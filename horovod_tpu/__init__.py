@@ -1,0 +1,69 @@
+"""horovod_tpu — a TPU-native distributed data-parallel training framework.
+
+A ground-up rebuild of the capabilities of Horovod v0.18.2 (reference:
+Agoniii/horovod) for TPU: named asynchronous collectives (allreduce /
+allgather / broadcast / adasum / join / alltoall) with tensor fusion, optimizer
+and gradient wrappers averaging gradients across replicas, parameter broadcast,
+fp16/bf16 compression, timeline profiling, stall detection, autotuning, and a
+``horovodrun``-style launcher — implemented on XLA collectives over TPU
+ICI/DCN meshes instead of NCCL/MPI/Gloo.
+
+Typical use (JAX-native, eager parity API)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    avg = hvd.allreduce(grad, name="g")          # psum/size over all ranks
+
+SPMD fast path (the performance path — everything in one jitted step)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    step = hvd.spmd.distributed_train_step(loss_fn, optimizer)
+"""
+
+from .basics import (  # noqa: F401
+    Adasum,
+    Average,
+    Sum,
+    cross_rank,
+    cross_size,
+    ddl_built,
+    gloo_built,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mlsl_built,
+    mpi_built,
+    mpi_threads_supported,
+    nccl_built,
+    num_replicas,
+    rank,
+    shutdown,
+    size,
+    xla_built,
+)
+from .exceptions import (  # noqa: F401
+    DuplicateNameError,
+    HorovodError,
+    HorovodInternalError,
+    NotInitializedError,
+    ShutdownError,
+)
+from .ops.collective_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    broadcast,
+    broadcast_async,
+    join,
+    poll,
+    synchronize,
+)
+from .ops.compression import Compression  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,295 @@
+"""Process model and global state — TPU-native equivalent of horovod's C ABI.
+
+Reference parity: `horovod/common/basics.py` (HorovodBasics ctypes wrapper) and the
+C API `horovod_init/shutdown/rank/size/local_rank/local_size/cross_rank/cross_size`
+(`horovod/common/operations.cc:642-779`).
+
+TPU-native design: there is no MPI. A *rank* is either
+  - a JAX process in a multi-host job (``jax.distributed``-initialized; the launcher
+    populates coordinator address / process id the way ``horovodrun`` populates
+    ``HOROVOD_RANK``/``HOROVOD_GLOO_RENDEZVOUS_ADDR``; see `horovod/run/gloo_run.py:210-285`), or
+  - a *thread-rank* bound to one local device, used by the in-process local cluster
+    (the analogue of ``horovodrun -np N -H localhost:N`` for tests/benchmarks — the
+    reference runs its whole test matrix this way, `.buildkite/gen-pipeline.sh:104-200`).
+
+The MPI communicator triple GLOBAL/LOCAL/CROSS (`horovod/common/mpi/mpi_context.cc:150-158`)
+maps onto device topology: LOCAL = ranks sharing a host (collectives ride ICI),
+CROSS = one rank per host (collectives ride DCN).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .exceptions import NotInitializedError
+
+# Reduce-op constants: parity with horovod/common/basics.py (Average/Sum/Adasum
+# exported from horovod.torch / horovod.tensorflow).
+Average = 0
+Sum = 1
+Adasum = 2
+
+# Rank identity for the calling thread. In process mode this is unused (the
+# process has exactly one rank); in local-cluster mode each worker thread carries
+# its rank here.
+_rank_ctx: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "hvd_tpu_rank", default=None
+)
+
+
+@dataclass
+class _GlobalState:
+    """Aggregate runtime state; mirrors HorovodGlobalState (`global_state.h:42-125`)."""
+
+    initialized: bool = False
+    mode: str = "standalone"  # standalone | cluster | multiprocess
+    size: int = 1
+    local_size: int = 1
+    cross_size: int = 1
+    rank0: int = 0  # this process's rank in multiprocess mode
+    local_rank0: int = 0
+    cross_rank0: int = 0
+    # rank -> jax device that rank's tensors live on (cluster mode: 1:1;
+    # process mode: this process's first addressable device).
+    rank_devices: Sequence[Any] = field(default_factory=list)
+    mesh: Any = None  # replica mesh: ALL devices, axis "hvd" (SPMD fast path)
+    rank_mesh: Any = None  # one device per rank (eager engine collectives)
+    engine: Any = None
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+_state = _GlobalState()
+_init_lock = threading.Lock()
+
+MESH_AXIS = "hvd"
+
+
+def _build_mesh(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices), (MESH_AXIS,))
+
+
+def init(
+    ranks: Optional[Sequence[int]] = None,
+    *,
+    _cluster_size: Optional[int] = None,
+    _devices: Optional[Sequence[Any]] = None,
+) -> None:
+    """Initialize the framework. Idempotent (InitializeHorovodOnce,
+    `operations.cc:585-631`).
+
+    Modes:
+      * **multiprocess** — launcher (or the user) set ``HVD_COORDINATOR_ADDR`` /
+        ``HVD_NUM_PROCS`` / ``HVD_PROCESS_ID`` or already called
+        ``jax.distributed.initialize``; each process is one rank.
+      * **cluster** — internal: ``local_cluster``/``run_cluster`` passes
+        ``_cluster_size`` and each worker thread is a rank bound to one device.
+      * **standalone** — single process, rank 0 of 1; the SPMD fast path still
+        uses every local device through the mesh.
+
+    ``ranks`` (subset init, `basics.py:33-65` in the reference) is accepted for
+    API parity; subsetting is only meaningful in multiprocess mode.
+    """
+    import jax
+
+    global _state
+    with _init_lock:
+        if _state.initialized:
+            return
+        coord = os.environ.get("HVD_COORDINATOR_ADDR")
+        if _cluster_size is not None:
+            devices = list(_devices) if _devices is not None else list(jax.devices())
+            if _cluster_size > len(devices):
+                raise ValueError(
+                    f"local cluster size {_cluster_size} exceeds device count "
+                    f"{len(devices)}"
+                )
+            devices = devices[:_cluster_size]
+            st = _GlobalState(
+                initialized=True,
+                mode="cluster",
+                size=_cluster_size,
+                local_size=_cluster_size,
+                cross_size=1,
+                rank_devices=devices,
+                mesh=_build_mesh(devices),
+                rank_mesh=_build_mesh(devices),
+            )
+        elif coord or jax.process_count() > 1:
+            if coord and jax.process_count() == 1:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=int(os.environ["HVD_NUM_PROCS"]),
+                    process_id=int(os.environ["HVD_PROCESS_ID"]),
+                )
+            nproc = jax.process_count()
+            pid = jax.process_index()
+            # local/cross decomposition: ranks sharing a host form LOCAL (ICI);
+            # one per host forms CROSS (DCN). Host identity from device process
+            # affinity; launcher also exports HVD_LOCAL_RANK/SIZE.
+            local_rank = int(os.environ.get("HVD_LOCAL_RANK", 0))
+            local_size = int(os.environ.get("HVD_LOCAL_SIZE", 1))
+            cross_rank = int(os.environ.get("HVD_CROSS_RANK", pid))
+            cross_size = int(os.environ.get("HVD_CROSS_SIZE", nproc))
+            # rank r's "home" device = first device owned by process r
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            rank_devices = [per_proc[i] for i in range(nproc)]
+            st = _GlobalState(
+                initialized=True,
+                mode="multiprocess",
+                size=nproc,
+                local_size=local_size,
+                cross_size=cross_size,
+                rank0=pid,
+                local_rank0=local_rank,
+                cross_rank0=cross_rank,
+                rank_devices=rank_devices,
+                mesh=_build_mesh(jax.devices()),
+                rank_mesh=_build_mesh(rank_devices),
+            )
+        else:
+            devices = list(jax.devices())
+            st = _GlobalState(
+                initialized=True,
+                mode="standalone",
+                size=1,
+                local_size=1,
+                cross_size=1,
+                rank_devices=[devices[0]],
+                mesh=_build_mesh(devices),
+                rank_mesh=_build_mesh(devices[:1]),
+            )
+        from .runtime.engine import Engine
+
+        st.engine = Engine(st)
+        st.engine.start()
+        _state = st
+
+
+def shutdown() -> None:
+    """Stop the background engine and reset state (`operations.cc:636-640`)."""
+    global _state
+    with _init_lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.shutdown()
+        _state = _GlobalState()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError(
+            "horovod_tpu has not been initialized; call hvd.init() first."
+        )
+    return _state
+
+
+def rank() -> int:
+    """Global rank of the caller (`operations.cc:665-668`)."""
+    st = _require_init()
+    if st.mode == "cluster":
+        r = _rank_ctx.get()
+        return 0 if r is None else r
+    return st.rank0
+
+
+def size() -> int:
+    """Number of ranks (`operations.cc:677-680`)."""
+    return _require_init().size
+
+
+def local_rank() -> int:
+    """Rank within the host / ICI domain (`operations.cc:670-674`)."""
+    st = _require_init()
+    if st.mode == "cluster":
+        return rank()
+    return st.local_rank0
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def cross_rank() -> int:
+    """Host index / DCN-domain rank (`operations.cc` cross accessors)."""
+    st = _require_init()
+    if st.mode == "cluster":
+        return 0
+    return st.cross_rank0
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def mesh():
+    """The 1-D rank mesh (axis name ``"hvd"``) collectives execute over."""
+    return _require_init().mesh
+
+
+def num_replicas() -> int:
+    """Total devices participating in the SPMD fast path (= mesh size).
+
+    In standalone mode this exceeds ``size()``: one process drives all local
+    chips and the jitted step data-parallelizes over them.
+    """
+    return int(np.prod(list(_require_init().mesh.shape.values())))
+
+
+def rank_device(r: Optional[int] = None):
+    st = _require_init()
+    return st.rank_devices[rank() if r is None else r]
+
+
+def _engine():
+    st = _require_init()
+    return st.engine
+
+
+def set_thread_rank(r: Optional[int]) -> None:
+    """Bind the calling thread to rank ``r`` (local-cluster worker threads)."""
+    _rank_ctx.set(r)
+
+
+# --- build-capability probes: parity with horovod/common/basics.py ------------
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mlsl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """TPU-native data plane: XLA collectives over ICI/DCN."""
+    return True

@@ -1,0 +1,42 @@
+"""Error types for the TPU-native collective engine.
+
+Reference parity: the C++ `Status` model (`horovod/common/common.h:150-250`) carries
+OK / UNKNOWN_ERROR / PRECONDITION_ERROR / ABORTED / INVALID_ARGUMENT / IN_PROGRESS.
+Here those surface as Python exceptions raised from `synchronize()` on a handle,
+matching the framework bindings' behavior (`horovod/torch/mpi_ops.py:476-492`).
+"""
+
+
+class HorovodError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodError):
+    """An error reported by the collective engine (negotiation or execution).
+
+    Mirrors coordinator-constructed ERROR responses
+    (`horovod/common/controller.cc:358-534`).
+    """
+
+
+class DuplicateNameError(HorovodInternalError):
+    """A rank enqueued two tensors with the same name before completion.
+
+    Mirrors DUPLICATE_NAME_ERROR (`horovod/common/common.h:160-163`).
+    """
+
+
+class ShutdownError(HorovodInternalError):
+    """Collective enqueued after engine shutdown.
+
+    Mirrors SHUT_DOWN_ERROR (`horovod/common/common.h:155-158`,
+    `operations.cc:824-826`). Subclasses HorovodInternalError so generic
+    ``except HorovodInternalError`` handlers around ``synchronize()`` match.
+    """
+
+
+class NotInitializedError(HorovodError):
+    """API used before ``init()`` was called.
+
+    Mirrors `horovod/common/operations.cc:660-663` (NOT_INITIALIZED_ERROR).
+    """

@@ -1,0 +1,153 @@
+"""Eager named-collective API: allreduce / allgather / broadcast / alltoall / join.
+
+Reference parity: the per-framework op surfaces —
+`horovod/torch/mpi_ops.py` (allreduce[_async][_], allgather[_async],
+broadcast[_async][_], poll, synchronize, join) and
+`horovod/tensorflow/mpi_ops.py` + `horovod/tensorflow/__init__.py:44-118`
+(allreduce with Average-in-framework, Adasum scaling, compression).
+
+Semantics: every op takes a *named* tensor; ranks negotiate readiness in the
+background engine; async variants return an integer handle usable with
+``poll``/``synchronize``. Inputs are committed to the calling rank's device;
+results come back on the same device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import basics
+from ..basics import Adasum, Average, Sum
+from ..runtime.messages import RequestType, TensorTableEntry
+from .compression import Compression
+
+_auto_counter = {}
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    """Stable auto-names per op type (the reference derives names from TF ops /
+    torch parameter names; eager callers without a name get a sequence id that
+    must line up across ranks by call order)."""
+    if name is not None:
+        return name
+    key = (prefix, basics.rank())
+    n = _auto_counter.get(key, 0)
+    _auto_counter[key] = n + 1
+    return f"{prefix}.noname.{n}"
+
+
+def _commit(tensor, rank: int):
+    arr = jnp.asarray(tensor)
+    return jax.device_put(arr, basics.rank_device(rank))
+
+
+def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
+             average=False, prescale=1.0, postscale=1.0) -> int:
+    eng = basics._engine()
+    r = basics.rank()
+    entry = TensorTableEntry(
+        tensor_name=name,
+        rank=r,
+        request_type=request_type,
+        array=_commit(tensor, r),
+        root_rank=root_rank,
+        average=average,
+        prescale_factor=prescale,
+        postscale_factor=postscale,
+    )
+    return eng.enqueue(entry)
+
+
+# ----------------------------------------------------------------- allreduce
+def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    """Asynchronous allreduce; returns a handle (`torch/mpi_ops.py:207-229`)."""
+    name = _auto_name("allreduce", name)
+    if op == Adasum:
+        return _enqueue(RequestType.ADASUM, tensor, name)
+    return _enqueue(RequestType.ALLREDUCE, tensor, name,
+                    average=(op == Average),
+                    prescale=prescale_factor, postscale=postscale_factor)
+
+
+def allreduce(tensor, name: Optional[str] = None, op: int = Average,
+              compression=Compression.none,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Synchronous allreduce of a named tensor across all ranks.
+
+    ``op``: Average (default; sum is divided by world size inside the fused
+    XLA program), Sum, or Adasum (`tensorflow/__init__.py:44-118`).
+    """
+    comp, ctx = compression.compress(jnp.asarray(tensor))
+    h = allreduce_async(comp, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor)
+    out = synchronize(h)
+    return compression.decompress(out, ctx)
+
+
+# ----------------------------------------------------------------- allgather
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    name = _auto_name("allgather", name)
+    return _enqueue(RequestType.ALLGATHER, tensor, name)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate each rank's tensor along dim 0 (ragged dim0 allowed, like
+    the reference's allgatherv path `mpi_operations.cc:83-166`)."""
+    return synchronize(allgather_async(tensor, name=name))
+
+
+# ----------------------------------------------------------------- broadcast
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
+    name = _auto_name("broadcast", name)
+    return _enqueue(RequestType.BROADCAST, tensor, name, root_rank=root_rank)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Every rank receives root_rank's value."""
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+# ------------------------------------------------------------------ alltoall
+def alltoall_async(tensor, name: Optional[str] = None) -> int:
+    """Equal-split alltoall (north-star op set extension): dim 0 must be
+    divisible by world size; rank r receives segment r from every rank."""
+    name = _auto_name("alltoall", name)
+    return _enqueue(RequestType.ALLTOALL, tensor, name)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    return synchronize(alltoall_async(tensor, name=name))
+
+
+# ------------------------------------------------------------- join / handles
+def join() -> int:
+    """Signal this rank is out of data; blocks until all ranks join; pending
+    allreduces proceed with zero contributions from joined ranks
+    (`operations.cc:908-934`, `torch/mpi_ops.py:495-509`). Returns the id of
+    the last rank to join."""
+    st = basics._require_init()
+    if st.mode == "multiprocess" and st.size > 1:
+        raise NotImplementedError(
+            "join() requires the cross-process control plane, which is not "
+            "yet implemented in multiprocess mode.")
+    eng = basics._engine()
+    h = eng.join(basics.rank())
+    return eng.handles.synchronize(h)
+
+
+def poll(handle: int) -> bool:
+    """Non-blocking completion check (`torch/mpi_ops.py:460-474`)."""
+    return basics._engine().handles.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the async op completes; raises HorovodInternalError on
+    negotiation/execution failure (`torch/mpi_ops.py:476-492`)."""
+    return basics._engine().handles.synchronize(handle)

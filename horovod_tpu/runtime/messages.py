@@ -1,0 +1,91 @@
+"""Request/Response value types for the collective engine.
+
+Reference parity: `horovod/common/message.{h,cc}` — Request (what one rank wants
+done with one named tensor) and Response (what the coordinator decided a tick
+should execute, possibly fused over several names). The reference serializes
+these with FlatBuffers (`wire/message.fbs`); here the in-process engine passes
+them as objects and the cross-process control plane uses the compact binary
+codec in :mod:`horovod_tpu.runtime.wire` (C++-owned once the native core lands).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+
+class RequestType(enum.IntEnum):
+    # Parity: message.h:48-49 (ALLREDUCE/ALLGATHER/BROADCAST/JOIN/ADASUM).
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5  # extension (north-star op set)
+
+
+class ResponseType(enum.IntEnum):
+    # Parity: message.h:133-134 (response adds ERROR).
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    ERROR = 6
+
+
+@dataclass
+class Request:
+    """One rank's intent for one named tensor (message.h Request)."""
+
+    request_rank: int
+    request_type: RequestType
+    tensor_name: str
+    tensor_dtype: str
+    tensor_shape: Tuple[int, ...]
+    root_rank: int = -1  # broadcast only
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+
+
+@dataclass
+class Response:
+    """Coordinator decision for a tick; may cover several fused names
+    (controller.cc:626-750 FuseResponses)."""
+
+    response_type: ResponseType
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+    # devices involved; informational (common.h Response devices field)
+    devices: List[int] = field(default_factory=list)
+    # allgather: per-rank dim0 sizes per tensor (tensor_sizes in reference)
+    tensor_sizes: List[List[int]] = field(default_factory=list)
+    # allreduce: divide the sum by world size (Average op); the reference does
+    # this division in-framework (`tensorflow/__init__.py:117`) — here it fuses
+    # into the compiled collective.
+    average: bool = False
+
+
+@dataclass
+class TensorTableEntry:
+    """Pending named tensor from one rank (`common.h:129-250` TensorTableEntry).
+
+    ``array`` is a committed jax.Array on the rank's device; ``callback``
+    receives (status_ok, result_or_error).
+    """
+
+    tensor_name: str
+    rank: int
+    request_type: RequestType
+    array: Any
+    root_rank: int = -1
+    callback: Optional[Any] = None
+    handle: Optional[int] = None
+    enqueue_seq: int = 0
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    average: bool = False  # Average op: fused divide-by-size
+    # alltoall splits (extension)
+    splits: Optional[Any] = None

@@ -1,0 +1,65 @@
+"""In-process local cluster: run a function as N ranks on N local devices.
+
+This is the TPU-native analogue of ``horovodrun -np N -H localhost:N`` used to
+run the reference's whole test matrix on one machine
+(`.buildkite/gen-pipeline.sh:104-200`, `test/common.py:24-56`). Instead of N
+OS processes coordinated over Gloo, N *threads* each bind to one local device
+(rank i ↔ device i) and share the in-process engine — the negotiation, fusion,
+validation, join and error paths are exercised exactly as in the reference's
+multi-process runs, while the collective itself executes as one XLA program
+over the device mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from . import basics
+
+
+class _RankThread(threading.Thread):
+    def __init__(self, rank: int, fn: Callable, args, kwargs):
+        super().__init__(name=f"hvd_tpu_rank{rank}", daemon=True)
+        self.rank = rank
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        basics.set_thread_rank(self.rank)
+        try:
+            self.result = self.fn(*self.args, **self.kwargs)
+        except BaseException as e:  # propagate to the launcher
+            self.error = e
+
+
+def run_cluster(fn: Callable, np: int = 2, args: Sequence = (),
+                kwargs: Optional[dict] = None,
+                timeout: float = 300.0) -> List[Any]:
+    """Run ``fn`` once per rank (N threads, one per device); returns per-rank
+    results in rank order. Initializes the framework in cluster mode if needed;
+    raises the first rank failure (first-failure semantics like
+    `gloo_run.py:253-259`)."""
+    kwargs = kwargs or {}
+    if basics.is_initialized():
+        st = basics._state
+        if st.mode != "cluster" or st.size != np:
+            basics.shutdown()
+    if not basics.is_initialized():
+        basics.init(_cluster_size=np)
+    threads = [_RankThread(r, fn, args, kwargs) for r in range(np)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"rank {t.rank} did not finish within {timeout}s "
+                "(possible stalled negotiation)")
+    for t in threads:
+        if t.error is not None:
+            raise t.error
+    return [t.result for t in threads]

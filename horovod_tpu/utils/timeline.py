@@ -1,0 +1,123 @@
+"""Chrome-tracing timeline profiler.
+
+Reference parity: `horovod/common/timeline.{h,cc}` — per-tensor NEGOTIATE spans,
+top-level op spans, and named activities written as Chrome tracing JSON by a
+dedicated writer thread fed through a queue (`timeline.h:47-75`). Enabled via
+``HOROVOD_TIMELINE=/path.json`` (`operations.cc:389-396`);
+``HOROVOD_TIMELINE_MARK_CYCLES=1`` adds engine-tick instant events
+(`operations.cc:400`). Device-side detail comes from ``jax.profiler`` traces —
+see :func:`trace_device` — replacing the CUDA-event replay of
+`cuda_operations.cc:77-93`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Host-side span recorder; no-op unless a path is configured."""
+
+    def __init__(self, path: Optional[str]):
+        self._path = path
+        self._enabled = bool(path)
+        self._mark_cycles = os.environ.get(
+            "HOROVOD_TIMELINE_MARK_CYCLES", "") in ("1", "true", "True")
+        self._q: "queue.Queue" = queue.Queue()
+        self._tid = {}
+        self._next_tid = 1
+        self._writer = None
+        if self._enabled:
+            self._f = open(path, "w")
+            self._f.write("[\n")
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="hvd_tpu_timeline", daemon=True)
+            self._writer.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def _emit(self, ev: dict) -> None:
+        if self._enabled:
+            self._q.put(ev)
+
+    def _writer_loop(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            self._f.write(json.dumps(ev) + ",\n")
+            self._f.flush()
+
+    def _ts(self) -> int:
+        return int(time.time() * 1e6)
+
+    def _tensor_tid(self, name: str) -> int:
+        t = self._tid.get(name)
+        if t is None:
+            t = self._next_tid
+            self._next_tid += 1
+            self._tid[name] = t
+            self._emit({"name": "thread_name", "ph": "M", "pid": 0, "tid": t,
+                        "args": {"name": name}})
+        return t
+
+    # span API mirroring Timeline::NegotiateStart/Start/ActivityStart/End
+    def negotiate_start(self, name: str, rank: int) -> None:
+        if not self._enabled:
+            return
+        self._emit({"name": f"NEGOTIATE_{name}", "ph": "B", "pid": 0,
+                    "tid": self._tensor_tid(name), "ts": self._ts(),
+                    "args": {"rank": rank}})
+
+    def op_start(self, name: str, op: str) -> None:
+        if not self._enabled:
+            return
+        tid = self._tensor_tid(name)
+        self._emit({"name": f"NEGOTIATE_{name}", "ph": "E", "pid": 0,
+                    "tid": tid, "ts": self._ts()})
+        self._emit({"name": op, "ph": "B", "pid": 0, "tid": tid,
+                    "ts": self._ts()})
+
+    def activity(self, name: str, activity: str) -> None:
+        if not self._enabled:
+            return
+        self._emit({"name": activity, "ph": "i", "pid": 0,
+                    "tid": self._tensor_tid(name), "ts": self._ts(), "s": "t"})
+
+    def op_end(self, name: str) -> None:
+        if not self._enabled:
+            return
+        self._emit({"name": "op", "ph": "E", "pid": 0,
+                    "tid": self._tensor_tid(name), "ts": self._ts()})
+
+    def cycle_tick(self) -> None:
+        if self._enabled and self._mark_cycles:
+            self._emit({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
+                        "ts": self._ts(), "s": "g"})
+
+    def close(self) -> None:
+        if not self._enabled:
+            return
+        self._q.put(None)
+        if self._writer is not None:
+            self._writer.join(timeout=2)
+        # valid-enough JSON: chrome tracing accepts trailing commas when the
+        # array is closed; terminate with an empty metadata event.
+        self._f.write('{"name":"end","ph":"M","pid":0}\n]\n')
+        self._f.close()
+        self._enabled = False
+
+
+def trace_device(path: str):
+    """Context manager: capture a ``jax.profiler`` device trace alongside the
+    host timeline (TPU analogue of the CUDA activity events)."""
+    import jax
+
+    return jax.profiler.trace(path)

@@ -1,0 +1,40 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's strategy of running the full test matrix as a real
+multi-rank job on one machine (`.buildkite/gen-pipeline.sh:104-200`): here the
+"pod" is 8 virtual CPU devices (`--xla_force_host_platform_device_count=8`)
+and ranks are in-process threads (see horovod_tpu/testing.py).
+"""
+
+import os
+import sys
+
+# The axon sitecustomize imports jax at interpreter start, but the backend
+# initializes lazily — reconfigure to CPU with 8 virtual devices before any
+# computation runs.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # fp64/int64 op-matrix parity tests
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Each test starts uninitialized (mirrors per-test process isolation)."""
+    yield
+    import horovod_tpu as hvd
+
+    if hvd.is_initialized():
+        hvd.shutdown()

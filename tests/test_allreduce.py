@@ -1,0 +1,157 @@
+"""Allreduce correctness matrix.
+
+Parity model: `test/test_tensorflow.py` (test_horovod_allreduce_cpu,
+_fused, _error shape/type mismatch, _grad) and `test/test_torch.py` async and
+inplace variants — rank-dependent inputs with exact expected sums.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum(dtype):
+    def fn():
+        r = hvd.rank()
+        x = np.full((4, 5), r + 1, dtype=dtype)
+        out = hvd.allreduce(x, name=f"sum_{np.dtype(dtype).name}", op=hvd.Sum)
+        expected = np.full((4, 5), sum(range(1, 5)), dtype=dtype)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-3)
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_allreduce_average():
+    def fn():
+        r = hvd.rank()
+        x = np.full((3,), float(r), np.float32)
+        out = hvd.allreduce(x, name="avg")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((3,), 3.5, np.float32))
+        return True
+
+    assert all(testing.run_cluster(fn, np=8))
+
+
+def test_allreduce_multiple_named_fused():
+    """Several tensors in flight fuse into one bucket and all complete."""
+
+    def fn():
+        r = hvd.rank()
+        handles = [hvd.allreduce_async(np.full((8,), r * 10 + i, np.float32),
+                                       name=f"fuse_{i}", op=hvd.Sum)
+                   for i in range(6)]
+        outs = [hvd.synchronize(h) for h in handles]
+        for i, o in enumerate(outs):
+            expected = sum(rr * 10 + i for rr in range(4))
+            np.testing.assert_allclose(np.asarray(o),
+                                       np.full((8,), expected, np.float32))
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_allreduce_async_poll():
+    def fn():
+        import time
+        h = hvd.allreduce_async(np.ones((2,), np.float32), name="pollme",
+                                op=hvd.Sum)
+        deadline = time.monotonic() + 30
+        while not hvd.poll(h):  # non-blocking completion check
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.full((2,), 2.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_allreduce_shape_mismatch_errors():
+    """Coordinator-style validation: mismatched shapes produce an error on
+    every rank (parity: test_horovod_allreduce_error, controller.cc:358-534)."""
+
+    def fn():
+        r = hvd.rank()
+        shape = (2, 3) if r == 0 else (3, 2)
+        with pytest.raises(hvd.HorovodInternalError):
+            hvd.allreduce(np.ones(shape, np.float32), name="mismatch",
+                          op=hvd.Sum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_allreduce_dtype_mismatch_errors():
+    def fn():
+        r = hvd.rank()
+        dtype = np.float32 if r == 0 else np.float64
+        with pytest.raises(hvd.HorovodInternalError):
+            hvd.allreduce(np.ones((2,), dtype), name="dtmismatch", op=hvd.Sum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_duplicate_name_errors():
+    """Same name enqueued twice from one rank before completion
+    (DUPLICATE_NAME_ERROR, common.h:160)."""
+
+    def fn():
+        if hvd.rank() == 0:
+            h1 = hvd.allreduce_async(np.ones((2,), np.float32), name="dup",
+                                     op=hvd.Sum)
+            h2 = hvd.allreduce_async(np.ones((2,), np.float32), name="dup",
+                                     op=hvd.Sum)
+            with pytest.raises(hvd.HorovodInternalError, match="[Dd]uplicate"):
+                hvd.synchronize(h2)
+            return hvd.synchronize(h1)
+        else:
+            import time
+            time.sleep(0.2)  # let rank 0 double-enqueue first
+            return hvd.synchronize(
+                hvd.allreduce_async(np.ones((2,), np.float32), name="dup",
+                                    op=hvd.Sum))
+
+    outs = testing.run_cluster(fn, np=2)
+    for o in outs:
+        np.testing.assert_allclose(np.asarray(o), np.full((2,), 2.0))
+
+
+def test_allreduce_prescale_postscale():
+    def fn():
+        out = hvd.allreduce(np.ones((4,), np.float32), name="scaled",
+                            op=hvd.Sum, prescale_factor=2.0,
+                            postscale_factor=0.5)
+        np.testing.assert_allclose(np.asarray(out), np.full((4,), 2.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_allreduce_standalone_identity():
+    hvd.init()
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = hvd.allreduce(x, name="solo")
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_allreduce_fp16_compression():
+    def fn():
+        r = hvd.rank()
+        x = np.full((16,), r + 1.0, np.float32)
+        out = hvd.allreduce(x, name="comp", op=hvd.Sum,
+                            compression=hvd.Compression.fp16)
+        assert np.asarray(out).dtype == np.float32
+        np.testing.assert_allclose(np.asarray(out), np.full((16,), 3.0),
+                                   rtol=1e-2)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))

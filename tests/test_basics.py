@@ -1,0 +1,49 @@
+"""Process-model tests (parity: reference init/rank/size C ABI behavior)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+
+
+def test_standalone_init():
+    hvd.init()
+    assert hvd.is_initialized()
+    assert hvd.size() == 1
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_size() == 1
+    assert hvd.num_replicas() == 8  # all virtual devices on the replica mesh
+
+
+def test_init_idempotent():
+    hvd.init()
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_not_initialized_raises():
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.rank()
+
+
+def test_build_probes():
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+
+
+def test_cluster_ranks():
+    res = testing.run_cluster(lambda: (hvd.rank(), hvd.size(),
+                                       hvd.local_rank(), hvd.cross_rank()),
+                              np=4)
+    assert res == [(r, 4, r, 0) for r in range(4)]
+
+
+def test_shutdown_resets():
+    hvd.init()
+    hvd.shutdown()
+    assert not hvd.is_initialized()

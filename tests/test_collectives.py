@@ -1,0 +1,234 @@
+"""Allgather / broadcast / alltoall / join / adasum correctness.
+
+Parity model: `test/test_tensorflow.py` allgather variable-size (:546),
+broadcast matrix + error cases, `test/test_torch.py` join (:1206 area),
+`test/test_adasum_tensorflow.py` numerics vs a NumPy reference (:104).
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+
+
+def test_allgather_equal_sizes():
+    def fn():
+        r = hvd.rank()
+        x = np.full((2, 3), r, np.float32)
+        out = np.asarray(hvd.allgather(x, name="ag"))
+        assert out.shape == (8, 3)
+        for src in range(4):
+            np.testing.assert_allclose(out[2 * src:2 * src + 2],
+                                       np.full((2, 3), src, np.float32))
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_allgather_variable_dim0():
+    """Ragged first dims, the allgatherv path (`mpi_operations.cc:83-166`)."""
+
+    def fn():
+        r = hvd.rank()
+        x = np.full((r + 1, 2), r, np.float32)
+        out = np.asarray(hvd.allgather(x, name="agv"))
+        assert out.shape == (1 + 2 + 3 + 4, 2)
+        off = 0
+        for src in range(4):
+            np.testing.assert_allclose(out[off:off + src + 1],
+                                       np.full((src + 1, 2), src, np.float32))
+            off += src + 1
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_allgather_tail_shape_mismatch_errors():
+    def fn():
+        r = hvd.rank()
+        shape = (2, 3) if r == 0 else (2, 4)
+        with pytest.raises(hvd.HorovodInternalError):
+            hvd.allgather(np.ones(shape, np.float32), name="agerr")
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+@pytest.mark.parametrize("root", [0, 1, 3])
+def test_broadcast(root):
+    def fn():
+        r = hvd.rank()
+        x = np.full((3, 2), r * 100 + 7, np.float32)
+        out = np.asarray(hvd.broadcast(x, root_rank=root, name=f"bc{root}"))
+        np.testing.assert_allclose(out, np.full((3, 2), root * 100 + 7,
+                                                np.float32))
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_broadcast_root_mismatch_errors():
+    def fn():
+        r = hvd.rank()
+        with pytest.raises(hvd.HorovodInternalError):
+            hvd.broadcast(np.ones((2,), np.float32), root_rank=r,
+                          name="bcroot")
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_equal_split():
+    def fn():
+        r = hvd.rank()
+        # rank r sends value r*10+dst to dst
+        x = np.concatenate([np.full((2,), r * 10 + dst, np.float32)
+                            for dst in range(4)])
+        out = np.asarray(hvd.alltoall(x, name="a2a"))
+        expected = np.concatenate([np.full((2,), src * 10 + r, np.float32)
+                                   for src in range(4)])
+        np.testing.assert_allclose(out, expected)
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_join_uneven_workloads():
+    """Ranks with less data join early; remaining allreduces see zeros from
+    joined ranks (JoinOp semantics, controller.cc:202-256)."""
+
+    def fn():
+        r = hvd.rank()
+        steps = 2 if r == 0 else 4  # rank 0 runs out of data first
+        for i in range(steps):
+            out = hvd.allreduce(np.full((2,), 1.0, np.float32),
+                                name=f"join_step{i}", op=hvd.Sum)
+        last = hvd.join()
+        return np.asarray(out)[0], last
+
+    res = testing.run_cluster(fn, np=2)
+    # steps 0-1: both ranks -> 2.0; steps 2-3: only rank 1 + zeros -> 1.0
+    assert res[0][0] == 2.0
+    assert res[1][0] == 1.0
+    # join returns the last rank to join (same on all ranks)
+    assert res[0][1] == res[1][1]
+
+
+def test_allgather_after_join_errors():
+    def fn():
+        r = hvd.rank()
+        if r == 0:
+            hvd.join()
+            return True
+        else:
+            import time
+            time.sleep(0.3)
+            with pytest.raises(hvd.HorovodInternalError):
+                hvd.allgather(np.ones((2, 2), np.float32), name="agjoin")
+            hvd.join()
+            return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def _numpy_adasum_pair(a, b):
+    """Reference combine rule (adasum/adasum.h:331+)."""
+    dot = float(np.dot(a.ravel(), b.ravel()))
+    na = float(np.dot(a.ravel(), a.ravel()))
+    nb = float(np.dot(b.ravel(), b.ravel()))
+    ac = 1.0 if na == 0 else 1.0 - dot / (2 * na)
+    bc = 1.0 if nb == 0 else 1.0 - dot / (2 * nb)
+    return ac * a + bc * b
+
+
+def _numpy_adasum(bufs):
+    while len(bufs) > 1:
+        bufs = [_numpy_adasum_pair(bufs[i], bufs[i + 1])
+                for i in range(0, len(bufs), 2)]
+    return bufs[0]
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_adasum_matches_numpy(world):
+    """Numerical parity with the reference VHDD combine
+    (`test/test_adasum_tensorflow.py:104` pattern)."""
+    rng = np.random.RandomState(0)
+    data = [rng.randn(33).astype(np.float32) for _ in range(world)]
+
+    def fn():
+        r = hvd.rank()
+        out = hvd.allreduce(data[r], name="adasum", op=hvd.Adasum)
+        return np.asarray(out)
+
+    res = testing.run_cluster(fn, np=world)
+    expected = _numpy_adasum(list(data))
+    for o in res:
+        np.testing.assert_allclose(o, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_adasum_orthogonal_is_sum():
+    """Orthogonal vectors: adasum == plain sum (scale-invariance property)."""
+    def fn():
+        r = hvd.rank()
+        x = np.zeros((4,), np.float32)
+        x[r] = 2.0
+        out = hvd.allreduce(x, name="ortho", op=hvd.Adasum)
+        return np.asarray(out)
+
+    res = testing.run_cluster(fn, np=4)
+    for o in res:
+        np.testing.assert_allclose(o, np.full((4,), 2.0), rtol=1e-5)
+
+
+def test_all_joined_with_pending_tensor_no_deadlock():
+    """Regression: rank enqueues an allreduce then joins while the other rank
+    has already joined — the pending tensor must reduce against zeros and the
+    join barrier must release (controller.cc:202-256)."""
+
+    def fn():
+        r = hvd.rank()
+        if r == 0:
+            h = hvd.allreduce_async(np.full((2,), 5.0, np.float32),
+                                    name="lastone", op=hvd.Sum)
+            hvd.join()
+            return np.asarray(hvd.synchronize(h))[0]
+        else:
+            hvd.join()
+            return None
+
+    res = testing.run_cluster(fn, np=2, timeout=30)
+    assert res[0] == 5.0  # rank 1 contributed zeros
+
+
+def test_op_flag_mismatch_errors():
+    """Sum on one rank vs Average on another must be an error, not a silent
+    first-enqueuer-wins."""
+
+    def fn():
+        op = hvd.Sum if hvd.rank() == 0 else hvd.Average
+        with pytest.raises(hvd.HorovodInternalError, match="op/scale"):
+            hvd.allreduce(np.ones((2,), np.float32), name="opmix", op=op)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_indivisible_errors():
+    def fn():
+        with pytest.raises(hvd.HorovodInternalError, match="divisible"):
+            hvd.alltoall(np.ones((7,), np.float32), name="a2abad")
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_shutdown_error_type():
+    import horovod_tpu.basics as basics
+    hvd.init()
+    eng = basics._engine()
+    eng.shutdown()
+    h = hvd.allreduce_async(np.ones((2,), np.float32), name="postshutdown")
+    with pytest.raises(hvd.ShutdownError):
+        eng.handles.synchronize(h)
+    hvd.shutdown()
