@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""ResNet-50 synthetic benchmark — the reference's headline measurement.
+
+Parity: `examples/tensorflow2_synthetic_benchmark.py` (ResNet-50, synthetic
+ImageNet-sized data, 10 warmup iters, 10 rounds x 10 timed iters, reports
+img/sec ± 1.96σ) rebuilt on the SPMD fast path: the whole train step (forward,
+backward, gradient averaging over the replica mesh, SGD update) is one XLA
+program; batch sharded over replicas, params replicated.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "img/s/chip", "vs_baseline": N / 103.55}
+
+Baseline denominator: the reference's published illustrative throughput
+1656.82 img/s on 16 Pascal GPUs = 103.55 img/s/GPU (`docs/benchmarks.rst:43`,
+BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.models.resnet import ResNet50
+
+    hvd.init()
+    backend = jax.default_backend()
+    n_dev = hvd.num_replicas()
+
+    on_tpu = backend == "tpu"
+    batch_per_device = int(os.environ.get(
+        "BENCH_BATCH", "128" if on_tpu else "4"))
+    image_size = int(os.environ.get(
+        "BENCH_IMAGE", "224" if on_tpu else "32"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "10" if on_tpu else "2"))
+    num_rounds = int(os.environ.get("BENCH_ROUNDS", "10" if on_tpu else "2"))
+    iters_per_round = int(os.environ.get("BENCH_ITERS", "10" if on_tpu else "2"))
+
+    batch = batch_per_device * n_dev
+    model = ResNet50(num_classes=1000,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    rng = jax.random.PRNGKey(0)
+    images_h = np.random.RandomState(0).randn(
+        batch, image_size, image_size, 3).astype(np.float32)
+    labels_h = np.random.RandomState(1).randint(0, 1000, (batch,))
+
+    variables = model.init(rng, jnp.zeros((1, image_size, image_size, 3),
+                                          jnp.float32), train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    mesh = hvd.mesh()
+    params = spmd.replicate(params, mesh)
+    batch_stats = spmd.replicate(batch_stats, mesh)
+    opt_state = spmd.replicate(opt_state, mesh)
+    images = spmd.shard_batch(jnp.asarray(images_h), mesh)
+    labels = spmd.shard_batch(jnp.asarray(labels_h), mesh)
+
+    def loss_fn(p, bs, x, y):
+        logits, new_state = model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, new_state["batch_stats"]
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(mesh, P())
+
+    @(lambda f: jax.jit(f, donate_argnums=(0, 1, 2),
+                        out_shardings=(repl, repl, repl, repl)))
+    def train_step(p, bs, opt, x, y):
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, bs, x, y)
+        updates, opt = tx.update(grads, opt, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_bs, opt, loss
+
+    # warmup (includes compile); sync via host transfer — on the axon relay
+    # platform block_until_ready on mesh-sharded outputs can return early
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+
+    img_secs = []
+    for _ in range(num_rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters_per_round):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        float(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(batch * iters_per_round / dt)
+
+    mean = float(np.mean(img_secs))
+    conf = float(1.96 * np.std(img_secs))
+    per_chip = mean / n_dev
+    print(f"# backend={backend} devices={n_dev} batch/device={batch_per_device} "
+          f"img={image_size} loss={float(loss):.3f}", file=sys.stderr)
+    print(f"# Img/sec total: {mean:.1f} +- {conf:.1f}; per chip: {per_chip:.1f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / 103.55, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,7 @@ SPMD fast path (the performance path — everything in one jitted step)::
 
     import horovod_tpu as hvd
     hvd.init()
-    step = hvd.spmd.distributed_train_step(loss_fn, optimizer)
+    step = hvd.spmd.make_train_step(loss_fn, optimizer)
 """
 
 from .basics import (  # noqa: F401
@@ -65,5 +65,17 @@ from .ops.collective_ops import (  # noqa: F401
     synchronize,
 )
 from .ops.compression import Compression  # noqa: F401
+from .optim.broadcast import (  # noqa: F401
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .optim.distributed import (  # noqa: F401
+    DistributedGradientTape,
+    DistributedOptimizer,
+    allreduce_gradients,
+    grad,
+)
+from . import spmd  # noqa: F401
 
 __version__ = "0.1.0"

@@ -68,6 +68,10 @@ def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
     """Asynchronous allreduce; returns a handle (`torch/mpi_ops.py:207-229`)."""
     name = _auto_name("allreduce", name)
     if op == Adasum:
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError(
+                "prescale_factor/postscale_factor are not supported with "
+                "op=Adasum (the combine rule is scale-invariant).")
         return _enqueue(RequestType.ADASUM, tensor, name)
     return _enqueue(RequestType.ALLREDUCE, tensor, name,
                     average=(op == Average),

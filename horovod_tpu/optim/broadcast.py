@@ -1,0 +1,96 @@
+"""Parameter / optimizer-state / object broadcast.
+
+Reference parity:
+  - `horovod/torch/__init__.py:437-466` ``broadcast_parameters`` — broadcast
+    every named parameter from root.
+  - `horovod/torch/__init__.py:469-585` ``broadcast_optimizer_state`` — walks
+    optimizer state, wraps scalar options into tensors, casts back after.
+  - `horovod/tensorflow/__init__.py:139-227` ``broadcast_variables`` /
+    ``BroadcastGlobalVariablesHook``.
+
+The checkpoint/resume pattern this enables is the reference's supported one
+(SURVEY §5): rank 0 restores from disk, everyone else receives via broadcast.
+Pytrees replace the name→tensor dicts; names are derived from key paths so
+every rank negotiates the same tensor names.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import basics
+from ..ops import collective_ops as ops
+
+
+def _named_leaves(tree, prefix: str):
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    out = []
+    for path, leaf in leaves:
+        name = prefix + jax.tree_util.keystr(path)
+        out.append((name, leaf))
+    return out
+
+
+def broadcast_parameters(params, root_rank: int = 0, prefix: str = "param"):
+    """Broadcast every leaf of a pytree from ``root_rank``; returns the tree
+    with every rank holding root's values."""
+    if basics.size() == 1:
+        return params
+    named = _named_leaves(params, prefix)
+    handles = [ops.broadcast_async(jnp.asarray(v), root_rank, name=n)
+               for n, v in named]
+    results = [ops.synchronize(h) for h in handles]
+    flat = [r.reshape(np.shape(v)) if hasattr(r, "reshape") else r
+            for r, (_, v) in zip(results, named)]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast optimizer state (optax pytree). Non-array leaves (step counts,
+    schedules as scalars) are wrapped into arrays for the wire and unwrapped
+    after, mirroring the scalar-wrapping in `torch/__init__.py:469-585`."""
+    if basics.size() == 1:
+        return opt_state
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    wrapped = []
+    kinds = []  # remember python scalar types to cast back
+    for leaf in leaves:
+        if isinstance(leaf, (int, float)):
+            kinds.append(type(leaf))
+            wrapped.append(jnp.asarray(leaf))
+        else:
+            kinds.append(None)
+            wrapped.append(leaf)
+    tree = jax.tree_util.tree_unflatten(treedef, wrapped)
+    tree = broadcast_parameters(tree, root_rank, prefix="opt")
+    leaves2 = jax.tree_util.tree_leaves(tree)
+    restored = [k(l) if k is not None else l for k, l in zip(kinds, leaves2)]
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast an arbitrary picklable object (config, RNG key tuple, ...).
+
+    Serialization rides the byte-collective: length broadcast first (so
+    non-root ranks can size their buffer), then the payload as uint8.
+    """
+    if basics.size() == 1:
+        return obj
+    name = name or "broadcast_object"
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    else:
+        payload = np.zeros((0,), dtype=np.uint8)
+    n = ops.broadcast(np.array([payload.size], np.int32), root_rank,
+                      name=f"{name}.len")
+    nbytes = int(np.asarray(n)[0])
+    if basics.rank() != root_rank:
+        payload = np.zeros((nbytes,), dtype=np.uint8)
+    data = ops.broadcast(payload, root_rank, name=f"{name}.data")
+    return pickle.loads(np.asarray(data).tobytes())

@@ -323,6 +323,11 @@ class Engine:
                         f"dimension for {name!r}: {shps}")
             if any(e.array.ndim == 0 for e in entries):
                 return f"Allgather of scalar tensor {name!r} is not supported."
+        if e0.request_type == RequestType.ADASUM:
+            if self._world & (self._world - 1):
+                # parity: torch/mpi_ops.py:104-120 (power-of-2 requirement)
+                return (f"Adasum requires a power-of-2 number of ranks; got "
+                        f"{self._world}.")
         if e0.request_type == RequestType.ALLTOALL:
             d0 = e0.array.shape[0] if e0.array.ndim else 0
             if e0.array.ndim == 0 or d0 % self._world != 0:

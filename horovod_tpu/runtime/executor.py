@@ -301,27 +301,24 @@ class Executor:
         g = self._global_array(bufs, lmax)
         full = self._allgather_fn(world, lmax, dtype)(g)  # replicated (world, lmax)
 
-        results = {}
+        # build the gathered tensors ONCE (identical for every destination),
+        # then place per rank
         import jax.numpy as jnp
-        for r in ranks:
-            outs = []
-            for t in range(nt):
-                segs = []
-                for src in range(world):
-                    off = sum(sizes[src][:t])
-                    sz = sizes[src][t]
-                    segs.append(jnp.ravel(full[src])[off:off + sz])
-                cat = jnp.concatenate(segs)
-                shp0 = entries_by_rank[r][t].array.shape
-                tail = shp0[1:]
-                d0 = sum(int(entries_by_rank[src][t].array.shape[0]) if
-                         entries_by_rank[src][t].array.shape else 1
-                         for src in range(world))
-                outs.append(cat.reshape((d0,) + tuple(tail)))
-            # place on the rank's device
-            results[r] = [self._jax.device_put(o, self._rank_devices[r])
-                          for o in outs]
-        return results
+        outs = []
+        for t in range(nt):
+            segs = []
+            for src in range(world):
+                off = sum(sizes[src][:t])
+                sz = sizes[src][t]
+                segs.append(jnp.ravel(full[src])[off:off + sz])
+            cat = jnp.concatenate(segs)
+            tail = entries_by_rank[ranks[0]][t].array.shape[1:]
+            d0 = sum(int(entries_by_rank[src][t].array.shape[0])
+                     for src in range(world))
+            outs.append(cat.reshape((d0,) + tuple(tail)))
+        return {r: [self._jax.device_put(o, self._rank_devices[r])
+                    for o in outs]
+                for r in ranks}
 
     def _exec_broadcast(self, response, entries_by_rank):
         world = self._world

@@ -1,0 +1,158 @@
+"""SPMD fast path: collectives *inside* a jitted step over the device mesh.
+
+This is the performance path that replaces the reference's whole background
+engine for training loops: where Horovod's `DistributedOptimizer` enqueues one
+NCCL allreduce per gradient tensor with 64 MB fusion
+(`horovod/torch/__init__.py:115-169`, `nccl_operations.cc:55-105`), here the
+entire train step — forward, backward, gradient averaging, optimizer update —
+is ONE compiled XLA program over the replica mesh. XLA schedules the gradient
+all-reduces on ICI, overlaps them with the backward pass (latency-hiding
+scheduler), and fuses the optimizer update; there is nothing left to negotiate
+at runtime. This is the design stance from SURVEY.md §7: negotiation machinery
+for the eager path, static scheduling for the hot path.
+
+Two usage levels:
+
+1. Collective primitives with the ``"hvd"`` axis for custom ``shard_map`` code:
+   ``spmd.allreduce/allgather/alltoall/broadcast/...``
+2. Whole-step builders: ``make_train_step(loss_fn, tx)`` returns a jitted
+   data-parallel step with batch sharded over replicas and params replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import basics
+from .basics import MESH_AXIS, Adasum, Average, Sum
+
+
+# --------------------------------------------------------- in-jit primitives
+def allreduce(x, op: int = Average, axis: str = MESH_AXIS):
+    """Collective reduce across the replica axis; call inside shard_map/pmap.
+
+    TPU-native form of `EnqueueTensorAllreduce` (`operations.cc:783`) for code
+    already running under SPMD.
+    """
+    if op == Adasum:
+        return adasum(x, axis=axis)
+    s = jax.lax.psum(x, axis)
+    if op == Average:
+        n = jax.lax.psum(jnp.ones((), jnp.int32), axis)
+        if jnp.issubdtype(s.dtype, jnp.integer):
+            s = s // n.astype(s.dtype)  # match eager engine int semantics
+        else:
+            s = s / n.astype(s.dtype)
+    return s
+
+
+def pmean(x, axis: str = MESH_AXIS):
+    return jax.lax.pmean(x, axis)
+
+
+def allgather(x, axis: str = MESH_AXIS):
+    return jax.lax.all_gather(x, axis, tiled=True)
+
+
+def alltoall(x, axis: str = MESH_AXIS, split_axis: int = 0, concat_axis: int = 0):
+    return jax.lax.all_to_all(x, axis, split_axis, concat_axis, tiled=True)
+
+
+def broadcast(x, root_rank: int, axis: str = MESH_AXIS):
+    """Every replica receives replica ``root_rank``'s value."""
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def reduce_scatter(x, axis: str = MESH_AXIS, scatter_axis: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+def adasum(x, axis: str = MESH_AXIS):
+    """Adasum combine across the replica axis inside SPMD code.
+
+    Pairwise tree as in `adasum/adasum.h:185-331`: at level k, partners are
+    distance 2^k apart; coefficients from psum'd dots/norms restricted to each
+    pair. Implemented via all_gather + local tree (replica count is static),
+    which XLA turns into one gather plus vectorized math — efficient for the
+    gradient-sized tensors Adasum targets.
+    """
+    g = jax.lax.all_gather(x, axis)  # [n, ...]
+    n = g.shape[0]
+    if n & (n - 1):
+        raise ValueError("Adasum requires a power-of-2 replica count "
+                         "(parity: torch/mpi_ops.py:104-120)")
+    flat = g.reshape(n, -1).astype(jnp.float32)
+    while flat.shape[0] > 1:
+        a, b = flat[0::2], flat[1::2]
+        dot = jnp.sum(a * b, axis=1, keepdims=True)
+        na = jnp.sum(a * a, axis=1, keepdims=True)
+        nb = jnp.sum(b * b, axis=1, keepdims=True)
+        ac = jnp.where(na == 0, 1.0, 1.0 - dot / (2 * jnp.where(na == 0, 1.0, na)))
+        bc = jnp.where(nb == 0, 1.0, 1.0 - dot / (2 * jnp.where(nb == 0, 1.0, nb)))
+        flat = ac * a + bc * b
+    return flat[0].reshape(x.shape).astype(x.dtype)
+
+
+# ------------------------------------------------------------ whole-step API
+def replica_mesh() -> Mesh:
+    return basics.mesh()
+
+
+def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Shard dim 0 (batch) across replicas."""
+    return NamedSharding(mesh or basics.mesh(), P(MESH_AXIS))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or basics.mesh(), P())
+
+
+def shard_batch(batch, mesh: Optional[Mesh] = None):
+    """Place a host batch onto the mesh, sharded along dim 0."""
+    sh = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+
+def replicate(tree, mesh: Optional[Mesh] = None):
+    """Replicate params/optimizer state across the mesh (the SPMD analogue of
+    `broadcast_parameters`: every replica holds identical values)."""
+    sh = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
+                    donate: bool = True) -> Callable:
+    """Build the jitted data-parallel train step (the bench hot loop).
+
+    ``loss_fn(params, batch) -> scalar loss`` computed on the *local* shard;
+    gradient averaging across replicas is inserted automatically by GSPMD
+    because params are replicated while the batch is sharded. ``tx`` is an
+    optax GradientTransformation. Returns
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+    """
+    import optax
+
+    mesh = mesh or basics.mesh()
+    repl = NamedSharding(mesh, P())
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(
+        step,
+        donate_argnums=donate_argnums,
+        out_shardings=(repl, repl, repl),
+    )

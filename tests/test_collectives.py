@@ -232,3 +232,13 @@ def test_shutdown_error_type():
     with pytest.raises(hvd.ShutdownError):
         eng.handles.synchronize(h)
     hvd.shutdown()
+
+
+def test_adasum_non_power_of_2_clear_error():
+    def fn():
+        with pytest.raises(hvd.HorovodInternalError, match="power-of-2"):
+            hvd.allreduce(np.ones((4,), np.float32), name="ad3",
+                          op=hvd.Adasum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=3))

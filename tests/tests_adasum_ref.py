@@ -1,0 +1,20 @@
+"""Shared NumPy reference for the Adasum combine rule
+(`adasum/adasum.h:331+`), used by eager and SPMD adasum tests."""
+
+import numpy as np
+
+
+def numpy_adasum_pair(a, b):
+    dot = float(np.dot(a.ravel(), b.ravel()))
+    na = float(np.dot(a.ravel(), a.ravel()))
+    nb = float(np.dot(b.ravel(), b.ravel()))
+    ac = 1.0 if na == 0 else 1.0 - dot / (2 * na)
+    bc = 1.0 if nb == 0 else 1.0 - dot / (2 * nb)
+    return ac * a + bc * b
+
+
+def numpy_adasum(bufs):
+    while len(bufs) > 1:
+        bufs = [numpy_adasum_pair(bufs[i], bufs[i + 1])
+                for i in range(0, len(bufs), 2)]
+    return bufs[0]
