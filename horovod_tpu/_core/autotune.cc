@@ -1,0 +1,187 @@
+#include "autotune.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------- GaussianProcess
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2 * ls_ * ls_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& X,
+                          const std::vector<double>& y) {
+  X_ = X;
+  size_t n = X.size();
+  ymean_ = 0;
+  for (double v : y) ymean_ += v;
+  if (n) ymean_ /= n;
+  std::vector<double> yc(n);
+  for (size_t i = 0; i < n; ++i) yc[i] = y[i] - ymean_;
+
+  // K + noise*I, Cholesky K = L L^T
+  std::vector<std::vector<double>> K(n, std::vector<double>(n));
+  for (size_t i = 0; i < n; ++i)
+    for (size_t j = 0; j < n; ++j)
+      K[i][j] = Kernel(X[i], X[j]) + (i == j ? noise_ : 0.0);
+  L_.assign(n, std::vector<double>(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double s = K[i][j];
+      for (size_t k = 0; k < j; ++k) s -= L_[i][k] * L_[j][k];
+      if (i == j)
+        L_[i][j] = std::sqrt(std::max(s, 1e-12));
+      else
+        L_[i][j] = s / L_[j][j];
+    }
+  }
+  // alpha = K^-1 yc via forward/back substitution
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = yc[i];
+    for (size_t k = 0; k < i; ++k) s -= L_[i][k] * z[k];
+    z[i] = s / L_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double s = z[ii];
+    for (size_t k = ii + 1; k < n; ++k) s -= L_[k][ii] * alpha_[k];
+    alpha_[ii] = s / L_[ii][ii];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* stddev) const {
+  size_t n = X_.size();
+  if (n == 0) {
+    *mean = 0;
+    *stddev = 1;
+    return;
+  }
+  std::vector<double> k(n);
+  for (size_t i = 0; i < n; ++i) k[i] = Kernel(x, X_[i]);
+  double m = ymean_;
+  for (size_t i = 0; i < n; ++i) m += k[i] * alpha_[i];
+  // var = k(x,x) - v^T v, v = L^-1 k
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = k[i];
+    for (size_t kk = 0; kk < i; ++kk) s -= L_[i][kk] * v[kk];
+    v[i] = s / L_[i][i];
+  }
+  double var = 1.0;
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  *mean = m;
+  *stddev = std::sqrt(std::max(var, 1e-12));
+}
+
+// --------------------------------------------------------- BayesianOptimizer
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  X_.push_back(x);
+  y_.push_back(y);
+  // standardize scores before fitting: raw throughput is ~1e8-1e9 bytes/sec
+  // while the GP prior variance is 1, so unnormalized EI would degenerate to
+  // greedy mean-maximization (the reference normalizes in ParameterManager
+  // before its GP too)
+  double mean = 0, var = 0;
+  for (double v : y_) mean += v;
+  mean /= y_.size();
+  for (double v : y_) var += (v - mean) * (v - mean);
+  double sd = y_.size() > 1 ? std::sqrt(var / (y_.size() - 1)) : 1.0;
+  if (sd < 1e-12) sd = 1.0;
+  std::vector<double> yn(y_.size());
+  for (size_t i = 0; i < y_.size(); ++i) yn[i] = (y_[i] - mean) / sd;
+  ynorm_ = yn;
+  gp_.Fit(X_, yn);
+}
+
+static double NormCdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+static double NormPdf(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
+std::vector<double> BayesianOptimizer::NextSample() {
+  std::uniform_real_distribution<double> u(0.0, 1.0);
+  if (X_.size() < 3) {  // bootstrap with random exploration
+    std::vector<double> x(dims_);
+    for (auto& v : x) v = u(rng_);
+    return x;
+  }
+  double best = *std::max_element(ynorm_.begin(), ynorm_.end());
+  std::vector<double> argmax(dims_, 0.5);
+  double best_ei = -1;
+  for (int c = 0; c < 256; ++c) {  // EI over random candidates
+    std::vector<double> x(dims_);
+    for (auto& v : x) v = u(rng_);
+    double m, s;
+    gp_.Predict(x, &m, &s);
+    double z = (m - best - 0.01) / s;
+    double ei = (m - best - 0.01) * NormCdf(z) + s * NormPdf(z);
+    if (ei > best_ei) {
+      best_ei = ei;
+      argmax = x;
+    }
+  }
+  return argmax;
+}
+
+// ----------------------------------------------------------- ParameterManager
+static const double kMinThreshMB = 1, kMaxThreshMB = 256;
+static const double kMinCycleMs = 1, kMaxCycleMs = 25;
+
+ParameterManager::ParameterManager(int64_t initial_threshold,
+                                   double initial_cycle_ms, uint64_t seed)
+    : threshold_(initial_threshold),
+      cycle_ms_(initial_cycle_ms),
+      opt_(2, seed),
+      best_threshold_(initial_threshold),
+      best_cycle_ms_(initial_cycle_ms) {}
+
+std::vector<double> ParameterManager::Encode() const {
+  double tmb = threshold_ / (1024.0 * 1024.0);
+  double x0 = (std::log2(tmb) - std::log2(kMinThreshMB)) /
+              (std::log2(kMaxThreshMB) - std::log2(kMinThreshMB));
+  double x1 = (cycle_ms_ - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs);
+  return {std::clamp(x0, 0.0, 1.0), std::clamp(x1, 0.0, 1.0)};
+}
+
+void ParameterManager::Decode(const std::vector<double>& x) {
+  double lt = std::log2(kMinThreshMB) +
+              x[0] * (std::log2(kMaxThreshMB) - std::log2(kMinThreshMB));
+  threshold_ = static_cast<int64_t>(std::pow(2.0, lt) * 1024 * 1024);
+  cycle_ms_ = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
+}
+
+bool ParameterManager::Update(int64_t bytes, double seconds) {
+  if (!enabled_) return false;
+  acc_bytes_ += bytes;
+  acc_seconds_ += seconds;
+  if (++steps_ < steps_per_sample_) return false;
+  double score = acc_seconds_ > 0 ? acc_bytes_ / acc_seconds_ : 0;
+  acc_bytes_ = 0;
+  acc_seconds_ = 0;
+  steps_ = 0;
+  if (score > best_score_) {
+    best_score_ = score;
+    best_threshold_ = threshold_;
+    best_cycle_ms_ = cycle_ms_;
+  }
+  opt_.AddSample(Encode(), score);
+  if (++samples_ >= max_samples_) {  // settle on the best seen
+    threshold_ = best_threshold_;
+    cycle_ms_ = best_cycle_ms_;
+    enabled_ = false;
+    return true;
+  }
+  Decode(opt_.NextSample());
+  return true;
+}
+
+}  // namespace hvdtpu

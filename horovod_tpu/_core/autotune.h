@@ -1,0 +1,92 @@
+// Runtime parameter autotuning: Gaussian-process Bayesian optimization of
+// fusion threshold and cycle time, scored by observed throughput.
+//
+// TPU-native rebuild of horovod/common/parameter_manager.{h,cc} +
+// optim/bayesian_optimization.{h,cc} + optim/gaussian_process.{h,cc}:
+// the reference fits a GP (Eigen + L-BFGS) over (fusion_threshold,
+// cycle_time) with bytes/sec as score and picks the next sample by expected
+// improvement. Here the GP uses an RBF kernel with hand-rolled Cholesky
+// (no Eigen in-image) and EI is maximized over a random candidate set —
+// the same algorithm at the fidelity this 2-D, ~tens-of-samples problem
+// needs.
+#pragma once
+
+#include <cstdint>
+#include <random>
+#include <vector>
+
+namespace hvdtpu {
+
+// Minimal dense GP regression with RBF kernel on normalized inputs.
+class GaussianProcess {
+ public:
+  GaussianProcess(double length_scale = 0.3, double noise = 1e-4)
+      : ls_(length_scale), noise_(noise) {}
+  void Fit(const std::vector<std::vector<double>>& X,
+           const std::vector<double>& y);
+  // predictive mean + stddev at x
+  void Predict(const std::vector<double>& x, double* mean, double* std) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+  double ls_, noise_;
+  std::vector<std::vector<double>> X_;
+  std::vector<double> alpha_;       // K^-1 y
+  std::vector<std::vector<double>> L_;  // Cholesky factor of K
+  double ymean_ = 0;
+};
+
+// Expected-improvement Bayesian optimizer over a unit hypercube.
+class BayesianOptimizer {
+ public:
+  explicit BayesianOptimizer(int dims, uint64_t seed = 0)
+      : dims_(dims), rng_(seed) {}
+  void AddSample(const std::vector<double>& x, double y);
+  std::vector<double> NextSample();
+
+ private:
+  int dims_;
+  std::mt19937_64 rng_;
+  std::vector<std::vector<double>> X_;
+  std::vector<double> y_;
+  std::vector<double> ynorm_;  // standardized scores the GP is fit on
+  GaussianProcess gp_;
+};
+
+// ParameterManager: drives (fusion_threshold_mb, cycle_time_ms) from scores.
+// Mirrors parameter_manager.h:88 Update(): accumulate bytes+time per step,
+// re-tune every `steps_per_sample` steps.
+class ParameterManager {
+ public:
+  ParameterManager(int64_t initial_threshold, double initial_cycle_ms,
+                   uint64_t seed = 0);
+  void SetEnabled(bool e) { enabled_ = e; }
+  bool enabled() const { return enabled_; }
+
+  // record bytes moved in an interval; returns true if params changed
+  bool Update(int64_t bytes, double seconds);
+  int64_t fusion_threshold() const { return threshold_; }
+  double cycle_time_ms() const { return cycle_ms_; }
+  double best_score() const { return best_score_; }
+
+ private:
+  std::vector<double> Encode() const;
+  void Decode(const std::vector<double>& x);
+
+  bool enabled_ = false;
+  int64_t threshold_;
+  double cycle_ms_;
+  BayesianOptimizer opt_;
+  int64_t acc_bytes_ = 0;
+  double acc_seconds_ = 0;
+  int steps_ = 0;
+  int steps_per_sample_ = 10;
+  double best_score_ = 0;
+  int64_t best_threshold_;
+  double best_cycle_ms_;
+  int samples_ = 0;
+  int max_samples_ = 40;  // then settle on best (parameter_manager stops too)
+};
+
+}  // namespace hvdtpu

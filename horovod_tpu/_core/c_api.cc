@@ -1,0 +1,219 @@
+// C ABI for the native engine core, consumed from Python via ctypes.
+//
+// TPU-native analogue of the reference's C API surface (operations.cc:642-934
+// horovod_init/.../EnqueueTensorAllreduce) reshaped for the split control
+// plane (C++) / data plane (XLA): Python submits tensor *metadata*, ticks the
+// controller, receives wire-encoded ResponseLists, executes the fused XLA
+// collective, and reports completion + throughput scores back for autotuning.
+#include <chrono>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "autotune.h"
+#include "common.h"
+#include "controller.h"
+#include "timeline.h"
+#include "wire.h"
+
+using namespace hvdtpu;
+
+namespace {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct EngineCore {
+  std::unique_ptr<Controller> controller;
+  std::unique_ptr<TimelineWriter> timeline;
+  std::unique_ptr<ParameterManager> params;
+  // last tick's encoded payloads, kept alive until the next call
+  std::string tick_buf;
+  std::mutex buf_mu;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, std::unique_ptr<EngineCore>> g_engines;
+int64_t g_next = 1;
+
+EngineCore* Get(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_engines.find(h);
+  return it == g_engines.end() ? nullptr : it->second.get();
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns engine handle
+int64_t hvd_core_create(int32_t world, int64_t fusion_threshold_bytes,
+                        double stall_warning_s, double stall_shutdown_s,
+                        int64_t cache_capacity, int32_t fusion_enabled,
+                        const char* timeline_path, int32_t autotune,
+                        double cycle_time_ms, int32_t local_only,
+                        int32_t self_rank) {
+  auto core = std::make_unique<EngineCore>();
+  ControllerOptions opts;
+  opts.world = world;
+  opts.fusion_threshold_bytes = fusion_threshold_bytes;
+  opts.stall_warning_s = stall_warning_s;
+  opts.stall_shutdown_s = stall_shutdown_s;
+  opts.cache_capacity = static_cast<size_t>(cache_capacity);
+  opts.fusion_enabled = fusion_enabled != 0;
+  opts.local_only = local_only != 0;
+  opts.self_rank = self_rank;
+  core->controller = std::make_unique<Controller>(opts);
+  core->timeline = std::make_unique<TimelineWriter>(
+      timeline_path ? timeline_path : "");
+  core->params = std::make_unique<ParameterManager>(
+      fusion_threshold_bytes, cycle_time_ms);
+  core->params->SetEnabled(autotune != 0);
+  std::lock_guard<std::mutex> l(g_mu);
+  int64_t h = g_next++;
+  g_engines[h] = std::move(core);
+  return h;
+}
+
+void hvd_core_destroy(int64_t eng) {
+  std::lock_guard<std::mutex> l(g_mu);
+  g_engines.erase(eng);
+}
+
+// submit one named tensor; returns handle >= 0, -1 duplicate, -2 shutdown,
+// -3 bad engine
+int64_t hvd_core_submit(int64_t eng, const char* name, int32_t rank,
+                        int32_t req_type, int32_t dtype, int32_t ndim,
+                        const int64_t* dims, int32_t root_rank,
+                        int32_t average, double prescale, double postscale) {
+  EngineCore* c = Get(eng);
+  if (!c) return -3;
+  PendingEntry e;
+  e.name = name;
+  e.rank = rank;
+  e.type = static_cast<RequestType>(req_type);
+  e.dtype = static_cast<DType>(dtype);
+  e.shape.assign(dims, dims + ndim);
+  e.root_rank = root_rank;
+  e.average = average != 0;
+  e.prescale = prescale;
+  e.postscale = postscale;
+  e.enqueue_us = NowUs();
+  int64_t h = c->controller->Submit(e);
+  if (h >= 0) c->timeline->NegotiateStart(e.name, rank, e.enqueue_us);
+  return h;
+}
+
+int64_t hvd_core_join(int64_t eng, int32_t rank) {
+  EngineCore* c = Get(eng);
+  if (!c) return -3;
+  return c->controller->Join(rank);
+}
+
+// One negotiation tick. Returns byte length of the encoded payload (0 = no
+// work) and sets *data to an internal buffer valid until the next tick call.
+// Payload layout: wire ResponseList, then for each response
+// u32 n_handle_pairs, (i32 rank, i64 handle)*, then u32 n_released_join,
+// i64*, i32 last_joined, u32 n_stall_warnings, str*, u8 stall_shutdown.
+int64_t hvd_core_tick(int64_t eng, const char** data) {
+  EngineCore* c = Get(eng);
+  if (!c) return -3;
+  TickResult r = c->controller->Tick(NowUs());
+  if (r.responses.empty() && r.join_handles_released.empty() &&
+      r.stall_warnings.empty() && !r.stall_shutdown)
+    return 0;
+  wire::Writer w;
+  w.out = wire::EncodeResponseList(r.responses);
+  for (auto& hs : r.handles) {
+    w.u32(static_cast<uint32_t>(hs.size()));
+    for (auto& p : hs) {
+      w.i32(p.first);
+      w.i64(p.second);
+    }
+  }
+  w.u32(static_cast<uint32_t>(r.join_handles_released.size()));
+  for (auto h : r.join_handles_released) w.i64(h);
+  w.i32(r.last_joined);
+  w.u32(static_cast<uint32_t>(r.stall_warnings.size()));
+  for (auto& s : r.stall_warnings) w.str(s);
+  w.u8(r.stall_shutdown ? 1 : 0);
+  std::lock_guard<std::mutex> l(c->buf_mu);
+  c->tick_buf = std::move(w.out);
+  *data = c->tick_buf.data();
+  return static_cast<int64_t>(c->tick_buf.size());
+}
+
+// shutdown: returns orphan handles to fail (same buffer protocol)
+int64_t hvd_core_shutdown(int64_t eng, const char** data) {
+  EngineCore* c = Get(eng);
+  if (!c) return -3;
+  std::vector<int64_t> orphans;
+  c->controller->Shutdown(&orphans);
+  c->timeline->Close();
+  wire::Writer w;
+  w.u32(static_cast<uint32_t>(orphans.size()));
+  for (auto h : orphans) w.i64(h);
+  std::lock_guard<std::mutex> l(c->buf_mu);
+  c->tick_buf = std::move(w.out);
+  *data = c->tick_buf.data();
+  return static_cast<int64_t>(c->tick_buf.size());
+}
+
+// timeline hooks for the execution phase (fired from Python around the XLA
+// call; ts recorded here so host clock is consistent)
+void hvd_core_timeline_op_start(int64_t eng, const char* tensor,
+                                const char* op) {
+  EngineCore* c = Get(eng);
+  if (c) c->timeline->OpStart(tensor, op, NowUs());
+}
+void hvd_core_timeline_activity(int64_t eng, const char* tensor,
+                                const char* activity) {
+  EngineCore* c = Get(eng);
+  if (c) c->timeline->Activity(tensor, activity, NowUs());
+}
+void hvd_core_timeline_op_end(int64_t eng, const char* tensor) {
+  EngineCore* c = Get(eng);
+  if (c) c->timeline->OpEnd(tensor, NowUs());
+}
+void hvd_core_timeline_cycle(int64_t eng) {
+  EngineCore* c = Get(eng);
+  if (c) c->timeline->CycleMarker(NowUs());
+}
+
+// autotune: report an execution interval; returns 1 if params changed
+int32_t hvd_core_report_score(int64_t eng, int64_t bytes, double seconds) {
+  EngineCore* c = Get(eng);
+  if (!c) return 0;
+  bool changed = c->params->Update(bytes, seconds);
+  if (changed)
+    c->controller->set_fusion_threshold(c->params->fusion_threshold());
+  return changed ? 1 : 0;
+}
+
+int64_t hvd_core_fusion_threshold(int64_t eng) {
+  EngineCore* c = Get(eng);
+  return c ? c->controller->fusion_threshold() : -1;
+}
+
+double hvd_core_cycle_time_ms(int64_t eng) {
+  EngineCore* c = Get(eng);
+  return c ? c->params->cycle_time_ms() : -1.0;
+}
+
+uint64_t hvd_core_cache_hits(int64_t eng) {
+  EngineCore* c = Get(eng);
+  return c ? c->controller->cache_hits() : 0;
+}
+
+uint64_t hvd_core_cache_misses(int64_t eng) {
+  EngineCore* c = Get(eng);
+  return c ? c->controller->cache_misses() : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+// Shared value types for the native engine core.
+//
+// TPU-native rebuild of horovod/common/common.h (Status, TensorShape,
+// TensorTableEntry) and message.h (RequestType/ResponseType). The data plane
+// is XLA, so tensors never cross this boundary — only metadata does: the
+// engine negotiates, validates, fuses and schedules; Python executes the
+// fused XLA collective it is handed.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class RequestType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  JOIN = 3,
+  ADASUM = 4,
+  ALLTOALL = 5,
+};
+
+enum class ResponseType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  JOIN = 3,
+  ADASUM = 4,
+  ALLTOALL = 5,
+  ERROR = 6,
+};
+
+// dtype codes shared with the Python side (runtime/native.py)
+enum class DType : int32_t {
+  F16 = 0, BF16 = 1, F32 = 2, F64 = 3,
+  I8 = 4, I16 = 5, I32 = 6, I64 = 7,
+  U8 = 8, U16 = 9, U32 = 10, U64 = 11,
+  BOOL = 12,
+};
+
+inline int64_t DTypeSize(DType d) {
+  switch (d) {
+    case DType::I8: case DType::U8: case DType::BOOL: return 1;
+    case DType::F16: case DType::BF16: case DType::I16: case DType::U16:
+      return 2;
+    case DType::F32: case DType::I32: case DType::U32: return 4;
+    default: return 8;
+  }
+}
+
+// One rank's pending named-tensor request (metadata only).
+struct PendingEntry {
+  std::string name;
+  int32_t rank = 0;
+  RequestType type = RequestType::ALLREDUCE;
+  DType dtype = DType::F32;
+  std::vector<int64_t> shape;
+  int32_t root_rank = -1;
+  bool average = false;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int64_t handle = -1;
+  int64_t enqueue_us = 0;  // monotonic microseconds at submit
+
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  int64_t num_bytes() const { return num_elements() * DTypeSize(dtype); }
+};
+
+// Coordinator decision: one (possibly fused) operation, or an error.
+struct Response {
+  ResponseType type = ResponseType::ERROR;
+  std::vector<std::string> names;
+  std::string error_message;
+  bool average = false;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t root_rank = -1;
+};
+
+}  // namespace hvdtpu

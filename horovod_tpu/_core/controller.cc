@@ -1,0 +1,312 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <sstream>
+
+namespace hvdtpu {
+
+// ------------------------------------------------------------ ResponseCache
+bool ResponseCache::Lookup(const std::string& sig) {
+  auto it = index_.find(sig);
+  if (it == index_.end()) {
+    ++misses;
+    return false;
+  }
+  ++hits;
+  return true;
+}
+
+void ResponseCache::Insert(const std::string& sig) {
+  if (index_.count(sig)) return;
+  if (lru_.size() >= capacity_ && !lru_.empty()) {
+    index_.erase(lru_.front());
+    lru_.pop_front();
+  }
+  index_[sig] = 1;
+  lru_.push_back(sig);
+}
+
+// ---------------------------------------------------------------- Controller
+int64_t Controller::Submit(const PendingEntry& e) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (shutdown_) return -2;
+  auto& st = table_[e.name];
+  if (st.by_rank.count(e.rank)) return -1;  // DUPLICATE_NAME_ERROR
+  if (st.by_rank.empty()) {
+    st.first_seen_us = e.enqueue_us;
+    order_.push_back(e.name);
+  }
+  PendingEntry copy = e;
+  copy.handle = next_handle_++;
+  int64_t h = copy.handle;
+  st.by_rank.emplace(e.rank, std::move(copy));
+  return h;
+}
+
+int64_t Controller::Join(int32_t rank) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (shutdown_) return -2;
+  int64_t h = next_handle_++;
+  joined_.insert(rank);
+  join_handles_[rank] = h;
+  last_joined_ = rank;
+  return h;
+}
+
+void Controller::Shutdown(std::vector<int64_t>* orphan_handles) {
+  std::lock_guard<std::mutex> l(mu_);
+  shutdown_ = true;
+  if (orphan_handles) {
+    for (auto& kv : table_)
+      for (auto& re : kv.second.by_rank)
+        orphan_handles->push_back(re.second.handle);
+    for (auto& jh : join_handles_) orphan_handles->push_back(jh.second);
+  }
+  table_.clear();
+  order_.clear();
+  join_handles_.clear();
+  joined_.clear();
+}
+
+std::string Controller::Validate(const std::string& name,
+                                 const NameState& st) const {
+  const PendingEntry* e0 = nullptr;
+  for (auto& kv : st.by_rank) { e0 = &kv.second; break; }
+  std::ostringstream err;
+  for (auto& kv : st.by_rank) {
+    const auto& e = kv.second;
+    if (e.type != e0->type) {
+      err << "Mismatched collective operations for tensor '" << name << "'";
+      return err.str();
+    }
+    if (e.dtype != e0->dtype) {
+      err << "Mismatched data types for tensor '" << name << "'";
+      return err.str();
+    }
+    if (e.average != e0->average || e.prescale != e0->prescale ||
+        e.postscale != e0->postscale) {
+      err << "Mismatched reduction op/scale factors for tensor '" << name
+          << "'";
+      return err.str();
+    }
+  }
+  bool shapes_equal_required =
+      e0->type == RequestType::ALLREDUCE || e0->type == RequestType::ADASUM ||
+      e0->type == RequestType::BROADCAST || e0->type == RequestType::ALLTOALL;
+  if (shapes_equal_required) {
+    for (auto& kv : st.by_rank) {
+      if (kv.second.shape != e0->shape) {
+        err << "Mismatched tensor shapes for '" << name << "': rank "
+            << kv.first;
+        return err.str();
+      }
+    }
+  }
+  if (e0->type == RequestType::ALLGATHER) {
+    if (opts_.local_only && opts_.world > 1) {
+      // per-rank dim0 sizes live on other processes; requires the
+      // cross-process control plane (size negotiation over DCN)
+      return "Allgather is not yet supported in multiprocess mode "
+             "(cross-process size negotiation not implemented).";
+    }
+    for (auto& kv : st.by_rank) {
+      const auto& s = kv.second.shape;
+      if (s.empty())
+        return "Allgather of scalar tensor '" + name + "' is not supported.";
+      if (s.size() != e0->shape.size() ||
+          !std::equal(s.begin() + 1, s.end(), e0->shape.begin() + 1)) {
+        err << "Mismatched allgather tensor shapes beyond first dimension "
+               "for '" << name << "'";
+        return err.str();
+      }
+    }
+  }
+  if (e0->type == RequestType::ADASUM) {
+    if (opts_.world & (opts_.world - 1)) {
+      err << "Adasum requires a power-of-2 number of ranks; got "
+          << opts_.world << ".";
+      return err.str();
+    }
+  }
+  if (e0->type == RequestType::ALLTOALL) {
+    int64_t d0 = e0->shape.empty() ? 0 : e0->shape[0];
+    if (e0->shape.empty() || d0 % opts_.world != 0) {
+      err << "Alltoall tensor '" << name << "' first dimension (" << d0
+          << ") must be divisible by world size " << opts_.world << ".";
+      return err.str();
+    }
+  }
+  if (e0->type == RequestType::BROADCAST) {
+    for (auto& kv : st.by_rank) {
+      if (kv.second.root_rank != e0->root_rank) {
+        err << "Mismatched root ranks for broadcast '" << name << "'";
+        return err.str();
+      }
+    }
+    if (e0->root_rank < 0 || e0->root_rank >= opts_.world) {
+      err << "Invalid root rank " << e0->root_rank << " for broadcast '"
+          << name << "' (world size " << opts_.world << ").";
+      return err.str();
+    }
+  }
+  if (!joined_.empty() && (e0->type == RequestType::ALLGATHER ||
+                           e0->type == RequestType::BROADCAST ||
+                           e0->type == RequestType::ALLTOALL)) {
+    // parity: controller.cc:434-437, 510-513
+    err << (e0->type == RequestType::ALLGATHER
+                ? "ALLGATHER"
+                : e0->type == RequestType::BROADCAST ? "BROADCAST"
+                                                     : "ALLTOALL")
+        << " is not supported while a rank has joined.";
+    return err.str();
+  }
+  return "";
+}
+
+std::string Controller::FusionSig(const PendingEntry& e) const {
+  std::ostringstream s;
+  s << static_cast<int>(e.type) << '|' << static_cast<int>(e.dtype) << '|'
+    << (e.average ? 1 : 0) << '|' << e.prescale << '|' << e.postscale << '|'
+    << e.root_rank;
+  return s.str();
+}
+
+TickResult Controller::Tick(int64_t now_us) {
+  std::lock_guard<std::mutex> l(mu_);
+  TickResult out;
+  if (shutdown_) return out;
+
+  std::set<int32_t> active;
+  if (opts_.local_only) {
+    if (!joined_.count(opts_.self_rank)) active.insert(opts_.self_rank);
+  } else {
+    for (int32_t r = 0; r < opts_.world; ++r)
+      if (!joined_.count(r)) active.insert(r);
+  }
+
+  // all joined + nothing pending -> release join barrier
+  // (controller.cc:202-256)
+  bool all_joined = opts_.local_only
+                        ? joined_.count(opts_.self_rank) > 0
+                        : static_cast<int32_t>(joined_.size()) == opts_.world;
+  if (!joined_.empty() && all_joined && table_.empty()) {
+    for (auto& jh : join_handles_) out.join_handles_released.push_back(jh.second);
+    out.last_joined = last_joined_;
+    join_handles_.clear();
+    joined_.clear();
+    return out;
+  }
+
+  // readiness scan in first-submission order
+  std::vector<std::string> ready;
+  std::vector<std::string> still_waiting;
+  for (const auto& name : order_) {
+    auto it = table_.find(name);
+    if (it == table_.end()) continue;
+    auto& st = it->second;
+    bool all_in = true;
+    for (int32_t r : active)
+      if (!st.by_rank.count(r)) { all_in = false; break; }
+    if (all_in) {
+      ready.push_back(name);
+    } else {
+      still_waiting.push_back(name);
+      double waited_s = (now_us - st.first_seen_us) / 1e6;
+      if (waited_s > opts_.stall_warning_s && !st.stall_warned) {
+        st.stall_warned = true;
+        out.stall_warnings.push_back(name);
+      }
+      if (opts_.stall_shutdown_s > 0 && waited_s > opts_.stall_shutdown_s)
+        out.stall_shutdown = true;
+    }
+  }
+  if (ready.empty()) {
+    // keep order_ compacted to names still pending
+    order_ = still_waiting;
+    return out;
+  }
+
+  // validate -> single responses (or errors)
+  struct Single {
+    std::string name;
+    PendingEntry e0;
+    int64_t bytes;
+    std::vector<std::pair<int32_t, int64_t>> rank_handles;
+    bool used = false;
+    std::string sig;
+  };
+  std::vector<Single> singles;
+  for (const auto& name : ready) {
+    auto it = table_.find(name);
+    auto& st = it->second;
+    std::string err = Validate(name, st);
+    std::vector<std::pair<int32_t, int64_t>> rhs;
+    for (auto& kv : st.by_rank) rhs.push_back({kv.first, kv.second.handle});
+    std::sort(rhs.begin(), rhs.end());
+    if (!err.empty()) {
+      Response r;
+      r.type = ResponseType::ERROR;
+      r.names = {name};
+      r.error_message = err;
+      out.responses.push_back(std::move(r));
+      out.handles.push_back(std::move(rhs));
+      table_.erase(it);
+      continue;
+    }
+    Single s;
+    s.name = name;
+    // lowest-rank entry is canonical (all validated equal)
+    s.e0 = st.by_rank.begin()->second;
+    for (auto& kv : st.by_rank)
+      if (kv.first < s.e0.rank) s.e0 = kv.second;
+    s.bytes = s.e0.num_bytes();
+    s.rank_handles = std::move(rhs);
+    s.sig = FusionSig(s.e0);
+    singles.push_back(std::move(s));
+    table_.erase(it);
+  }
+  order_ = still_waiting;
+
+  // fusion with lookahead (FuseResponses, controller.cc:626-750)
+  for (size_t i = 0; i < singles.size(); ++i) {
+    if (singles[i].used) continue;
+    singles[i].used = true;
+    std::vector<size_t> bucket{i};
+    int64_t total = singles[i].bytes;
+    RequestType t = singles[i].e0.type;
+    bool fusable = opts_.fusion_enabled &&
+                   (t == RequestType::ALLREDUCE || t == RequestType::ADASUM ||
+                    t == RequestType::ALLGATHER);
+    if (fusable) {
+      for (size_t j = i + 1; j < singles.size(); ++j) {
+        if (singles[j].used) continue;
+        if (singles[j].sig == singles[i].sig &&
+            total + singles[j].bytes <= opts_.fusion_threshold_bytes) {
+          singles[j].used = true;
+          bucket.push_back(j);
+          total += singles[j].bytes;
+        }
+      }
+    }
+    Response r;
+    r.type = static_cast<ResponseType>(static_cast<int32_t>(t));
+    r.average = singles[i].e0.average;
+    r.prescale = singles[i].e0.prescale;
+    r.postscale = singles[i].e0.postscale;
+    r.root_rank = singles[i].e0.root_rank;
+    std::vector<std::pair<int32_t, int64_t>> hs;
+    for (size_t k : bucket) {
+      r.names.push_back(singles[k].name);
+      for (auto& rh : singles[k].rank_handles) hs.push_back(rh);
+    }
+    // cache the fused signature (ResponseCache fast-path bookkeeping)
+    std::string fused_sig = singles[i].sig;
+    for (size_t k : bucket) fused_sig += '|' + singles[k].name;
+    if (!cache_.Lookup(fused_sig)) cache_.Insert(fused_sig);
+    out.responses.push_back(std::move(r));
+    out.handles.push_back(std::move(hs));
+  }
+  return out;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,113 @@
+// Negotiation controller: decides per tick which named tensors are ready on
+// every active rank, validates cross-rank agreement, fuses ready tensors into
+// byte-bounded buckets, tracks join state and stalls.
+//
+// TPU-native rebuild of horovod/common/controller.{h,cc}
+// (ComputeResponseList :55, ConstructResponse :358, FuseResponses :626,
+// IncrementTensorCount :778), tensor_queue.{h,cc} (duplicate detection),
+// stall_inspector.{h,cc} and response_cache.{h,cc}. The MPI gather/bcast
+// legs are absent: in-process ranks share this table directly; cross-process
+// agreement is by SPMD program order (future: KV control plane exchanging
+// wire-encoded RequestLists).
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// LRU cache of negotiated response signatures: lets steady-state training
+// skip validation/fusion planning (fast path of controller.cc:171-185).
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
+  // membership test; counts hits/misses (the negotiated-response payloads are
+  // deterministic from the signature, so only presence is stored — bounded by
+  // capacity)
+  bool Lookup(const std::string& sig);
+  void Insert(const std::string& sig);
+  size_t size() const { return index_.size(); }
+  uint64_t hits = 0, misses = 0;
+
+ private:
+  size_t capacity_;
+  std::unordered_map<std::string, int64_t> index_;
+  std::deque<std::string> lru_;
+};
+
+struct ControllerOptions {
+  int32_t world = 1;
+  int64_t fusion_threshold_bytes = 64ll * 1024 * 1024;  // operations.cc:404
+  double stall_warning_s = 60.0;   // stall_inspector.h:75
+  double stall_shutdown_s = 0.0;   // stall_inspector.h:80
+  size_t cache_capacity = 1024;    // HOROVOD_CACHE_CAPACITY
+  bool fusion_enabled = true;
+  // multiprocess mode: only self_rank submits to this process's table
+  // (readiness = local rank only; cross-process agreement is SPMD program
+  // order until the KV control plane lands). world stays the GLOBAL size for
+  // validation (root range, adasum power-of-2, alltoall divisibility).
+  bool local_only = false;
+  int32_t self_rank = 0;
+};
+
+struct TickResult {
+  std::vector<Response> responses;
+  // per-response per-rank entry handles, ordered like response.names:
+  // handles[resp_idx] = flat list of (rank, handle) pairs
+  std::vector<std::vector<std::pair<int32_t, int64_t>>> handles;
+  std::vector<int64_t> join_handles_released;  // handles to complete
+  int32_t last_joined = -1;
+  std::vector<std::string> stall_warnings;
+  bool stall_shutdown = false;
+};
+
+class Controller {
+ public:
+  explicit Controller(const ControllerOptions& opts) : opts_(opts) {}
+
+  // Returns handle (>=0), or -1 duplicate-name, -2 after shutdown.
+  int64_t Submit(const PendingEntry& e);
+  int64_t Join(int32_t rank);
+  void Shutdown(std::vector<int64_t>* orphan_handles);
+
+  // One negotiation tick (RunLoopOnce analogue). now_us: monotonic clock.
+  TickResult Tick(int64_t now_us);
+
+  // stats for introspection / autotune
+  uint64_t cache_hits() const { return cache_.hits; }
+  uint64_t cache_misses() const { return cache_.misses; }
+  void set_fusion_threshold(int64_t b) { std::lock_guard<std::mutex> l(mu_);
+                                         opts_.fusion_threshold_bytes = b; }
+  int64_t fusion_threshold() const { return opts_.fusion_threshold_bytes; }
+
+ private:
+  struct NameState {
+    std::unordered_map<int32_t, PendingEntry> by_rank;
+    int64_t first_seen_us = 0;
+    bool stall_warned = false;
+  };
+
+  // validation (ConstructResponse); returns empty on OK else error message
+  std::string Validate(const std::string& name, const NameState& st) const;
+  std::string FusionSig(const PendingEntry& e) const;
+
+  ControllerOptions opts_;
+  mutable std::mutex mu_;
+  bool shutdown_ = false;
+  int64_t next_handle_ = 0;
+  std::vector<std::string> order_;  // first-submission order
+  std::unordered_map<std::string, NameState> table_;
+  std::set<int32_t> joined_;
+  std::unordered_map<int32_t, int64_t> join_handles_;
+  int32_t last_joined_ = -1;
+  ResponseCache cache_{1024};
+};
+
+}  // namespace hvdtpu

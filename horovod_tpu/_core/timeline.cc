@@ -1,0 +1,134 @@
+#include "timeline.h"
+
+#include <sstream>
+
+namespace hvdtpu {
+
+static std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') { out.push_back('\\'); out.push_back(c); }
+    else if (c == '\n') out += "\\n";
+    else out.push_back(c);
+  }
+  return out;
+}
+
+TimelineWriter::TimelineWriter(const std::string& path) {
+  if (path.empty()) return;
+  f_.open(path);
+  if (!f_.is_open()) return;
+  enabled_ = true;
+  f_ << "[\n";
+  thread_ = std::thread(&TimelineWriter::Loop, this);
+}
+
+TimelineWriter::~TimelineWriter() { Close(); }
+
+void TimelineWriter::Emit(const std::string& json) {
+  if (!enabled_) return;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    q_.push_back({json});
+  }
+  cv_.notify_one();
+}
+
+int32_t TimelineWriter::Tid(const std::string& tensor) {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = tids_.find(tensor);
+  if (it != tids_.end()) return it->second;
+  int32_t t = next_tid_++;
+  tids_[tensor] = t;
+  std::ostringstream os;
+  os << "{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":0,\"tid\":" << t
+     << ",\"args\":{\"name\":\"" << JsonEscape(tensor) << "\"}}";
+  q_.push_back({os.str()});
+  cv_.notify_one();
+  return t;
+}
+
+void TimelineWriter::NegotiateStart(const std::string& tensor, int32_t rank,
+                                    int64_t ts_us) {
+  if (!enabled_) return;
+  int32_t tid = Tid(tensor);
+  std::ostringstream os;
+  os << "{\"name\":\"NEGOTIATE_" << JsonEscape(tensor)
+     << "\",\"ph\":\"B\",\"pid\":0,\"tid\":" << tid << ",\"ts\":" << ts_us
+     << ",\"args\":{\"rank\":" << rank << "}}";
+  Emit(os.str());
+}
+
+void TimelineWriter::OpStart(const std::string& tensor, const std::string& op,
+                             int64_t ts_us) {
+  if (!enabled_) return;
+  int32_t tid = Tid(tensor);
+  std::ostringstream os;
+  os << "{\"name\":\"NEGOTIATE_" << JsonEscape(tensor)
+     << "\",\"ph\":\"E\",\"pid\":0,\"tid\":" << tid << ",\"ts\":" << ts_us
+     << "}";
+  Emit(os.str());
+  std::ostringstream os2;
+  os2 << "{\"name\":\"" << JsonEscape(op) << "\",\"ph\":\"B\",\"pid\":0,"
+      << "\"tid\":" << tid << ",\"ts\":" << ts_us << "}";
+  Emit(os2.str());
+}
+
+void TimelineWriter::Activity(const std::string& tensor,
+                              const std::string& activity, int64_t ts_us) {
+  if (!enabled_) return;
+  int32_t tid = Tid(tensor);
+  std::ostringstream os;
+  os << "{\"name\":\"" << JsonEscape(activity)
+     << "\",\"ph\":\"i\",\"pid\":0,\"tid\":" << tid << ",\"ts\":" << ts_us
+     << ",\"s\":\"t\"}";
+  Emit(os.str());
+}
+
+void TimelineWriter::OpEnd(const std::string& tensor, int64_t ts_us) {
+  if (!enabled_) return;
+  int32_t tid = Tid(tensor);
+  std::ostringstream os;
+  os << "{\"ph\":\"E\",\"pid\":0,\"tid\":" << tid << ",\"ts\":" << ts_us
+     << "}";
+  Emit(os.str());
+}
+
+void TimelineWriter::CycleMarker(int64_t ts_us) {
+  if (!enabled_) return;
+  std::ostringstream os;
+  os << "{\"name\":\"CYCLE\",\"ph\":\"i\",\"pid\":0,\"tid\":0,\"ts\":" << ts_us
+     << ",\"s\":\"g\"}";
+  Emit(os.str());
+}
+
+void TimelineWriter::Loop() {
+  std::unique_lock<std::mutex> l(mu_);
+  while (true) {
+    cv_.wait(l, [&] { return done_ || !q_.empty(); });
+    while (!q_.empty()) {
+      Event e = std::move(q_.front());
+      q_.pop_front();
+      l.unlock();
+      f_ << e.json << ",\n";
+      l.lock();
+    }
+    if (done_) return;
+    f_.flush();
+  }
+}
+
+void TimelineWriter::Close() {
+  if (!enabled_) return;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    done_ = true;
+  }
+  cv_.notify_one();
+  if (thread_.joinable()) thread_.join();
+  f_ << "{\"name\":\"end\",\"ph\":\"M\",\"pid\":0}\n]\n";
+  f_.close();
+  enabled_ = false;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,54 @@
+// Chrome-tracing timeline writer with a dedicated writer thread.
+//
+// TPU-native rebuild of horovod/common/timeline.{h,cc}: per-tensor NEGOTIATE
+// spans, top-level op spans and named activities, buffered through a queue to
+// a writer thread (timeline.h:47-75 uses a boost lock-free SPSC; a mutexed
+// deque suffices at engine-tick rates). Output is Chrome tracing JSON loadable
+// in chrome://tracing / Perfetto.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvdtpu {
+
+class TimelineWriter {
+ public:
+  explicit TimelineWriter(const std::string& path);
+  ~TimelineWriter();
+
+  void NegotiateStart(const std::string& tensor, int32_t rank, int64_t ts_us);
+  void OpStart(const std::string& tensor, const std::string& op, int64_t ts_us);
+  void Activity(const std::string& tensor, const std::string& activity,
+                int64_t ts_us);
+  void OpEnd(const std::string& tensor, int64_t ts_us);
+  void CycleMarker(int64_t ts_us);
+  void Close();
+  bool enabled() const { return enabled_; }
+
+ private:
+  struct Event {
+    std::string json;
+  };
+  void Emit(const std::string& json);
+  int32_t Tid(const std::string& tensor);
+  void Loop();
+
+  bool enabled_ = false;
+  std::ofstream f_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> q_;
+  bool done_ = false;
+  std::thread thread_;
+  std::unordered_map<std::string, int32_t> tids_;
+  int32_t next_tid_ = 1;
+};
+
+}  // namespace hvdtpu

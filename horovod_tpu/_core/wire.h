@@ -1,0 +1,144 @@
+// Compact binary wire format for engine messages.
+//
+// TPU-native rebuild of horovod/common/wire/message.fbs + message.{h,cc}:
+// the reference serializes Request/Response lists with FlatBuffers for the
+// MPI/Gloo control plane; here a little-endian length-prefixed encoding is
+// used for (a) returning negotiated ResponseLists across the C/Python
+// boundary and (b) the cross-process control plane over the launcher's KV
+// service. Layout (all integers little-endian):
+//
+//   ResponseList := u32 count, Response*
+//   Response     := i32 type, u32 nnames, (u32 len, bytes)* names,
+//                   u32 errlen, bytes err, u8 average,
+//                   f64 prescale, f64 postscale, i32 root_rank
+//   RequestList  := u32 count, Request*
+//   Request      := i32 rank, i32 type, u32 namelen, bytes name, i32 dtype,
+//                   u32 ndim, i64* dims, i32 root_rank, u8 average,
+//                   f64 prescale, f64 postscale
+#pragma once
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+namespace wire {
+
+class Writer {
+ public:
+  std::string out;
+  void u8(uint8_t v) { out.push_back(static_cast<char>(v)); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    out.append(s);
+  }
+
+ private:
+  void raw(const void* p, size_t n) {
+    out.append(reinterpret_cast<const char*>(p), n);
+  }
+};
+
+class Reader {
+ public:
+  Reader(const char* data, size_t len) : p_(data), end_(data + len) {}
+  bool ok() const { return ok_; }
+  uint8_t u8() { uint8_t v = 0; raw(&v, 1); return v; }
+  uint32_t u32() { uint32_t v = 0; raw(&v, 4); return v; }
+  int32_t i32() { int32_t v = 0; raw(&v, 4); return v; }
+  int64_t i64() { int64_t v = 0; raw(&v, 8); return v; }
+  double f64() { double v = 0; raw(&v, 8); return v; }
+  std::string str() {
+    uint32_t n = u32();
+    if (p_ + n > end_) { ok_ = false; return {}; }
+    std::string s(p_, n);
+    p_ += n;
+    return s;
+  }
+
+ private:
+  void raw(void* dst, size_t n) {
+    if (p_ + n > end_) { ok_ = false; std::memset(dst, 0, n); return; }
+    std::memcpy(dst, p_, n);
+    p_ += n;
+  }
+  const char* p_;
+  const char* end_;
+  bool ok_ = true;
+};
+
+inline void EncodeResponse(Writer& w, const Response& r) {
+  w.i32(static_cast<int32_t>(r.type));
+  w.u32(static_cast<uint32_t>(r.names.size()));
+  for (const auto& n : r.names) w.str(n);
+  w.str(r.error_message);
+  w.u8(r.average ? 1 : 0);
+  w.f64(r.prescale);
+  w.f64(r.postscale);
+  w.i32(r.root_rank);
+}
+
+inline std::string EncodeResponseList(const std::vector<Response>& rs) {
+  Writer w;
+  w.u32(static_cast<uint32_t>(rs.size()));
+  for (const auto& r : rs) EncodeResponse(w, r);
+  return w.out;
+}
+
+inline Response DecodeResponse(Reader& rd) {
+  Response r;
+  r.type = static_cast<ResponseType>(rd.i32());
+  uint32_t n = rd.u32();
+  for (uint32_t i = 0; i < n; ++i) r.names.push_back(rd.str());
+  r.error_message = rd.str();
+  r.average = rd.u8() != 0;
+  r.prescale = rd.f64();
+  r.postscale = rd.f64();
+  r.root_rank = rd.i32();
+  return r;
+}
+
+inline std::vector<Response> DecodeResponseList(const char* data, size_t len) {
+  Reader rd(data, len);
+  uint32_t n = rd.u32();
+  std::vector<Response> out;
+  for (uint32_t i = 0; i < n && rd.ok(); ++i) out.push_back(DecodeResponse(rd));
+  return out;
+}
+
+inline void EncodeRequest(Writer& w, const PendingEntry& e) {
+  w.i32(e.rank);
+  w.i32(static_cast<int32_t>(e.type));
+  w.str(e.name);
+  w.i32(static_cast<int32_t>(e.dtype));
+  w.u32(static_cast<uint32_t>(e.shape.size()));
+  for (auto d : e.shape) w.i64(d);
+  w.i32(e.root_rank);
+  w.u8(e.average ? 1 : 0);
+  w.f64(e.prescale);
+  w.f64(e.postscale);
+}
+
+inline PendingEntry DecodeRequest(Reader& rd) {
+  PendingEntry e;
+  e.rank = rd.i32();
+  e.type = static_cast<RequestType>(rd.i32());
+  e.name = rd.str();
+  e.dtype = static_cast<DType>(rd.i32());
+  uint32_t nd = rd.u32();
+  for (uint32_t i = 0; i < nd; ++i) e.shape.push_back(rd.i64());
+  e.root_rank = rd.i32();
+  e.average = rd.u8() != 0;
+  e.prescale = rd.f64();
+  e.postscale = rd.f64();
+  return e;
+}
+
+}  // namespace wire
+}  // namespace hvdtpu

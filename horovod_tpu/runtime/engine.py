@@ -1,51 +1,87 @@
 """Background collective engine: queue → negotiation → fusion → execute.
 
 Reference parity: the background-thread engine in `horovod/common/operations.cc`
-(`BackgroundThreadLoop` :328, `RunLoopOnce` :531, `PerformOperation` :227), the
-negotiation protocol in `controller.cc` (`ComputeResponseList` :55,
-`ConstructResponse` :358, `FuseResponses` :626, `IncrementTensorCount` :778),
-the mutex-protected `TensorQueue` (`tensor_queue.{h,cc}`), and the stall
-inspector (`stall_inspector.{h,cc}`).
+(`BackgroundThreadLoop` :328, `RunLoopOnce` :531, `PerformOperation` :227).
 
-TPU-native shape: ranks enqueue committed device arrays from their own threads
-(cluster mode) or processes; a single engine thread ticks every
-``cycle_time_ms``, decides which named tensors all active (non-joined) ranks
-have submitted, validates dtype/shape agreement exactly like the coordinator
-(ERROR responses on mismatch), fuses ready tensors into ≤ threshold-byte
-buckets preserving submission order with lookahead, and hands each fused
-Response to the XLA executor. Completion fires per-tensor callbacks and marks
-async handles, preserving horovod's out-of-order async semantics.
+Architecture (mirrors the reference's split of C++ engine + framework
+callbacks): the **control plane** — tensor table, readiness negotiation,
+cross-rank validation, fusion planning, response cache, stall inspection,
+timeline, autotune — lives in the native C++ core
+(`horovod_tpu/_core/`, loaded via ctypes; pure-Python fallback in
+`pycontroller.py`). The **data plane** is XLA: this engine thread decodes the
+controller's wire-encoded responses and hands each fused response to the
+executor, which runs ONE compiled collective over the device mesh. Completion
+fires per-tensor callbacks/handles, preserving horovod's async op-by-op
+semantics.
 
 Env knobs (parity with `common.h:61-87` / `operations.cc:388-485`):
   HOROVOD_FUSION_THRESHOLD (bytes, default 64 MB, operations.cc:404)
   HOROVOD_CYCLE_TIME       (ms,   default 5,     operations.cc:412)
+  HOROVOD_CACHE_CAPACITY   (default 1024)
   HOROVOD_STALL_CHECK_TIME_SECONDS (default 60,  stall_inspector.h:75)
   HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (default 0 = never, stall_inspector.h:80)
   HOROVOD_TIMELINE         (path for Chrome-trace output)
+  HOROVOD_AUTOTUNE         (1 = GP/EI tuning of fusion threshold+cycle time)
+  HVD_TPU_NATIVE           (0 = force the pure-Python controller)
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
-from collections import OrderedDict
 from typing import Dict, List, Optional
-
-import numpy as np
 
 from ..exceptions import DuplicateNameError, ShutdownError
 from .executor import Executor
 from .handles import HandleManager
-from .messages import Request, RequestType, Response, ResponseType, TensorTableEntry
+from .messages import RequestType, Response, ResponseType, TensorTableEntry
 
 DEFAULT_FUSION_BYTES = 64 * 1024 * 1024
 DEFAULT_CYCLE_MS = 5.0
+
+logger = logging.getLogger("horovod_tpu")
 
 
 def _env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     return float(v) if v else default
+
+
+def _make_controller(world: int, mode: str, self_rank: int = 0):
+    fusion_threshold = int(_env_float("HOROVOD_FUSION_THRESHOLD",
+                                      DEFAULT_FUSION_BYTES))
+    cycle_ms = _env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_MS)
+    kwargs = dict(
+        world=world,
+        fusion_threshold=fusion_threshold,
+        stall_warning_s=_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+        stall_shutdown_s=_env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+        cache_capacity=int(_env_float("HOROVOD_CACHE_CAPACITY", 1024)),
+        # multiprocess fusion requires the cross-process control plane:
+        # bucket contents must not depend on per-process tick timing
+        fusion_enabled=(mode != "multiprocess"),
+        timeline_path=os.environ.get("HOROVOD_TIMELINE"),
+        autotune=os.environ.get("HOROVOD_AUTOTUNE", "") in ("1", "true"),
+        cycle_time_ms=cycle_ms,
+        # multiprocess: only the local rank submits to this process's table;
+        # readiness must not wait on remote ranks (they negotiate in their own
+        # process; agreement is SPMD program order)
+        local_only=(mode == "multiprocess"),
+        self_rank=self_rank,
+    )
+    try:
+        from .native import NativeController
+
+        return NativeController(**kwargs), True
+    except Exception as exc:  # toolchain-less host or HVD_TPU_NATIVE=0
+        if os.environ.get("HVD_TPU_NATIVE", "1") not in ("0", "false"):
+            logger.warning("native core unavailable (%s); using Python "
+                           "controller", exc)
+        from .pycontroller import PyController
+
+        return PyController(**kwargs), False
 
 
 class Engine:
@@ -57,28 +93,17 @@ class Engine:
         self._mode = state.mode
         self._executor = Executor(state)
         self.handles = HandleManager()
+        self.controller, self.native = _make_controller(
+            state.size, state.mode, state.rank0)
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        # name -> {rank: TensorTableEntry}; insertion order = negotiation order
-        self._table: "OrderedDict[str, Dict[int, TensorTableEntry]]" = OrderedDict()
-        self._first_seen: Dict[str, float] = {}
-        self._joined: set = set()
-        self._join_handles: Dict[int, int] = {}
-        self._last_joined: int = -1
+        # controller handle -> (entry, user_handle)
+        self._pending: Dict[int, TensorTableEntry] = {}
+        self._join_waiters: Dict[int, int] = {}  # ctrl handle -> user handle
         self._shutdown = False
-        self._seq = 0
         self._thread: Optional[threading.Thread] = None
-
-        self.fusion_threshold = int(
-            _env_float("HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_BYTES))
-        self.cycle_time_s = _env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_MS) / 1e3
-        self.stall_warning_s = _env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0)
-        self.stall_shutdown_s = _env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0)
-        self._stall_warned: set = set()
-
-        from ..utils.timeline import Timeline
-        self.timeline = Timeline(os.environ.get("HOROVOD_TIMELINE"))
+        self.cycle_time_s = self.controller.cycle_time_ms() / 1e3
 
     # ------------------------------------------------------------------ API
     def start(self) -> None:
@@ -88,304 +113,158 @@ class Engine:
 
     def shutdown(self) -> None:
         with self._lock:
+            if self._shutdown:
+                return
             self._shutdown = True
             self._wake.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        self.timeline.close()
 
     def enqueue(self, entry: TensorTableEntry) -> int:
-        """Add a named tensor; returns an async handle.
+        """Add a named tensor; returns an async user handle.
 
         Mirrors EnqueueTensorAllreduce/-Allgather/-Broadcast
-        (`operations.cc:783-934`) + TensorQueue::AddToTensorQueue duplicate
-        detection (`tensor_queue.cc`, DUPLICATE_NAME_ERROR `common.h:160`).
-        """
-        handle = self.handles.allocate()
-        entry.handle = handle
+        (`operations.cc:783-934`); duplicate detection in the controller
+        (DUPLICATE_NAME_ERROR `common.h:160`)."""
+        user = self.handles.allocate()
+        entry.handle = user
         with self._lock:
             if self._shutdown:
                 self.handles.mark_done(
-                    handle, False,
-                    error="Horovod has been shut down. This was caused by an "
-                          "exception on one of the ranks or an earlier shutdown().",
+                    user, False, error="Horovod has been shut down.",
                     error_cls=ShutdownError)
-                return handle
-            ranks = self._table.setdefault(entry.tensor_name, {})
-            if entry.rank in ranks:
+                return user
+            ch = self.controller.submit(entry)
+            if ch == self.controller.SUBMIT_DUPLICATE:
                 self.handles.mark_done(
-                    handle, False,
+                    user, False,
                     error=f"Duplicate tensor name {entry.tensor_name!r}: a "
                           f"collective with this name from rank {entry.rank} "
                           "is already pending.",
                     error_cls=DuplicateNameError)
-                return handle
-            self._seq += 1
-            entry.enqueue_seq = self._seq
-            ranks[entry.rank] = entry
-            self._first_seen.setdefault(entry.tensor_name, time.monotonic())
-            self.timeline.negotiate_start(entry.tensor_name, entry.rank)
+                return user
+            if ch == self.controller.SUBMIT_SHUTDOWN:
+                self.handles.mark_done(
+                    user, False, error="Horovod has been shut down.",
+                    error_cls=ShutdownError)
+                return user
+            self._pending[ch] = entry
             self._wake.notify_all()
-        return handle
+        return user
 
     def join(self, rank: int) -> int:
-        """Rank signals it has no more data (JoinOp, `operations.cc:908-934`).
-
-        Returns a handle; synchronizing it blocks until ALL ranks joined; the
-        result is the id of the last rank to join.
-        """
-        handle = self.handles.allocate()
+        """Rank signals it has no more data (JoinOp, `operations.cc:908-934`)."""
+        user = self.handles.allocate()
         with self._lock:
-            self._joined.add(rank)
-            self._join_handles[rank] = handle
-            self._last_joined = rank
+            if self._shutdown:
+                self.handles.mark_done(user, False,
+                                       error="Horovod has been shut down.",
+                                       error_cls=ShutdownError)
+                return user
+            ch = self.controller.join(rank)
+            self._join_waiters[ch] = user
             self._wake.notify_all()
-        return handle
+        return user
+
+    def report_score(self, nbytes: int, seconds: float) -> None:
+        if self.controller.report_score(nbytes, seconds):
+            self.cycle_time_s = self.controller.cycle_time_ms() / 1e3
 
     # ----------------------------------------------------------------- loop
-    def _required_ranks(self) -> set:
-        if self._mode == "multiprocess":
-            return {self._state.rank0}
-        return set(range(self._world))
-
     def _loop(self) -> None:
         while True:
             try:
                 with self._lock:
-                    if not self._shutdown and not self._table and not self._joined:
+                    if (not self._shutdown and not self._pending
+                            and not self._join_waiters):
                         self._wake.wait(timeout=self.cycle_time_s)
                     if self._shutdown:
-                        self._drain_locked()
+                        self._drain()
                         return
-                    responses, entries = self._compute_responses_locked()
-                for resp, ebr in zip(responses, entries):
-                    self._perform(resp, ebr)
-                if not responses:
-                    # nothing ready: nap one cycle (RunLoopOnce cadence)
+                tick = self.controller.tick()
+                if tick is None:
                     time.sleep(self.cycle_time_s / 5)
+                    continue
+                (responses, handle_pairs, join_released, last_joined,
+                 stall_warnings, stall_shutdown) = tick
+                for name in stall_warnings:
+                    logger.warning(
+                        "One or more tensors were submitted to be reduced/"
+                        "gathered/broadcasted by subset of ranks and are "
+                        "waiting for remainder of ranks for more than %ss. "
+                        "Stalled op: %s",
+                        os.environ.get("HOROVOD_STALL_CHECK_TIME_SECONDS",
+                                       "60"), name)
+                if responses:
+                    self.controller.timeline_cycle()
+                for resp, pairs in zip(responses, handle_pairs):
+                    self._perform(resp, pairs)
+                if join_released:
+                    with self._lock:
+                        for ch in join_released:
+                            user = self._join_waiters.pop(ch, None)
+                            if user is not None:
+                                self.handles.mark_done(user, True,
+                                                       result=last_joined)
+                if stall_shutdown:
+                    raise RuntimeError(
+                        "Stalled tensors exceeded "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting "
+                        "(stall_inspector.h:80).")
             except Exception as exc:
-                # An engine-tick failure (e.g. stall-shutdown) must not leave
-                # callers blocked: fail everything outstanding and stop, the
-                # way the reference drains with SHUT_DOWN_ERROR
-                # (`operations.cc:511-517`).
-                import logging
-                logging.getLogger("horovod_tpu").error(
-                    "engine thread aborting: %s", exc)
+                logger.error("engine thread aborting: %s", exc)
                 with self._lock:
                     self._shutdown = True
-                    self._drain_locked()
+                    self._drain()
                 return
 
-    def _drain_locked(self) -> None:
-        """Finalize outstanding entries with shutdown error
+    def _drain(self) -> None:
+        """Fail everything outstanding with shutdown error
         (`operations.cc:511-517`)."""
-        for name, ranks in self._table.items():
-            for e in ranks.values():
-                self.handles.mark_done(
-                    e.handle, False, error="Horovod has been shut down.")
-                if e.callback:
-                    e.callback(False, "shutdown")
-        self._table.clear()
-        for r, h in self._join_handles.items():
-            self.handles.mark_done(h, False, error="Horovod has been shut down.")
-        self._join_handles.clear()
-
-    # ------------------------------------------------------ negotiation tick
-    def _compute_responses_locked(self):
-        """ComputeResponseList analogue: find ready names, validate, fuse."""
-        required = self._required_ranks()
-        active = required - self._joined
-        now = time.monotonic()
-
-        # all ranks joined -> release join barrier (controller.cc:202-256)
-        if self._joined and self._joined >= required and not self._table:
-            for r, h in self._join_handles.items():
-                self.handles.mark_done(h, True, result=self._last_joined)
-            self._join_handles.clear()
-            self._joined.clear()
-
-        ready: List[str] = []
-        for name, ranks in self._table.items():
-            # ready when every active (non-joined) rank has submitted; with an
-            # empty active set (everyone joined) pending tensors reduce
-            # against zeros from the joined ranks (controller.cc:202-256)
-            if active <= set(ranks.keys()):
-                ready.append(name)
-            else:
-                self._check_stall(name, now)
-
-        responses: List[Response] = []
-        out_entries: List[Dict[int, List[TensorTableEntry]]] = []
-
-        # validate each ready name -> single-name response or error
-        singles: List[tuple] = []  # (name, rtype, dtype, bytes, entries_by_rank)
-        for name in ready:
-            ranks = self._table.pop(name)
-            self._first_seen.pop(name, None)
-            self._stall_warned.discard(name)
-            err = self._validate(name, ranks)
-            if err is not None:
-                resp = Response(ResponseType.ERROR, [name], error_message=err)
-                responses.append(resp)
-                out_entries.append({r: [e] for r, e in ranks.items()})
-                continue
-            e0 = next(iter(ranks.values()))
-            rtype = e0.request_type
-            nbytes = int(sum(
-                np.prod(e.array.shape) * e.array.dtype.itemsize
-                for e in ranks.values())) or 1
-            singles.append((name, e0, rtype, str(e0.array.dtype), nbytes, ranks))
-
-        # fusion: greedy buckets by (type, dtype, scale/average/root signature)
-        # preserving order, with lookahead past non-matching entries
-        # (FuseResponses, controller.cc:626-750).
-        # In multiprocess mode fusion is DISABLED until the cross-process
-        # control plane lands: bucket contents would depend on per-process
-        # tick timing, and all processes must execute identical XLA programs.
-        fuse_ok = self._mode != "multiprocess"
-        used = [False] * len(singles)
-        for i, (name, e0, rtype, dtype, nbytes, ranks) in enumerate(singles):
-            if used[i]:
-                continue
-            used[i] = True
-            bucket = [i]
-            total = nbytes
-            if fuse_ok and rtype in (RequestType.ALLREDUCE, RequestType.ADASUM,
-                                     RequestType.ALLGATHER):
-                sig = self._fusion_sig(singles[i])
-                for j in range(i + 1, len(singles)):
-                    if used[j]:
-                        continue
-                    if self._fusion_sig(singles[j]) == sig and \
-                            total + singles[j][4] <= self.fusion_threshold:
-                        used[j] = True
-                        bucket.append(j)
-                        total += singles[j][4]
-            names = [singles[k][0] for k in bucket]
-            rt = ResponseType(int(rtype))
-            resp = Response(rt, names)
-            if rtype == RequestType.ALLREDUCE:
-                resp.average = e0.average
-            ebr: Dict[int, List[TensorTableEntry]] = {}
-            for k in bucket:
-                for r, e in singles[k][5].items():
-                    ebr.setdefault(r, []).append(e)
-            responses.append(resp)
-            out_entries.append(ebr)
-        if responses:
-            self.timeline.cycle_tick()  # one CYCLE marker per engine tick
-        return responses, out_entries
-
-    @staticmethod
-    def _fusion_sig(single):
-        name, e0, rtype, dtype, nbytes, ranks = single
-        return (int(rtype), dtype, e0.average,
-                e0.prescale_factor, e0.postscale_factor, e0.root_rank)
-
-    def _validate(self, name: str, ranks: Dict[int, TensorTableEntry]) -> Optional[str]:
-        """ConstructResponse-style cross-rank consistency checks
-        (`controller.cc:358-597`)."""
-        entries = list(ranks.values())
-        e0 = entries[0]
-        if (self._mode == "multiprocess" and self._world > 1
-                and e0.request_type == RequestType.ALLGATHER):
-            # per-rank dim0 sizes live on other processes; needs the
-            # cross-process control plane (negotiation over DCN) to agree on
-            # the ragged layout. Allreduce/broadcast/alltoall are symmetric
-            # and need no size exchange.
-            return ("Allgather is not yet supported in multiprocess mode "
-                    "(cross-process size negotiation not implemented).")
-        if any(e.request_type != e0.request_type for e in entries):
-            types = {e.rank: e.request_type.name for e in entries}
-            return (f"Mismatched collective operations for tensor {name!r}: "
-                    f"{types}")
-        if any(str(e.array.dtype) != str(e0.array.dtype) for e in entries):
-            dts = {e.rank: str(e.array.dtype) for e in entries}
-            return f"Mismatched data types for tensor {name!r}: {dts}"
-        if any((e.average, e.prescale_factor, e.postscale_factor)
-               != (e0.average, e0.prescale_factor, e0.postscale_factor)
-               for e in entries):
-            flags = {e.rank: ("avg" if e.average else "sum",
-                              e.prescale_factor, e.postscale_factor)
-                     for e in entries}
-            return (f"Mismatched reduction op/scale factors for tensor "
-                    f"{name!r}: {flags}")
-        if e0.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM,
-                               RequestType.BROADCAST, RequestType.ALLTOALL):
-            if any(tuple(e.array.shape) != tuple(e0.array.shape) for e in entries):
-                shps = {e.rank: tuple(e.array.shape) for e in entries}
-                return f"Mismatched tensor shapes for {name!r}: {shps}"
-        if e0.request_type == RequestType.ALLGATHER:
-            if any(tuple(e.array.shape[1:]) != tuple(e0.array.shape[1:])
-                   for e in entries):
-                shps = {e.rank: tuple(e.array.shape) for e in entries}
-                return (f"Mismatched allgather tensor shapes beyond first "
-                        f"dimension for {name!r}: {shps}")
-            if any(e.array.ndim == 0 for e in entries):
-                return f"Allgather of scalar tensor {name!r} is not supported."
-        if e0.request_type == RequestType.ADASUM:
-            if self._world & (self._world - 1):
-                # parity: torch/mpi_ops.py:104-120 (power-of-2 requirement)
-                return (f"Adasum requires a power-of-2 number of ranks; got "
-                        f"{self._world}.")
-        if e0.request_type == RequestType.ALLTOALL:
-            d0 = e0.array.shape[0] if e0.array.ndim else 0
-            if e0.array.ndim == 0 or d0 % self._world != 0:
-                return (f"Alltoall tensor {name!r} first dimension ({d0}) "
-                        f"must be divisible by world size {self._world}.")
-        if e0.request_type == RequestType.BROADCAST:
-            if any(e.root_rank != e0.root_rank for e in entries):
-                roots = {e.rank: e.root_rank for e in entries}
-                return f"Mismatched root ranks for broadcast {name!r}: {roots}"
-            if not (0 <= e0.root_rank < self._world):
-                return (f"Invalid root rank {e0.root_rank} for broadcast "
-                        f"{name!r} (world size {self._world}).")
-        if self._joined and e0.request_type in (RequestType.ALLGATHER,
-                                                RequestType.BROADCAST,
-                                                RequestType.ALLTOALL):
-            # parity: controller.cc:434-437, 510-513
-            return (f"{e0.request_type.name} is not supported while a rank "
-                    "has joined.")
-        return None
-
-    def _check_stall(self, name: str, now: float) -> None:
-        """StallInspector warn/shutdown (`stall_inspector.{h,cc}`)."""
-        t0 = self._first_seen.get(name)
-        if t0 is None:
-            return
-        waited = now - t0
-        if waited > self.stall_warning_s and name not in self._stall_warned:
-            self._stall_warned.add(name)
-            missing = sorted(self._required_ranks() - self._joined
-                             - set(self._table[name].keys()))
-            import logging
-            logging.getLogger("horovod_tpu").warning(
-                "One or more tensors were submitted to be reduced/gathered/"
-                "broadcasted by subset of ranks and are waiting for remainder "
-                "of ranks for more than %ds. Stalled op: %s (missing ranks: %s)",
-                int(self.stall_warning_s), name, missing)
-        if self.stall_shutdown_s and waited > self.stall_shutdown_s:
-            raise RuntimeError(
-                f"Stalled tensor {name!r} exceeded "
-                f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting "
-                "(stall_inspector.h:80).")
+        orphans = self.controller.shutdown()
+        for ch in orphans:
+            entry = self._pending.pop(ch, None)
+            if entry is not None:
+                self.handles.mark_done(entry.handle, False,
+                                       error="Horovod has been shut down.",
+                                       error_cls=ShutdownError)
+                if entry.callback:
+                    entry.callback(False, "shutdown")
+            user = self._join_waiters.pop(ch, None)
+            if user is not None:
+                self.handles.mark_done(user, False,
+                                       error="Horovod has been shut down.",
+                                       error_cls=ShutdownError)
 
     # -------------------------------------------------------------- perform
-    def _perform(self, resp: Response, ebr: Dict[int, List[TensorTableEntry]]):
+    def _perform(self, resp: Response, pairs) -> None:
         """PerformOperation analogue (`operations.cc:227-304`)."""
-        names = resp.tensor_names
+        with self._lock:
+            entries = [self._pending.pop(ch) for _, ch in pairs]
+        ebr: Dict[int, List[TensorTableEntry]] = {}
+        for e in entries:
+            ebr.setdefault(e.rank, []).append(e)
+        # order each rank's entries to match resp.names
+        name_order = {n: i for i, n in enumerate(resp.tensor_names)}
+        for r in ebr:
+            ebr[r].sort(key=lambda e: name_order[e.tensor_name])
+
         if resp.response_type == ResponseType.ERROR:
-            for r, es in ebr.items():
+            for es in ebr.values():
                 for e in es:
                     self.handles.mark_done(e.handle, False,
                                            error=resp.error_message)
                     if e.callback:
                         e.callback(False, resp.error_message)
             return
-        for n in names:
-            self.timeline.op_start(n, resp.response_type.name)
+
+        for n in resp.tensor_names:
+            self.controller.timeline_op_start(n, resp.response_type.name)
+        t0 = time.perf_counter()
+        nbytes = sum(int(e.array.size) * e.array.dtype.itemsize
+                     for es in ebr.values() for e in es)
         try:
-            results = self._executor.execute(resp, ebr, frozenset(self._joined))
+            results = self._executor.execute(resp, ebr)
             for r, es in ebr.items():
                 outs = results[r]
                 for e, out in zip(es, outs):
@@ -394,11 +273,12 @@ class Engine:
                         e.callback(True, out)
         except Exception as exc:  # surface execution errors on every handle
             msg = f"{type(exc).__name__}: {exc}"
-            for r, es in ebr.items():
+            for es in ebr.values():
                 for e in es:
                     self.handles.mark_done(e.handle, False, error=msg)
                     if e.callback:
                         e.callback(False, msg)
         finally:
-            for n in names:
-                self.timeline.op_end(n)
+            for n in resp.tensor_names:
+                self.controller.timeline_op_end(n)
+            self.report_score(nbytes, time.perf_counter() - t0)

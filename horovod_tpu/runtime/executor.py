@@ -211,8 +211,7 @@ class Executor:
 
     # ---------------------------------------------------------------- execute
     def execute(self, response: Response,
-                entries_by_rank: Dict[int, List[TensorTableEntry]],
-                joined_ranks: frozenset = frozenset()):
+                entries_by_rank: Dict[int, List[TensorTableEntry]]):
         """Run one fused response; returns {rank: [result arrays in name order]}.
 
         The contract mirrors OperationManager::ExecuteOperation
@@ -221,7 +220,7 @@ class Executor:
         """
         rt = response.response_type
         if rt in (ResponseType.ALLREDUCE, ResponseType.ADASUM):
-            return self._exec_allreduce(response, entries_by_rank, joined_ranks,
+            return self._exec_allreduce(response, entries_by_rank,
                                         adasum=(rt == ResponseType.ADASUM))
         if rt == ResponseType.ALLGATHER:
             return self._exec_allgather(response, entries_by_rank)
@@ -231,7 +230,7 @@ class Executor:
             return self._exec_alltoall(response, entries_by_rank)
         raise ValueError(f"unsupported response type {rt}")
 
-    def _exec_allreduce(self, response, entries_by_rank, joined_ranks, adasum):
+    def _exec_allreduce(self, response, entries_by_rank, adasum):
         import jax.numpy as jnp
 
         world = self._world

@@ -1,0 +1,191 @@
+"""ctypes bindings to the native engine core (libhvd_tpu_core.so).
+
+The reference loads its C++ engine the same way — a ctypes wrapper over a C
+ABI (`horovod/common/basics.py:27-31`). The library is built from
+`horovod_tpu/_core/` by `make`; if missing, it is built on first use (the
+toolchain is part of the supported environment) and the engine falls back to
+the pure-Python controller only if compilation is impossible
+(``HVD_TPU_NATIVE=0`` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import wire
+from .messages import RequestType, Response, TensorTableEntry
+
+_CORE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_core")
+_LIB_PATH = os.path.join(_CORE_DIR, "libhvd_tpu_core.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+# numpy dtype name -> DType code (common.h)
+_DTYPE_CODES = {
+    "float16": 0, "bfloat16": 1, "float32": 2, "float64": 3,
+    "int8": 4, "int16": 5, "int32": 6, "int64": 7,
+    "uint8": 8, "uint16": 9, "uint32": 10, "uint64": 11, "bool": 12,
+}
+
+
+def dtype_code(dtype) -> int:
+    return _DTYPE_CODES.get(str(dtype), 2)
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _CORE_DIR], capture_output=True,
+                           timeout=300)
+        return r.returncode == 0 and os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def load_library():
+    """Load (building if needed) the native core; returns None on failure."""
+    global _lib
+    with _lib_lock:
+        if os.environ.get("HVD_TPU_NATIVE", "1") in ("0", "false"):
+            return None
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.hvd_core_create.restype = ctypes.c_int64
+        lib.hvd_core_create.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_int32, ctypes.c_int32]
+        lib.hvd_core_destroy.argtypes = [ctypes.c_int64]
+        lib.hvd_core_submit.restype = ctypes.c_int64
+        lib.hvd_core_submit.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+        lib.hvd_core_join.restype = ctypes.c_int64
+        lib.hvd_core_join.argtypes = [ctypes.c_int64, ctypes.c_int32]
+        lib.hvd_core_tick.restype = ctypes.c_int64
+        lib.hvd_core_tick.argtypes = [ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_char_p)]
+        lib.hvd_core_shutdown.restype = ctypes.c_int64
+        lib.hvd_core_shutdown.argtypes = [ctypes.c_int64,
+                                          ctypes.POINTER(ctypes.c_char_p)]
+        for f in ("hvd_core_timeline_op_start", "hvd_core_timeline_activity"):
+            getattr(lib, f).argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                        ctypes.c_char_p]
+        lib.hvd_core_timeline_op_end.argtypes = [ctypes.c_int64,
+                                                 ctypes.c_char_p]
+        lib.hvd_core_timeline_cycle.argtypes = [ctypes.c_int64]
+        lib.hvd_core_report_score.restype = ctypes.c_int32
+        lib.hvd_core_report_score.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                              ctypes.c_double]
+        lib.hvd_core_fusion_threshold.restype = ctypes.c_int64
+        lib.hvd_core_fusion_threshold.argtypes = [ctypes.c_int64]
+        lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
+        lib.hvd_core_cycle_time_ms.argtypes = [ctypes.c_int64]
+        lib.hvd_core_cache_hits.restype = ctypes.c_uint64
+        lib.hvd_core_cache_hits.argtypes = [ctypes.c_int64]
+        lib.hvd_core_cache_misses.restype = ctypes.c_uint64
+        lib.hvd_core_cache_misses.argtypes = [ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+class NativeController:
+    """Thin stateful wrapper over one native engine instance.
+
+    Interface consumed by runtime.engine.Engine: submit/join/tick/shutdown +
+    timeline hooks + autotune scoring. Tensor data never crosses this
+    boundary — only metadata and handles.
+    """
+
+    SUBMIT_DUPLICATE = -1
+    SUBMIT_SHUTDOWN = -2
+
+    def __init__(self, world: int, fusion_threshold: int,
+                 stall_warning_s: float, stall_shutdown_s: float,
+                 cache_capacity: int, fusion_enabled: bool,
+                 timeline_path: Optional[str], autotune: bool,
+                 cycle_time_ms: float, local_only: bool = False,
+                 self_rank: int = 0):
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._eng = self._lib.hvd_core_create(
+            world, fusion_threshold, stall_warning_s, stall_shutdown_s,
+            cache_capacity, int(fusion_enabled),
+            timeline_path.encode() if timeline_path else None,
+            int(autotune), cycle_time_ms, int(local_only), self_rank)
+        self._dead = False
+
+    def submit(self, entry: TensorTableEntry) -> int:
+        shape = np.asarray(entry.array.shape, dtype=np.int64)
+        dims = shape.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) \
+            if shape.size else ctypes.POINTER(ctypes.c_int64)()
+        return self._lib.hvd_core_submit(
+            self._eng, entry.tensor_name.encode(), entry.rank,
+            int(entry.request_type), dtype_code(entry.array.dtype),
+            len(entry.array.shape), dims, entry.root_rank,
+            int(entry.average), entry.prescale_factor, entry.postscale_factor)
+
+    def join(self, rank: int) -> int:
+        return self._lib.hvd_core_join(self._eng, rank)
+
+    def tick(self):
+        p = ctypes.c_char_p()
+        n = self._lib.hvd_core_tick(self._eng, ctypes.byref(p))
+        if n <= 0:
+            return None
+        buf = ctypes.string_at(p, n)
+        return wire.decode_tick(buf)
+
+    def shutdown(self) -> List[int]:
+        if self._dead:
+            return []
+        self._dead = True
+        p = ctypes.c_char_p()
+        n = self._lib.hvd_core_shutdown(self._eng, ctypes.byref(p))
+        orphans = wire.decode_handle_list(ctypes.string_at(p, n)) if n > 0 else []
+        self._lib.hvd_core_destroy(self._eng)
+        return orphans
+
+    # ---- timeline / autotune
+    def timeline_op_start(self, tensor: str, op: str) -> None:
+        self._lib.hvd_core_timeline_op_start(self._eng, tensor.encode(),
+                                             op.encode())
+
+    def timeline_activity(self, tensor: str, activity: str) -> None:
+        self._lib.hvd_core_timeline_activity(self._eng, tensor.encode(),
+                                             activity.encode())
+
+    def timeline_op_end(self, tensor: str) -> None:
+        self._lib.hvd_core_timeline_op_end(self._eng, tensor.encode())
+
+    def timeline_cycle(self) -> None:
+        self._lib.hvd_core_timeline_cycle(self._eng)
+
+    def report_score(self, nbytes: int, seconds: float) -> bool:
+        return bool(self._lib.hvd_core_report_score(self._eng, nbytes,
+                                                    seconds))
+
+    def fusion_threshold(self) -> int:
+        return self._lib.hvd_core_fusion_threshold(self._eng)
+
+    def cycle_time_ms(self) -> float:
+        return self._lib.hvd_core_cycle_time_ms(self._eng)
+
+    def cache_stats(self) -> Tuple[int, int]:
+        return (self._lib.hvd_core_cache_hits(self._eng),
+                self._lib.hvd_core_cache_misses(self._eng))

@@ -1,0 +1,273 @@
+"""Pure-Python fallback controller with the NativeController interface.
+
+Used only when the C++ core cannot be built/loaded (``HVD_TPU_NATIVE=0`` or a
+toolchain-less host). Semantics match `_core/controller.cc` exactly; the test
+suite runs the same matrix against both (see tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.timeline import Timeline
+from .messages import RequestType, Response, ResponseType, TensorTableEntry
+
+
+class _Meta:
+    __slots__ = ("name", "rank", "type", "dtype", "shape", "root_rank",
+                 "average", "prescale", "postscale", "handle", "enqueue_t",
+                 "nbytes")
+
+    def __init__(self, e: TensorTableEntry, handle: int):
+        self.name = e.tensor_name
+        self.rank = e.rank
+        self.type = e.request_type
+        self.dtype = str(e.array.dtype)
+        self.shape = tuple(e.array.shape)
+        self.root_rank = e.root_rank
+        self.average = e.average
+        self.prescale = e.prescale_factor
+        self.postscale = e.postscale_factor
+        self.handle = handle
+        self.enqueue_t = time.monotonic()
+        self.nbytes = int(e.array.size) * e.array.dtype.itemsize
+
+
+class PyController:
+    SUBMIT_DUPLICATE = -1
+    SUBMIT_SHUTDOWN = -2
+
+    def __init__(self, world: int, fusion_threshold: int,
+                 stall_warning_s: float, stall_shutdown_s: float,
+                 cache_capacity: int, fusion_enabled: bool,
+                 timeline_path: Optional[str], autotune: bool,
+                 cycle_time_ms: float, local_only: bool = False,
+                 self_rank: int = 0):
+        self._world = world
+        self._local_only = local_only
+        self._self_rank = self_rank
+        self._threshold = fusion_threshold
+        self._stall_warning_s = stall_warning_s
+        self._stall_shutdown_s = stall_shutdown_s
+        self._fusion_enabled = fusion_enabled
+        self._cycle_ms = cycle_time_ms
+        self._timeline = Timeline(timeline_path)
+        self._next_handle = 0
+        self._order: List[str] = []
+        self._table: Dict[str, Dict[int, _Meta]] = {}
+        self._joined: set = set()
+        self._join_handles: Dict[int, int] = {}
+        self._last_joined = -1
+        self._shutdown = False
+        self._warned: set = set()
+        import threading
+        self._lock = threading.Lock()
+
+    def submit(self, entry: TensorTableEntry) -> int:
+        with self._lock:
+            if self._shutdown:
+                return self.SUBMIT_SHUTDOWN
+            ranks = self._table.setdefault(entry.tensor_name, {})
+            if entry.rank in ranks:
+                return self.SUBMIT_DUPLICATE
+            h = self._next_handle
+            self._next_handle += 1
+            if not ranks:
+                self._order.append(entry.tensor_name)
+            ranks[entry.rank] = _Meta(entry, h)
+            self._timeline.negotiate_start(entry.tensor_name, entry.rank)
+            return h
+
+    def join(self, rank: int) -> int:
+        with self._lock:
+            if self._shutdown:
+                return self.SUBMIT_SHUTDOWN
+            h = self._next_handle
+            self._next_handle += 1
+            self._joined.add(rank)
+            self._join_handles[rank] = h
+            self._last_joined = rank
+            return h
+
+    # ------------------------------------------------------------- validate
+    def _validate(self, name: str, ranks: Dict[int, _Meta]) -> Optional[str]:
+        metas = list(ranks.values())
+        e0 = metas[0]
+        if any(m.type != e0.type for m in metas):
+            return f"Mismatched collective operations for tensor '{name}'"
+        if any(m.dtype != e0.dtype for m in metas):
+            return f"Mismatched data types for tensor '{name}'"
+        if any((m.average, m.prescale, m.postscale)
+               != (e0.average, e0.prescale, e0.postscale) for m in metas):
+            return f"Mismatched reduction op/scale factors for tensor '{name}'"
+        if e0.type in (RequestType.ALLREDUCE, RequestType.ADASUM,
+                       RequestType.BROADCAST, RequestType.ALLTOALL):
+            if any(m.shape != e0.shape for m in metas):
+                return f"Mismatched tensor shapes for '{name}'"
+        if e0.type == RequestType.ALLGATHER:
+            if self._local_only and self._world > 1:
+                return ("Allgather is not yet supported in multiprocess mode "
+                        "(cross-process size negotiation not implemented).")
+            if any(len(m.shape) == 0 for m in metas):
+                return f"Allgather of scalar tensor '{name}' is not supported."
+            if any(m.shape[1:] != e0.shape[1:] for m in metas):
+                return ("Mismatched allgather tensor shapes beyond first "
+                        f"dimension for '{name}'")
+        if e0.type == RequestType.ADASUM and (self._world & (self._world - 1)):
+            return (f"Adasum requires a power-of-2 number of ranks; got "
+                    f"{self._world}.")
+        if e0.type == RequestType.ALLTOALL:
+            d0 = e0.shape[0] if e0.shape else 0
+            if not e0.shape or d0 % self._world != 0:
+                return (f"Alltoall tensor '{name}' first dimension ({d0}) "
+                        f"must be divisible by world size {self._world}.")
+        if e0.type == RequestType.BROADCAST:
+            if any(m.root_rank != e0.root_rank for m in metas):
+                return f"Mismatched root ranks for broadcast '{name}'"
+            if not (0 <= e0.root_rank < self._world):
+                return (f"Invalid root rank {e0.root_rank} for broadcast "
+                        f"'{name}' (world size {self._world}).")
+        if self._joined and e0.type in (RequestType.ALLGATHER,
+                                        RequestType.BROADCAST,
+                                        RequestType.ALLTOALL):
+            return (f"{e0.type.name} is not supported while a rank has "
+                    "joined.")
+        return None
+
+    @staticmethod
+    def _sig(m: _Meta):
+        return (int(m.type), m.dtype, m.average, m.prescale, m.postscale,
+                m.root_rank)
+
+    def tick(self):
+        with self._lock:
+            if self._shutdown:
+                return None
+            now = time.monotonic()
+            if self._local_only:
+                active = {self._self_rank} - self._joined
+            else:
+                active = set(range(self._world)) - self._joined
+
+            join_released: List[int] = []
+            last_joined = -1
+            all_joined = (self._self_rank in self._joined
+                          if self._local_only
+                          else len(self._joined) == self._world)
+            if self._joined and all_joined and not self._table:
+                join_released = list(self._join_handles.values())
+                last_joined = self._last_joined
+                self._join_handles.clear()
+                self._joined.clear()
+                return ([], [], join_released, last_joined, [], False)
+
+            ready, waiting = [], []
+            stall_warnings: List[str] = []
+            stall_shutdown = False
+            for name in self._order:
+                st = self._table.get(name)
+                if st is None:
+                    continue
+                if active <= set(st.keys()):
+                    ready.append(name)
+                else:
+                    waiting.append(name)
+                    waited = now - min(m.enqueue_t for m in st.values())
+                    if waited > self._stall_warning_s and name not in self._warned:
+                        self._warned.add(name)
+                        stall_warnings.append(name)
+                    if self._stall_shutdown_s and waited > self._stall_shutdown_s:
+                        stall_shutdown = True
+            self._order = waiting
+            if not ready and not stall_warnings and not stall_shutdown:
+                return None
+
+            singles = []
+            responses: List[Response] = []
+            handle_pairs: List[List[Tuple[int, int]]] = []
+            for name in ready:
+                st = self._table.pop(name)
+                pairs = sorted((r, m.handle) for r, m in st.items())
+                err = self._validate(name, st)
+                if err is not None:
+                    responses.append(Response(ResponseType.ERROR, [name],
+                                              error_message=err))
+                    handle_pairs.append(pairs)
+                    continue
+                e0 = st[min(st)]
+                singles.append((name, e0, pairs))
+
+            used = [False] * len(singles)
+            for i, (name, e0, pairs) in enumerate(singles):
+                if used[i]:
+                    continue
+                used[i] = True
+                bucket = [i]
+                total = e0.nbytes
+                fusable = self._fusion_enabled and e0.type in (
+                    RequestType.ALLREDUCE, RequestType.ADASUM,
+                    RequestType.ALLGATHER)
+                if fusable:
+                    for j in range(i + 1, len(singles)):
+                        if used[j]:
+                            continue
+                        if (self._sig(singles[j][1]) == self._sig(e0)
+                                and total + singles[j][1].nbytes
+                                <= self._threshold):
+                            used[j] = True
+                            bucket.append(j)
+                            total += singles[j][1].nbytes
+                resp = Response(ResponseType(int(e0.type)),
+                                [singles[k][0] for k in bucket],
+                                average=e0.average)
+                resp.prescale = e0.prescale
+                resp.postscale = e0.postscale
+                resp.root_rank = e0.root_rank
+                hp: List[Tuple[int, int]] = []
+                for k in bucket:
+                    hp.extend(singles[k][2])
+                responses.append(resp)
+                handle_pairs.append(hp)
+            return (responses, handle_pairs, join_released, last_joined,
+                    stall_warnings, stall_shutdown)
+
+    def shutdown(self) -> List[int]:
+        with self._lock:
+            if self._shutdown:
+                return []
+            self._shutdown = True
+            orphans = [m.handle for st in self._table.values()
+                       for m in st.values()]
+            orphans.extend(self._join_handles.values())
+            self._table.clear()
+            self._order.clear()
+            self._join_handles.clear()
+            self._joined.clear()
+        self._timeline.close()
+        return orphans
+
+    # ---- timeline / autotune
+    def timeline_op_start(self, tensor: str, op: str) -> None:
+        self._timeline.op_start(tensor, op)
+
+    def timeline_activity(self, tensor: str, activity: str) -> None:
+        self._timeline.activity(tensor, activity)
+
+    def timeline_op_end(self, tensor: str) -> None:
+        self._timeline.op_end(tensor)
+
+    def timeline_cycle(self) -> None:
+        self._timeline.cycle_tick()
+
+    def report_score(self, nbytes: int, seconds: float) -> bool:
+        return False  # autotune is a native-core feature
+
+    def fusion_threshold(self) -> int:
+        return self._threshold
+
+    def cycle_time_ms(self) -> float:
+        return self._cycle_ms
+
+    def cache_stats(self):
+        return (0, 0)

@@ -1,0 +1,94 @@
+"""Python codec for the native engine's wire format.
+
+Mirrors `horovod_tpu/_core/wire.h` (the TPU-native replacement for the
+reference's FlatBuffers `wire/message.fbs`): little-endian, length-prefixed.
+Used to decode tick payloads from the C++ controller and to exchange
+request/response lists over the cross-process control plane.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from .messages import Response, ResponseType
+
+
+class Reader:
+    __slots__ = ("buf", "off")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.off = 0
+
+    def u8(self) -> int:
+        v = self.buf[self.off]
+        self.off += 1
+        return v
+
+    def u32(self) -> int:
+        v = struct.unpack_from("<I", self.buf, self.off)[0]
+        self.off += 4
+        return v
+
+    def i32(self) -> int:
+        v = struct.unpack_from("<i", self.buf, self.off)[0]
+        self.off += 4
+        return v
+
+    def i64(self) -> int:
+        v = struct.unpack_from("<q", self.buf, self.off)[0]
+        self.off += 8
+        return v
+
+    def f64(self) -> float:
+        v = struct.unpack_from("<d", self.buf, self.off)[0]
+        self.off += 8
+        return v
+
+    def str(self) -> str:
+        n = self.u32()
+        v = self.buf[self.off:self.off + n].decode("utf-8")
+        self.off += n
+        return v
+
+
+def decode_response(rd: Reader) -> Response:
+    rtype = ResponseType(rd.i32())
+    names = [rd.str() for _ in range(rd.u32())]
+    err = rd.str()
+    average = rd.u8() != 0
+    prescale = rd.f64()
+    postscale = rd.f64()
+    root_rank = rd.i32()
+    resp = Response(rtype, names, error_message=err, average=average)
+    resp.prescale = prescale
+    resp.postscale = postscale
+    resp.root_rank = root_rank
+    return resp
+
+
+def decode_tick(buf: bytes):
+    """Decode one hvd_core_tick payload.
+
+    Returns (responses, handle_pairs_per_response, join_released,
+    last_joined, stall_warnings, stall_shutdown).
+    """
+    rd = Reader(buf)
+    n = rd.u32()
+    responses = [decode_response(rd) for _ in range(n)]
+    handle_pairs: List[List[Tuple[int, int]]] = []
+    for _ in range(n):
+        m = rd.u32()
+        handle_pairs.append([(rd.i32(), rd.i64()) for _ in range(m)])
+    join_released = [rd.i64() for _ in range(rd.u32())]
+    last_joined = rd.i32()
+    stall_warnings = [rd.str() for _ in range(rd.u32())]
+    stall_shutdown = rd.u8() != 0
+    return (responses, handle_pairs, join_released, last_joined,
+            stall_warnings, stall_shutdown)
+
+
+def decode_handle_list(buf: bytes) -> List[int]:
+    rd = Reader(buf)
+    return [rd.i64() for _ in range(rd.u32())]

@@ -1,0 +1,145 @@
+"""Native C++ core: load, parity with the Python fallback, autotune, timeline.
+
+The reference runs one engine implementation; here the C++ controller is the
+product and the Python controller is the fallback — this file pins both to the
+same semantics (same test matrix via the HVD_TPU_NATIVE=0 switch is run in
+test_allreduce/test_collectives; here we check native-specific machinery).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+
+
+def test_native_core_loaded():
+    if os.environ.get("HVD_TPU_NATIVE", "1") in ("0", "false"):
+        pytest.skip("native disabled via HVD_TPU_NATIVE=0")
+    from horovod_tpu.runtime.native import load_library
+
+    assert load_library() is not None, "native core failed to build/load"
+    hvd.init()
+    import horovod_tpu.basics as basics
+
+    assert basics._engine().native, "engine did not select native controller"
+
+
+def test_python_fallback_matches(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_NATIVE", "0")
+
+    def fn():
+        r = hvd.rank()
+        out = hvd.allreduce(np.full((4,), float(r + 1), np.float32),
+                            name="pyfall", op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), np.full((4,), 3.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+    import horovod_tpu.basics as basics
+
+    assert not basics._engine().native
+
+
+def test_native_duplicate_and_validation():
+    def fn():
+        r = hvd.rank()
+        # duplicate detection inside C++ table
+        if r == 0:
+            h1 = hvd.allreduce_async(np.ones((2,), np.float32), name="ndup",
+                                     op=hvd.Sum)
+            h2 = hvd.allreduce_async(np.ones((2,), np.float32), name="ndup",
+                                     op=hvd.Sum)
+            with pytest.raises(hvd.DuplicateNameError):
+                hvd.synchronize(h2)
+            hvd.synchronize(h1)
+        else:
+            hvd.synchronize(
+                hvd.allreduce_async(np.ones((2,), np.float32), name="ndup",
+                                    op=hvd.Sum))
+        # C++ shape validation
+        shape = (2, 3) if r == 0 else (3, 2)
+        with pytest.raises(hvd.HorovodInternalError, match="[Ss]hapes"):
+            hvd.allreduce(np.ones(shape, np.float32), name="nshape",
+                          op=hvd.Sum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_native_timeline(tmp_path, monkeypatch):
+    path = str(tmp_path / "timeline.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+
+    def fn():
+        hvd.allreduce(np.ones((4,), np.float32), name="tl", op=hvd.Sum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+    hvd.shutdown()  # closes the C++ writer
+    data = json.loads(open(path).read())
+    names = [e.get("name", "") for e in data]
+    assert any(n.startswith("NEGOTIATE_tl") for n in names)
+    assert "ALLREDUCE" in names
+
+
+def test_native_cache_and_fusion_stats():
+    import horovod_tpu.basics as basics
+
+    def fn():
+        for i in range(4):
+            hs = [hvd.allreduce_async(np.ones((8,), np.float32),
+                                      name=f"cf_{j}", op=hvd.Sum)
+                  for j in range(3)]
+            for h in hs:
+                hvd.synchronize(h)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+    eng = basics._engine()
+    if eng.native:
+        hits, misses = eng.controller.cache_stats()
+        assert hits + misses > 0
+    assert eng.controller.fusion_threshold() == 64 * 1024 * 1024
+
+
+def test_autotune_parameter_manager(monkeypatch):
+    """GP/EI autotune adjusts the fusion threshold from reported scores."""
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    hvd.init()
+    import horovod_tpu.basics as basics
+
+    eng = basics._engine()
+    if not eng.native:
+        pytest.skip("autotune requires the native core")
+    initial = eng.controller.fusion_threshold()
+    changed = False
+    for i in range(200):
+        if eng.controller.report_score(10 * 1024 * 1024, 0.001 + i * 1e-5):
+            changed = True
+    assert changed, "parameter manager never proposed new parameters"
+    assert eng.controller.fusion_threshold() > 0
+
+
+def test_wire_roundtrip_python_decoder():
+    """Python wire decoder agrees with the C++ encoder (tick payloads)."""
+    from horovod_tpu.runtime import wire
+
+    def fn():
+        r = hvd.rank()
+        out = hvd.allreduce(np.full((2,), float(r), np.float32), name="wt",
+                            op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), np.full((2,), 1.0))
+        return True
+
+    # exercised implicitly through the native engine; also decode a
+    # hand-built buffer
+    assert all(testing.run_cluster(fn, np=2))
+    import struct
+    buf = struct.pack("<I", 0) + struct.pack("<I", 0) + struct.pack(
+        "<i", -1) + struct.pack("<I", 0) + b"\x00"
+    resp, pairs, joins, last, warns, shut = wire.decode_tick(buf)
+    assert resp == [] and joins == [] and last == -1 and not shut
