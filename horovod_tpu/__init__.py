@@ -76,6 +76,8 @@ from .optim.distributed import (  # noqa: F401
     allreduce_gradients,
     grad,
 )
+from . import callbacks  # noqa: F401
 from . import spmd  # noqa: F401
+from .run.api import run  # noqa: F401
 
 __version__ = "0.1.0"

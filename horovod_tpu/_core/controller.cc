@@ -46,6 +46,9 @@ int64_t Controller::Submit(const PendingEntry& e) {
 int64_t Controller::Join(int32_t rank) {
   std::lock_guard<std::mutex> l(mu_);
   if (shutdown_) return -2;
+  auto it = join_handles_.find(rank);
+  if (it != join_handles_.end()) return it->second;  // repeated join: same
+                                                     // barrier handle
   int64_t h = next_handle_++;
   joined_.insert(rank);
   join_handles_[rank] = h;

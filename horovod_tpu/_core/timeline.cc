@@ -1,4 +1,5 @@
 #include "timeline.h"
+#include <cstdio>
 
 #include <sstream>
 
@@ -6,10 +7,17 @@ namespace hvdtpu {
 
 static std::string JsonEscape(const std::string& s) {
   std::string out;
-  for (char c : s) {
-    if (c == '"' || c == '\\') { out.push_back('\\'); out.push_back(c); }
-    else if (c == '\n') out += "\\n";
-    else out.push_back(c);
+  char buf[8];
+  for (unsigned char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(static_cast<char>(c));
+    } else if (c < 0x20) {  // all control chars must be escaped in JSON
+      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out.push_back(static_cast<char>(c));
+    }
   }
   return out;
 }

@@ -123,12 +123,22 @@ def init(
                 rank_mesh=_build_mesh(devices),
             )
         elif coord or jax.process_count() > 1:
-            if coord and jax.process_count() == 1:
-                jax.distributed.initialize(
-                    coordinator_address=coord,
-                    num_processes=int(os.environ["HVD_NUM_PROCS"]),
-                    process_id=int(os.environ["HVD_PROCESS_ID"]),
-                )
+            if coord:
+                # must run BEFORE any backend-initializing jax call
+                # (jax.distributed requirement); idempotent via try
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=coord,
+                        num_processes=int(os.environ["HVD_NUM_PROCS"]),
+                        process_id=int(os.environ["HVD_PROCESS_ID"]),
+                    )
+                except RuntimeError as e:
+                    # tolerate only double-initialization; a genuine
+                    # coordination failure (bad address, timeout) must NOT
+                    # silently degrade to un-synchronized single-process
+                    # training
+                    if "already" not in str(e).lower():
+                        raise
             nproc = jax.process_count()
             pid = jax.process_index()
             # local/cross decomposition: ranks sharing a host form LOCAL (ICI);

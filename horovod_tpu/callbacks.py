@@ -1,0 +1,153 @@
+"""Training-loop callbacks (Keras-surface parity, framework-agnostic).
+
+Reference parity: `horovod/_keras/callbacks.py` —
+  * BroadcastGlobalVariablesCallback (:20-43) — sync params+optimizer state
+    from root at train start (the checkpoint/restore pattern).
+  * MetricAverageCallback (:46-84) — allreduce epoch metrics across ranks.
+  * LearningRateScheduleCallback (:87-134) and LearningRateWarmupCallback
+    (:137-185) — multiplier schedules with the momentum-correction staircase.
+
+JAX shape: callbacks operate on a mutable ``state`` dict the training loop
+owns (``params``, ``opt_state``, ``lr`` keys by convention) via hooks named
+like Keras': ``on_train_begin / on_epoch_begin / on_epoch_end / on_batch_end``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+from . import basics
+from .ops import collective_ops as ops
+from .optim.broadcast import broadcast_optimizer_state, broadcast_parameters
+
+
+class Callback:
+    def on_train_begin(self, state: Dict[str, Any]) -> None: ...
+
+    def on_epoch_begin(self, epoch: int, state: Dict[str, Any]) -> None: ...
+
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None: ...
+
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any],
+                     metrics: Optional[Dict[str, float]] = None) -> None: ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast params (+ optimizer state) from root at train start
+    (`_keras/callbacks.py:20-43`)."""
+
+    def __init__(self, root_rank: int = 0, broadcast_opt_state: bool = True):
+        self.root_rank = root_rank
+        self.broadcast_opt_state = broadcast_opt_state
+
+    def on_train_begin(self, state):
+        state["params"] = broadcast_parameters(state["params"],
+                                               self.root_rank)
+        if self.broadcast_opt_state and "opt_state" in state:
+            state["opt_state"] = broadcast_optimizer_state(
+                state["opt_state"], self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over ranks before reporting
+    (`_keras/callbacks.py:46-84`)."""
+
+    def on_epoch_end(self, epoch, state, metrics=None):
+        if not metrics or basics.size() == 1:
+            return
+        import numpy as np
+
+        for k in sorted(metrics):
+            avg = ops.allreduce(np.asarray([metrics[k]], np.float64),
+                                name=f"metric.{k}.e{epoch}", op=basics.Average)
+            metrics[k] = float(np.asarray(avg)[0])
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` within [start, end)
+    (`_keras/callbacks.py:87-134`). ``staircase``/momentum-correction notes
+    apply to the optimizer integration the loop owns."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 initial_lr: Optional[float] = None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.initial_lr = initial_lr
+        if not callable(multiplier):
+            self._mult = lambda epoch: multiplier
+        else:
+            self._mult = multiplier
+        self._current_epoch = 0
+
+    def _in_range(self, epoch):
+        return (epoch >= self.start_epoch
+                and (self.end_epoch is None or epoch < self.end_epoch))
+
+    def on_epoch_begin(self, epoch, state):
+        self._current_epoch = epoch
+        base = self.initial_lr if self.initial_lr is not None else \
+            state.get("base_lr", state.get("lr"))
+        if base is None:
+            raise ValueError("state must carry 'lr' (or pass initial_lr)")
+        state.setdefault("base_lr", base)
+        if self.staircase and self._in_range(epoch):
+            state["lr"] = state["base_lr"] * self._mult(epoch)
+
+    def on_batch_end(self, batch, state):
+        if not self.staircase and self._in_range(self._current_epoch):
+            # smooth schedule: fractional epoch
+            frac = self._current_epoch + state.get("_batch_frac", 0.0)
+            state["lr"] = state["base_lr"] * self._mult(frac)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr to lr*size over ``warmup_epochs``
+    (`_keras/callbacks.py:137-185`, Goyal et al. linear scaling)."""
+
+    def __init__(self, warmup_epochs: int = 5, momentum_correction: bool = True,
+                 initial_lr: Optional[float] = None, verbose: bool = False):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        size = basics.size() if basics.is_initialized() else 1
+
+        def multiplier(epoch):
+            if epoch >= warmup_epochs:
+                return size
+            # epoch may be fractional; reference formula:
+            # lr = initial * (size * epoch / warmup + (1 - epoch / warmup))
+            p = epoch / float(warmup_epochs)
+            return size * p + (1 - p)
+
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=None, staircase=False,
+                         initial_lr=initial_lr)
+
+    def on_epoch_begin(self, epoch, state):
+        super().on_epoch_begin(epoch, state)
+        state["lr"] = state["base_lr"] * self._mult(epoch)
+        if self.verbose and epoch <= self.warmup_epochs:
+            print(f"Epoch {epoch}: warmup lr = {state['lr']:.6f}")
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = list(callbacks)
+
+    def on_train_begin(self, state):
+        for c in self.callbacks:
+            c.on_train_begin(state)
+
+    def on_epoch_begin(self, epoch, state):
+        for c in self.callbacks:
+            c.on_epoch_begin(epoch, state)
+
+    def on_batch_end(self, batch, state):
+        for c in self.callbacks:
+            c.on_batch_end(batch, state)
+
+    def on_epoch_end(self, epoch, state, metrics=None):
+        for c in self.callbacks:
+            c.on_epoch_end(epoch, state, metrics)

@@ -1,0 +1,58 @@
+"""``horovod_tpu.run.run(fn, ...)`` — programmatic launch of a function.
+
+Reference parity: `horovod/run/run.py:769-828, 863-947` — the function is
+cloudpickled, shipped through the launcher's KV store, executed by every rank
+(`run_task.py`), and per-rank results are returned in rank order."""
+
+from __future__ import annotations
+
+import pickle
+import sys
+from typing import Any, Callable, List, Optional
+
+from . import launcher, rendezvous
+
+
+def _dumps(obj) -> bytes:
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj)
+    except ImportError:  # stdlib pickle handles module-level functions
+        return pickle.dumps(obj)
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        hostfile: Optional[str] = None, ssh_port: int = 22,
+        env: Optional[dict] = None, start_timeout: float = 600.0,
+        verbose: bool = False) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` ranks; returns per-rank results."""
+    payload = _dumps((fn, tuple(args), dict(kwargs or {})))
+
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    ip = rendezvous.local_ip() if hosts or hostfile else "127.0.0.1"
+    kv_addr = f"{ip}:{kv.port}"
+    client = rendezvous.KVStoreClient(kv_addr, secret)
+    client.put("runfunc", "fn", payload)
+
+    cmd = [sys.executable, "-m", "horovod_tpu.run.task"]
+    try:
+        rc = launcher.launch(
+            np, cmd, hosts=hosts, hostfile=hostfile, ssh_port=ssh_port,
+            knob_env=dict(env or {}), start_timeout=start_timeout,
+            extra_env={"HVD_KV_ADDR": kv_addr, "HVD_SECRET": secret})
+        results = []
+        for r in range(np):
+            blob = client.get("result", str(r))
+            if blob is None:
+                raise RuntimeError(
+                    f"rank {r} produced no result (exit code {rc})")
+            ok, value = pickle.loads(blob)
+            if not ok:
+                raise RuntimeError(f"rank {r} failed: {value}")
+            results.append(value)
+        return results
+    finally:
+        kv.stop()

@@ -1,0 +1,75 @@
+"""YAML/flag → env knob mapping.
+
+Reference parity: `horovod/run/common/util/config_parser.py` (YAML config file
+mapped onto HOROVOD_* envs) and the knob flags of `run/run.py:395-616`
+(``--fusion-threshold-mb`` → HOROVOD_FUSION_THRESHOLD etc.)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# flag name -> (env var, converter)
+_KNOBS = {
+    "fusion_threshold_mb": ("HOROVOD_FUSION_THRESHOLD",
+                            lambda v: str(int(float(v) * 1024 * 1024))),
+    "cycle_time_ms": ("HOROVOD_CYCLE_TIME", str),
+    "cache_capacity": ("HOROVOD_CACHE_CAPACITY", str),
+    "timeline_filename": ("HOROVOD_TIMELINE", str),
+    "timeline_mark_cycles": ("HOROVOD_TIMELINE_MARK_CYCLES",
+                             lambda v: "1" if v else "0"),
+    "autotune": ("HOROVOD_AUTOTUNE", lambda v: "1" if v else "0"),
+    "autotune_log": ("HOROVOD_AUTOTUNE_LOG", str),
+    "stall_check_time": ("HOROVOD_STALL_CHECK_TIME_SECONDS", str),
+    "stall_shutdown_time": ("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", str),
+    "log_level": ("HOROVOD_LOG_LEVEL", str),
+}
+
+
+def args_to_env(args) -> Dict[str, str]:
+    """Map parsed CLI args (argparse Namespace or dict) to env vars."""
+    d = vars(args) if not isinstance(args, dict) else args
+    env = {}
+    for flag, (var, conv) in _KNOBS.items():
+        v = d.get(flag)
+        if v is not None and v is not False:
+            env[var] = conv(v)
+    return env
+
+
+def parse_config_file(path: str) -> Dict[str, object]:
+    """Parse the YAML config file into flag values (reference layout:
+    top-level params + nested ``timeline:``/``autotune:`` sections)."""
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    out: Dict[str, object] = {}
+    for k in ("fusion_threshold_mb", "cycle_time_ms", "cache_capacity",
+              "log_level"):
+        if k.replace("_", "-") in data:
+            out[k] = data[k.replace("_", "-")]
+        elif k in data:
+            out[k] = data[k]
+    tl = data.get("timeline") or {}
+    if "filename" in tl:
+        out["timeline_filename"] = tl["filename"]
+    if "mark-cycles" in tl:
+        out["timeline_mark_cycles"] = tl["mark-cycles"]
+    at = data.get("autotune") or {}
+    if at.get("enabled"):
+        out["autotune"] = True
+    if "log-file" in at:
+        out["autotune_log"] = at["log-file"]
+    return out
+
+
+def env_from_config(path: Optional[str], args=None) -> Dict[str, str]:
+    merged: Dict[str, object] = {}
+    if path:
+        merged.update(parse_config_file(path))
+    if args is not None:
+        d = vars(args) if not isinstance(args, dict) else dict(args)
+        for k, v in d.items():
+            if v is not None and v is not False:
+                merged[k] = v
+    return args_to_env(merged)

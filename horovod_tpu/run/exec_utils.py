@@ -1,0 +1,97 @@
+"""Process fan-out: local subprocesses or ssh, with per-rank stream prefixing
+and first-failure kill.
+
+Reference parity: `horovod/run/common/util/safe_shell_exec.py` (middleman fork
+killing the process tree on parent death, stream prefixing ``[rank]<stdout>``)
+and `horovod/run/gloo_run.py:142-259` (threaded ssh fan-out, first-failure
+termination). Local processes run in their own process group so the whole tree
+can be killed."""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class RankProcess:
+    def __init__(self, rank: int, cmd: Sequence[str], env: Dict[str, str],
+                 hostname: Optional[str] = None, ssh_port: int = 22,
+                 output_file: Optional[str] = None):
+        self.rank = rank
+        self.returncode: Optional[int] = None
+        self._output_file = output_file
+        if hostname in (None, "localhost", "127.0.0.1"):
+            full_env = dict(os.environ)
+            full_env.update(env)
+            self._proc = subprocess.Popen(
+                list(cmd), env=full_env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, start_new_session=True)
+        else:
+            # ssh fan-out: env inlined into the remote command
+            # (gloo_run.py:207-237)
+            envstr = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+            remote = f"cd {shlex.quote(os.getcwd())} && env {envstr} " + \
+                " ".join(shlex.quote(c) for c in cmd)
+            self._proc = subprocess.Popen(
+                ["ssh", "-p", str(ssh_port),
+                 "-o", "StrictHostKeyChecking=no", hostname, remote],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        self._pump = threading.Thread(target=self._pump_output, daemon=True)
+        self._pump.start()
+
+    def _pump_output(self):
+        f = open(self._output_file, "w") if self._output_file else None
+        try:
+            for raw in self._proc.stdout:
+                line = raw.decode("utf-8", "replace")
+                sys.stdout.write(f"[{self.rank}]<stdout>:{line}")
+                sys.stdout.flush()
+                if f:
+                    f.write(line)
+        finally:
+            if f:
+                f.close()
+
+    def poll(self) -> Optional[int]:
+        self.returncode = self._proc.poll()
+        return self.returncode
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        self.returncode = self._proc.wait(timeout)
+        return self.returncode
+
+    def terminate(self):
+        try:
+            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def wait_all(procs: List[RankProcess], timeout: Optional[float] = None) -> int:
+    """Wait for all ranks; on first nonzero exit, kill the rest
+    (first-failure semantics, `gloo_run.py:253-259`). Returns worst code."""
+    deadline = time.monotonic() + timeout if timeout else None
+    pending = list(procs)
+    worst = 0
+    while pending:
+        for p in list(pending):
+            rc = p.poll()
+            if rc is not None:
+                pending.remove(p)
+                if rc != 0:
+                    worst = worst or rc
+                    for q in pending:
+                        q.terminate()
+        if deadline and time.monotonic() > deadline:
+            for q in pending:
+                q.terminate()
+            raise TimeoutError("ranks did not finish before timeout")
+        time.sleep(0.05)
+    return worst

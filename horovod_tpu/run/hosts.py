@@ -1,0 +1,92 @@
+"""Host parsing and rank allocation.
+
+Reference parity: `horovod/run/run.py:694-709` (``host:slots`` parsing,
+hostfile) and `horovod/run/gloo_run.py:53-111` (``_allocate``: global rank,
+LOCAL rank within a host, CROSS rank across hosts). The LOCAL/CROSS split maps
+to ICI/DCN domains on TPU (SURVEY §5)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass
+class HostSlots:
+    hostname: str
+    slots: int
+
+
+@dataclass
+class RankInfo:
+    rank: int
+    size: int
+    hostname: str
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+
+def parse_hosts(hosts: str) -> List[HostSlots]:
+    """``"h1:4,h2:4"`` → [HostSlots]; bare hostname means 1 slot."""
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append(HostSlots(name, int(slots)))
+        else:
+            out.append(HostSlots(part, 1))
+    return out
+
+
+def parse_hostfile(path: str) -> List[HostSlots]:
+    """One ``host slots=N`` (mpirun style) or ``host:N`` per line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            if "slots=" in line:
+                name, _, rest = line.partition(" ")
+                slots = int(rest.split("slots=")[1].split()[0])
+                out.append(HostSlots(name.strip(), slots))
+            else:
+                out.extend(parse_hosts(line))
+    return out
+
+
+def allocate(hosts: List[HostSlots], np: int) -> List[RankInfo]:
+    """Assign np ranks to hosts in declaration order (gloo_run._allocate)."""
+    total = sum(h.slots for h in hosts)
+    if np > total:
+        raise ValueError(
+            f"requested -np {np} exceeds total available slots {total} "
+            f"on hosts {[f'{h.hostname}:{h.slots}' for h in hosts]}")
+    ranks: List[RankInfo] = []
+    rank = 0
+    used_hosts = []
+    for h in hosts:
+        if rank >= np:
+            break
+        take = min(h.slots, np - rank)
+        used_hosts.append((h.hostname, take))
+        rank += take
+    # cross set for local_rank j = ranks with local_rank j across hosts
+    # (exact reference semantics, gloo_run.py:87-111)
+    rank = 0
+    for host_idx, (hostname, take) in enumerate(used_hosts):
+        for local_rank in range(take):
+            cross_rank = sum(1 for hh, tt in used_hosts[:host_idx]
+                             if tt > local_rank)
+            cross_size = sum(1 for hh, tt in used_hosts if tt > local_rank)
+            ranks.append(RankInfo(
+                rank=rank, size=np, hostname=hostname,
+                local_rank=local_rank, local_size=take,
+                cross_rank=cross_rank, cross_size=cross_size))
+            rank += 1
+    return ranks

@@ -1,0 +1,151 @@
+"""``hvdrun`` — the launcher CLI (horovodrun equivalent).
+
+Reference parity: `horovod/run/run.py:395-616` (arg surface), `gloo_run.py`
+(per-rank env injection + fan-out). TPU-native: instead of Gloo rendezvous,
+each worker gets ``HVD_COORDINATOR_ADDR``/``HVD_NUM_PROCS``/``HVD_PROCESS_ID``
+for `jax.distributed.initialize` (the coordinator service replaces the MPI/
+Gloo control plane, SURVEY §5) plus ``HVD_KV_ADDR``/``HVD_SECRET`` for the
+launcher's KV store (run-func shipping, future control plane).
+
+Usage::
+
+    hvdrun -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+    hvdrun -np 4 --timeline-filename /tmp/tl.json python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+from . import config_parser, hosts as hosts_mod, rendezvous
+from .exec_utils import RankProcess, wait_all
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="number of ranks")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host:slots list, e.g. "h1:4,h2:4" (default: '
+                        "localhost:np)")
+    p.add_argument("--hostfile", default=None,
+                   help="file with one 'host slots=N' per line")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--output-filename", default=None,
+                   help="per-rank output file prefix (rank appended)")
+    p.add_argument("--start-timeout", type=float, default=600.0)
+    p.add_argument("--verbose", action="store_true")
+    # knob flags (run.py:395-616)
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log", default=None)
+    p.add_argument("--stall-check-time", type=float, default=None)
+    p.add_argument("--stall-shutdown-time", type=float, default=None)
+    p.add_argument("--log-level", default=None)
+    p.add_argument("--config-file", default=None, help="YAML config file")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program and args to launch per rank")
+    return p
+
+
+def make_rank_envs(ranks, coordinator_addr: str, kv_addr: str, secret: str,
+                   knob_env: Dict[str, str]) -> List[Dict[str, str]]:
+    envs = []
+    for r in ranks:
+        env = dict(knob_env)
+        env.update({
+            "HVD_NUM_PROCS": str(r.size),
+            "HVD_PROCESS_ID": str(r.rank),
+            "HVD_COORDINATOR_ADDR": coordinator_addr,
+            "HVD_LOCAL_RANK": str(r.local_rank),
+            "HVD_LOCAL_SIZE": str(r.local_size),
+            "HVD_CROSS_RANK": str(r.cross_rank),
+            "HVD_CROSS_SIZE": str(r.cross_size),
+            "HVD_KV_ADDR": kv_addr,
+            "HVD_SECRET": secret,
+        })
+        envs.append(env)
+    return envs
+
+
+def launch(np: int, command: List[str], hosts: Optional[str] = None,
+           hostfile: Optional[str] = None, ssh_port: int = 22,
+           knob_env: Optional[Dict[str, str]] = None,
+           output_filename: Optional[str] = None,
+           start_timeout: float = 600.0,
+           extra_env: Optional[Dict[str, str]] = None) -> int:
+    """Core fan-out; returns worst exit code."""
+    if hostfile:
+        hostlist = hosts_mod.parse_hostfile(hostfile)
+    elif hosts:
+        hostlist = hosts_mod.parse_hosts(hosts)
+    else:
+        hostlist = [hosts_mod.HostSlots("localhost", np)]
+    ranks = hosts_mod.allocate(hostlist, np)
+
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    multi_host = any(r.hostname not in ("localhost", "127.0.0.1")
+                     for r in ranks)
+    ip = rendezvous.local_ip() if multi_host else "127.0.0.1"
+    kv_addr = f"{ip}:{kv.port}"
+    coord_port = rendezvous.find_free_port()
+    coord_host = ranks[0].hostname
+    if coord_host in ("localhost",):
+        coord_host = "127.0.0.1" if not multi_host else ip
+    coordinator_addr = f"{coord_host}:{coord_port}"
+
+    envs = make_rank_envs(ranks, coordinator_addr, kv_addr, secret,
+                          knob_env or {})
+    if extra_env:
+        for e in envs:
+            e.update(extra_env)
+    procs = []
+    try:
+        for r, env in zip(ranks, envs):
+            out = (f"{output_filename}.{r.rank}" if output_filename else None)
+            procs.append(RankProcess(r.rank, command, env,
+                                     hostname=r.hostname, ssh_port=ssh_port,
+                                     output_file=out))
+        return wait_all(procs, timeout=start_timeout if start_timeout > 0
+                        else None)
+    finally:
+        for p in procs:
+            p.terminate()
+        kv.stop()
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    knob_env = config_parser.env_from_config(args.config_file, args)
+    if args.verbose:
+        print(f"hvdrun: launching {args.num_proc} ranks: {cmd}",
+              file=sys.stderr)
+    return launch(args.num_proc, cmd, hosts=args.hosts,
+                  hostfile=args.hostfile, ssh_port=args.ssh_port,
+                  knob_env=knob_env, output_filename=args.output_filename,
+                  start_timeout=args.start_timeout)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""HTTP KV rendezvous server + client.
+
+Reference parity: `horovod/run/http/http_server.py` (scoped PUT/GET KV store
+used by Gloo rendezvous and the run-func result channel) and
+`http/http_client.py`. Here the KV store distributes the `jax.distributed`
+coordinator address and ships cloudpickled functions/results for ``run()``
+(`run/run.py:769-828`), and will carry the cross-process control-plane
+request lists (wire format) in a later milestone.
+
+Security: requests carry an HMAC of the body with a per-job secret
+(`run/common/util/secret.py` parity).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import http.server
+import os
+import secrets as pysecrets
+import socket
+import threading
+import time
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+
+def make_secret() -> str:
+    return pysecrets.token_hex(16)
+
+
+def _sign(secret: str, payload: bytes) -> str:
+    return hmac.new(secret.encode(), payload, hashlib.sha256).hexdigest()
+
+
+class KVStoreServer:
+    """Threaded HTTP server: PUT /scope/key, GET /scope/key (404 if absent)."""
+
+    def __init__(self, secret: str, host: str = "0.0.0.0", port: int = 0):
+        self._secret = secret
+        store: Dict[Tuple[str, str], bytes] = {}
+        lock = threading.Lock()
+        secret_ = secret
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def _path(self):
+                parts = self.path.strip("/").split("/", 1)
+                if len(parts) != 2:
+                    return None
+                return parts[0], parts[1]
+
+            def do_PUT(self):
+                key = self._path()
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                sig = self.headers.get("X-HVD-Sig", "")
+                if not hmac.compare_digest(sig, _sign(secret_, body)):
+                    self.send_response(403)
+                    self.end_headers()
+                    return
+                if key is None:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                with lock:
+                    store[key] = body
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                key = self._path()
+                with lock:
+                    val = store.get(key) if key else None
+                if val is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(val)))
+                self.end_headers()
+                self.wfile.write(val)
+
+            def do_DELETE(self):  # finalize scope (RendezvousHandler parity)
+                key = self._path()
+                with lock:
+                    if key:
+                        store.pop(key, None)
+                self.send_response(200)
+                self.end_headers()
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class KVStoreClient:
+    def __init__(self, addr: str, secret: str, timeout: float = 30.0):
+        self._base = f"http://{addr}"
+        self._secret = secret
+        self._timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        req = urllib.request.Request(
+            f"{self._base}/{scope}/{key}", data=value, method="PUT",
+            headers={"X-HVD-Sig": _sign(self._secret, value)})
+        urllib.request.urlopen(req, timeout=self._timeout).read()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        try:
+            req = urllib.request.Request(f"{self._base}/{scope}/{key}")
+            return urllib.request.urlopen(req, timeout=self._timeout).read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def wait(self, scope: str, key: str, timeout: float = 60.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            time.sleep(0.1)
+        raise TimeoutError(f"KV key {scope}/{key} not available "
+                           f"after {timeout}s")
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def local_ip() -> str:
+    """Best-effort routable local address (reference NIC discovery is a full
+    driver/task probe, `run/run.py:199-269`; single-NIC hosts need only this)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,39 @@
+"""Per-rank worker for the run-func API (`horovod/run/run_task.py` parity):
+pull the pickled function from the launcher's KV store, init the framework,
+execute, post the result."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    addr = os.environ["HVD_KV_ADDR"]
+    secret = os.environ["HVD_SECRET"]
+    rank = int(os.environ.get("HVD_PROCESS_ID", "0"))
+
+    from .rendezvous import KVStoreClient
+
+    client = KVStoreClient(addr, secret)
+    blob = client.wait("runfunc", "fn", timeout=60.0)
+    fn, args, kwargs = pickle.loads(blob)
+
+    try:
+        import horovod_tpu as hvd
+
+        hvd.init()
+        result = fn(*args, **kwargs)
+        payload = pickle.dumps((True, result))
+    except BaseException:
+        payload = pickle.dumps((False, traceback.format_exc()))
+        client.put("result", str(rank), payload)
+        return 1
+    client.put("result", str(rank), payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

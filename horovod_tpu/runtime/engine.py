@@ -62,7 +62,11 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
         # multiprocess fusion requires the cross-process control plane:
         # bucket contents must not depend on per-process tick timing
         fusion_enabled=(mode != "multiprocess"),
-        timeline_path=os.environ.get("HOROVOD_TIMELINE"),
+        # only the coordinator writes the timeline (operations.cc:389-396);
+        # concurrent writers on a shared path would corrupt the JSON
+        timeline_path=(os.environ.get("HOROVOD_TIMELINE")
+                       if (mode != "multiprocess" or self_rank == 0)
+                       else None),
         autotune=os.environ.get("HOROVOD_AUTOTUNE", "") in ("1", "true"),
         cycle_time_ms=cycle_ms,
         # multiprocess: only the local rank submits to this process's table;
@@ -104,6 +108,9 @@ class Engine:
         self._shutdown = False
         self._thread: Optional[threading.Thread] = None
         self.cycle_time_s = self.controller.cycle_time_ms() / 1e3
+        # shape signatures already executed once: first executions include
+        # XLA compile time and must not be scored for autotune
+        self._scored_sigs: set = set()
 
     # ------------------------------------------------------------------ API
     def start(self) -> None:
@@ -162,7 +169,9 @@ class Engine:
                                        error_cls=ShutdownError)
                 return user
             ch = self.controller.join(rank)
-            self._join_waiters[ch] = user
+            # repeated join from the same rank reuses the controller handle;
+            # every caller's user handle must release with the barrier
+            self._join_waiters.setdefault(ch, []).append(user)
             self._wake.notify_all()
         return user
 
@@ -202,8 +211,7 @@ class Engine:
                 if join_released:
                     with self._lock:
                         for ch in join_released:
-                            user = self._join_waiters.pop(ch, None)
-                            if user is not None:
+                            for user in self._join_waiters.pop(ch, []):
                                 self.handles.mark_done(user, True,
                                                        result=last_joined)
                 if stall_shutdown:
@@ -220,21 +228,27 @@ class Engine:
 
     def _drain(self) -> None:
         """Fail everything outstanding with shutdown error
-        (`operations.cc:511-517`)."""
-        orphans = self.controller.shutdown()
-        for ch in orphans:
-            entry = self._pending.pop(ch, None)
-            if entry is not None:
-                self.handles.mark_done(entry.handle, False,
-                                       error="Horovod has been shut down.",
-                                       error_cls=ShutdownError)
-                if entry.callback:
-                    entry.callback(False, "shutdown")
-            user = self._join_waiters.pop(ch, None)
-            if user is not None:
+        (`operations.cc:511-517`).
+
+        Drains the controller's orphans AND anything still in the local
+        pending/join maps — entries a tick already returned but that were
+        never performed (e.g. the tick after the one that raised) are not in
+        the controller's table anymore, yet their handles must not hang.
+        """
+        self.controller.shutdown()
+        for entry in self._pending.values():
+            self.handles.mark_done(entry.handle, False,
+                                   error="Horovod has been shut down.",
+                                   error_cls=ShutdownError)
+            if entry.callback:
+                entry.callback(False, "shutdown")
+        self._pending.clear()
+        for users in self._join_waiters.values():
+            for user in users:
                 self.handles.mark_done(user, False,
                                        error="Horovod has been shut down.",
                                        error_cls=ShutdownError)
+        self._join_waiters.clear()
 
     # -------------------------------------------------------------- perform
     def _perform(self, resp: Response, pairs) -> None:
@@ -281,4 +295,9 @@ class Engine:
         finally:
             for n in resp.tensor_names:
                 self.controller.timeline_op_end(n)
-            self.report_score(nbytes, time.perf_counter() - t0)
+            sig = (int(resp.response_type), nbytes,
+                   tuple(sorted(len(es) for es in ebr.values())))
+            if sig in self._scored_sigs:
+                self.report_score(nbytes, time.perf_counter() - t0)
+            else:
+                self._scored_sigs.add(sig)  # first run pays jit compile

@@ -83,6 +83,8 @@ class PyController:
         with self._lock:
             if self._shutdown:
                 return self.SUBMIT_SHUTDOWN
+            if rank in self._join_handles:  # repeated join: same barrier
+                return self._join_handles[rank]
             h = self._next_handle
             self._next_handle += 1
             self._joined.add(rank)

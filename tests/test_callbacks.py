@@ -1,0 +1,67 @@
+"""Callback surface tests (parity: test_keras.py / _keras/callbacks.py)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+from horovod_tpu.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    CallbackList,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+
+
+def test_broadcast_callback():
+    def fn():
+        r = hvd.rank()
+        state = {"params": {"w": np.full((2,), float(r), np.float32)}}
+        BroadcastGlobalVariablesCallback(root_rank=1).on_train_begin(state)
+        np.testing.assert_allclose(np.asarray(state["params"]["w"]),
+                                   np.full((2,), 1.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_metric_average_callback():
+    def fn():
+        r = hvd.rank()
+        metrics = {"loss": float(r), "acc": float(r) * 10}
+        MetricAverageCallback().on_epoch_end(0, {}, metrics)
+        return metrics
+
+    res = testing.run_cluster(fn, np=4)
+    for m in res:
+        assert m["loss"] == pytest.approx(1.5)
+        assert m["acc"] == pytest.approx(15.0)
+
+
+def test_lr_schedule_staircase():
+    hvd.init()
+    cb = LearningRateScheduleCallback(
+        multiplier=lambda e: 0.1 ** (e // 2), staircase=True, initial_lr=1.0)
+    state = {"lr": 1.0}
+    cb.on_epoch_begin(0, state)
+    assert state["lr"] == pytest.approx(1.0)
+    cb.on_epoch_begin(3, state)
+    assert state["lr"] == pytest.approx(0.1)
+
+
+def test_lr_warmup_reaches_size_scale():
+    def fn():
+        cb = LearningRateWarmupCallback(warmup_epochs=4, initial_lr=0.1)
+        state = {"lr": 0.1}
+        cb.on_epoch_begin(0, state)
+        lr0 = state["lr"]
+        cb.on_epoch_begin(4, state)
+        lr_end = state["lr"]
+        return lr0, lr_end
+
+    res = testing.run_cluster(fn, np=4)
+    for lr0, lr_end in res:
+        assert lr0 == pytest.approx(0.1)       # epoch 0: base lr
+        assert lr_end == pytest.approx(0.4)    # warmed to lr * size
+    return True

@@ -1,0 +1,173 @@
+"""Launcher unit tests + a real multi-process run() integration test.
+
+Parity model: `test/test_run.py` (arg→env mapping :68-80, config YAML, host
+parsing, command construction — unit, mocked) and `test/test_interactiverun.py`
+(run() func API across 2 real processes)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.run import config_parser, hosts, rendezvous
+from horovod_tpu.run.launcher import build_parser, make_rank_envs
+
+
+def test_parse_hosts():
+    hs = hosts.parse_hosts("h1:4, h2:2,h3")
+    assert [(h.hostname, h.slots) for h in hs] == [("h1", 4), ("h2", 2),
+                                                   ("h3", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("h1 slots=4\nh2:2  # comment\n\n")
+    hs = hosts.parse_hostfile(str(f))
+    assert [(h.hostname, h.slots) for h in hs] == [("h1", 4), ("h2", 2)]
+
+
+def test_allocate_local_cross():
+    ranks = hosts.allocate(hosts.parse_hosts("h1:2,h2:2"), 4)
+    assert [(r.rank, r.hostname, r.local_rank, r.cross_rank)
+            for r in ranks] == [
+        (0, "h1", 0, 0), (1, "h1", 1, 0), (2, "h2", 0, 1), (3, "h2", 1, 1)]
+    assert all(r.local_size == 2 and r.cross_size == 2 for r in ranks)
+
+
+def test_allocate_uneven_cross_sets():
+    ranks = hosts.allocate(hosts.parse_hosts("h1:3,h2:1"), 4)
+    # local_rank 0 exists on both hosts; local ranks 1,2 only on h1
+    r3 = ranks[3]
+    assert r3.hostname == "h2" and r3.local_rank == 0 and r3.cross_size == 2
+    assert ranks[1].cross_size == 1  # local_rank 1 only on h1
+
+
+def test_allocate_overflow_raises():
+    with pytest.raises(ValueError, match="exceeds"):
+        hosts.allocate(hosts.parse_hosts("h1:2"), 4)
+
+
+def test_args_to_env_mapping():
+    args = build_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "3.5",
+         "--timeline-filename", "/tmp/tl.json", "--autotune", "--",
+         "python", "x.py"])
+    env = config_parser.env_from_config(None, args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "3.5"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+
+
+def test_config_yaml(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        fusion-threshold-mb: 16
+        cycle-time-ms: 2.0
+        timeline:
+            filename: /tmp/t2.json
+            mark-cycles: true
+        autotune:
+            enabled: true
+    """))
+    env = config_parser.env_from_config(str(cfg))
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.0"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t2.json"
+    assert env["HOROVOD_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+
+
+def test_make_rank_envs():
+    ranks = hosts.allocate(hosts.parse_hosts("localhost:2"), 2)
+    envs = make_rank_envs(ranks, "127.0.0.1:1234", "127.0.0.1:9",
+                          "sec", {"HOROVOD_CYCLE_TIME": "5"})
+    assert envs[0]["HVD_PROCESS_ID"] == "0"
+    assert envs[1]["HVD_PROCESS_ID"] == "1"
+    assert envs[0]["HVD_NUM_PROCS"] == "2"
+    assert envs[0]["HVD_COORDINATOR_ADDR"] == "127.0.0.1:1234"
+    assert envs[1]["HOROVOD_CYCLE_TIME"] == "5"
+
+
+def test_kv_store_roundtrip():
+    secret = rendezvous.make_secret()
+    srv = rendezvous.KVStoreServer(secret).start()
+    try:
+        c = rendezvous.KVStoreClient(f"127.0.0.1:{srv.port}", secret)
+        c.put("scope", "key", b"value")
+        assert c.get("scope", "key") == b"value"
+        assert c.get("scope", "missing") is None
+        # bad secret rejected
+        bad = rendezvous.KVStoreClient(f"127.0.0.1:{srv.port}", "wrong")
+        with pytest.raises(Exception):
+            bad.put("scope", "key2", b"x")
+    finally:
+        srv.stop()
+
+
+def _worker_allreduce():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    out = hvd.allreduce(np.full((4,), float(hvd.rank() + 1), np.float32),
+                        name="mp", op=hvd.Sum)
+    return (hvd.rank(), hvd.size(), [float(x) for x in np.asarray(out)])
+
+
+@pytest.mark.integration
+def test_run_func_two_processes():
+    """Real 2-process launch: jax.distributed rendezvous + cross-process
+    allreduce through the multiprocess engine (test_interactiverun parity)."""
+    from horovod_tpu.run.api import run
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        # each worker: CPU platform, own pair of virtual devices
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        # workers must be able to import this test module to unpickle fn
+        "PYTHONPATH": os.pathsep.join([os.path.dirname(here), here]),
+    }
+    results = run(_worker_allreduce, np=2, env=env, start_timeout=120)
+    assert results[0][:2] == (0, 2)
+    assert results[1][:2] == (1, 2)
+    assert results[0][2] == [3.0, 3.0, 3.0, 3.0]
+    assert results[1][2] == [3.0, 3.0, 3.0, 3.0]
+
+
+@pytest.mark.integration
+def test_hvdrun_cli_smoke(tmp_path):
+    """hvdrun CLI end-to-end on 2 local ranks."""
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import sys
+        sys.path.insert(0, %r)
+        import horovod_tpu as hvd
+        hvd.init()
+        out = hvd.allreduce(np.ones((2,), np.float32), name="cli",
+                            op=hvd.Sum)
+        print("RANK", hvd.rank(), "OUT", float(np.asarray(out)[0]))
+    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "hvdrun"), "-np", "2",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OUT 2.0" in r.stdout
+    assert "[0]<stdout>" in r.stdout and "[1]<stdout>" in r.stdout
