@@ -1,0 +1,19 @@
+"""Parallelism beyond DP: sequence/context parallelism and two-level
+collectives (extensions over the DP-only reference; SURVEY §5)."""
+
+from .hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
+    make_hierarchical_allreduce,
+    make_two_level_mesh,
+)
+from .ring_attention import (  # noqa: F401
+    make_ring_attention,
+    reference_attention,
+    ring_attention,
+)
+from .sequence import (  # noqa: F401
+    heads_to_seq,
+    make_ulysses_attention,
+    seq_to_heads,
+    ulysses_attention,
+)

@@ -1,0 +1,129 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+No reference counterpart — Horovod 0.18.2 is data-parallel only (SURVEY §5
+"Long-context: absent") — but long-context sequence parallelism is first-class
+in this framework. Design follows the blockwise ring-attention construction
+(Liu et al., "Ring Attention with Blockwise Transformers"; see PAPERS.md):
+
+  * Q, K, V are sharded on the sequence axis across the ``sp`` mesh axis.
+  * Each step computes a flash-style partial attention (running max ``m``,
+    normalizer ``l``, accumulator ``o``) against the currently-held K/V block,
+    then rotates K/V one hop around the ring with ``lax.ppermute`` — the
+    collective rides ICI neighbor links, overlapping compute with transfer
+    (XLA schedules the ppermute DMA alongside the matmuls).
+  * After ``sp`` steps every query block has attended to every key block;
+    memory per chip stays O(T/sp · T/sp) instead of O(T²).
+
+Causal masking uses global positions derived from each block's ring origin, so
+the result matches full causal attention exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def _block_attn(q, k, v, m, l, o, q_off, k_off, causal, scale):
+    """One flash-accumulation step of q against the (k, v) block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; m/l: [B, H, Tq]; o like q (f32).
+    """
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)  # [B,H,Tq,Tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(tq)
+        kpos = k_off + jnp.arange(tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows: exp(-inf - -inf) etc.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(jnp.where(jnp.isneginf(s), -jnp.inf, s - m_safe[..., None]))
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact (flash-accumulated) attention across a sequence-sharded ring.
+
+    Call inside ``shard_map`` with q/k/v sharded on dim 1 (sequence) over
+    ``axis_name``. Shapes per shard: ``[batch, seq/sp, heads, head_dim]``.
+    Returns the attention output in the input dtype, same sharding.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    q_off = my * t
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        m, l, o, k_cur, v_cur = carry
+        # block currently held arrived from rank (my - i) mod n
+        src = (my - i) % n
+        k_off = src * t
+        m, l, o = _block_attn(q, k_cur, v_cur, m, l, o, q_off, k_off,
+                              causal, scale)
+        # rotate K/V to the next rank
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    # blocks 0..n-2 rotate; the final block is processed outside the loop so
+    # no wasted ppermute pair trails the last compute step
+    m, l, o, k_last, v_last = lax.fori_loop(0, n - 1, body,
+                                            (m0, l0, o0, k, v))
+    src = (my - (n - 1)) % n
+    m, l, o = _block_attn(q, k_last, v_last, m, l, o, q_off, src * t,
+                          causal, scale)
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = False):
+    """Jitted ring attention over ``mesh``: takes global [B, T, H, D] arrays
+    sharded on T and returns the same."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name)
+
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Plain full attention (for tests / single-device fallback)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

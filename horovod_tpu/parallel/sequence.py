@@ -1,0 +1,63 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+No reference counterpart (Horovod 0.18.2 is DP-only); this implements the
+DeepSpeed-Ulysses construction on XLA collectives: attention needs full
+sequence per head, so before attention an all-to-all converts
+sequence-sharding into head-sharding (each device gets ALL tokens for H/sp
+heads), and after attention a second all-to-all converts back. Both
+all-to-alls ride ICI via ``lax.all_to_all`` inside ``shard_map``.
+
+Use when head count >= sp size; for longer-than-heads scaling use
+:mod:`ring_attention`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+
+def seq_to_heads(x, axis_name: str = "sp"):
+    """[B, T/sp, H, D] → [B, T, H/sp, D]: gather sequence, scatter heads."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name: str = "sp"):
+    """[B, T, H/sp, D] → [B, T/sp, H, D]: inverse reshard."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      attn_fn: Optional[Callable] = None):
+    """Attention over sequence-sharded q/k/v ([B, T/sp, H, D] per shard) via
+    the Ulysses two-all-to-all pattern. ``attn_fn(q, k, v, causal=...)``
+    computes full attention on [B, T, H/sp, D] (default: exact softmax
+    attention)."""
+    from .ring_attention import reference_attention
+
+    attn_fn = attn_fn or reference_attention
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    oh = attn_fn(qh, kh, vh, causal=causal)
+    return heads_to_seq(oh, axis_name)
+
+
+def make_ulysses_attention(mesh, axis_name: str = "sp",
+                           causal: bool = False):
+    """Jitted Ulysses attention over ``mesh``: global [B, T, H, D] sharded on
+    T in, same out. Requires H % sp == 0."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return jax.jit(fn)
