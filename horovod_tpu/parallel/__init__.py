@@ -5,6 +5,7 @@ from .hierarchical import (  # noqa: F401
     hierarchical_allreduce,
     make_hierarchical_allreduce,
     make_two_level_mesh,
+    stack_contributions,
 )
 from .ring_attention import (  # noqa: F401
     make_ring_attention,

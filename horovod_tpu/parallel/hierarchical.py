@@ -21,51 +21,86 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
-
 import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_two_level_mesh(ici_size: Optional[int] = None,
                         devices=None) -> Mesh:
-    """Build a ("dcn", "ici") mesh: ici = devices per slice (defaults to the
-    devices of one process = one host's chips), dcn = slices."""
+    """Build a ("dcn", "ici") mesh. Each ici row is one process's devices
+    (one host's chips — ICI domain); rows are hosts (DCN domain). Device
+    order from ``jax.devices()`` is NOT assumed process-contiguous — rows are
+    built from explicit per-process grouping. Pass ``ici_size`` to subdivide
+    differently (must evenly divide each process's device count)."""
     devices = list(devices) if devices is not None else list(jax.devices())
+    per_proc = {}
+    for d in devices:
+        per_proc.setdefault(d.process_index, []).append(d)
+    groups = [per_proc[k] for k in sorted(per_proc)]
     if ici_size is None:
-        per_proc = {}
-        for d in devices:
-            per_proc.setdefault(d.process_index, []).append(d)
-        ici_size = len(next(iter(per_proc.values())))
-    n = len(devices)
-    assert n % ici_size == 0, (n, ici_size)
-    arr = np.asarray(devices).reshape(n // ici_size, ici_size)
-    return Mesh(arr, ("dcn", "ici"))
+        ici_size = len(groups[0])
+    rows = []
+    for g in groups:
+        if len(g) % ici_size != 0:
+            raise ValueError(
+                f"process owns {len(g)} devices, not divisible by "
+                f"ici_size={ici_size}")
+        for i in range(0, len(g), ici_size):
+            rows.append(g[i:i + ici_size])
+    return Mesh(np.asarray(rows, dtype=object), ("dcn", "ici"))
 
 
 def hierarchical_allreduce(x, ici_axis: str = "ici", dcn_axis: str = "dcn",
                            average: bool = False):
     """reduce_scatter(ICI) → allreduce(DCN) → all_gather(ICI), the
     NCCLHierarchicalAllreduce decomposition. Call inside shard_map over a
-    two-axis mesh. ``x`` must have dim 0 divisible by the ici axis size
-    (the reference pads to fp64-worst-case divisibility,
-    nccl_operations.cc:198-204; here the caller pads)."""
+    two-axis mesh with ``x`` = this device's same-shaped contribution.
+    Dim 0 is padded to ici-divisibility internally (the reference pads to
+    fp64-worst-case divisibility, nccl_operations.cc:198-204)."""
+    ici = lax.psum(1, ici_axis)
+    d0 = x.shape[0]
+    pad = (-d0) % ici
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
     scattered = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
     reduced = lax.psum(scattered, dcn_axis)
     out = lax.all_gather(reduced, ici_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:d0]
     if average:
-        n = lax.psum(1, ici_axis) * lax.psum(1, dcn_axis)
+        n = ici * lax.psum(1, dcn_axis)
         out = out / jnp.asarray(n, out.dtype)
     return out
 
 
 def make_hierarchical_allreduce(mesh: Mesh, average: bool = False):
-    """Jitted two-level allreduce: every device holds the full (replicated)
-    reduced array afterwards."""
+    """Jitted two-level allreduce of PER-DEVICE contributions.
+
+    Input: a global array of shape ``[n_devices, ...]`` sharded on dim 0 over
+    both mesh axes — row i is device i's contribution. Output: the full
+    reduction, replicated on every device (shape ``[...]``).
+    """
     dcn_axis, ici_axis = mesh.axis_names
 
-    fn = jax.shard_map(
-        functools.partial(hierarchical_allreduce, ici_axis=ici_axis,
-                          dcn_axis=dcn_axis, average=average),
-        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
+    def body(x):  # x: [1, ...] — this device's row
+        return hierarchical_allreduce(x[0], ici_axis=ici_axis,
+                                      dcn_axis=dcn_axis, average=average)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=P((dcn_axis, ici_axis)), out_specs=P(),
+                       check_vma=False)
     return jax.jit(fn)
+
+
+def stack_contributions(mesh: Mesh, arrays):
+    """Helper: place per-device host arrays as the sharded [n, ...] input of
+    :func:`make_hierarchical_allreduce` (device i gets ``arrays[i]``)."""
+    devs = list(mesh.devices.flat)
+    assert len(arrays) == len(devs)
+    shards = [jax.device_put(np.asarray(a)[None], d)
+              for a, d in zip(arrays, devs)]
+    shape = (len(devs),) + tuple(np.shape(arrays[0]))
+    sharding = NamedSharding(mesh, P(mesh.axis_names))
+    return jax.make_array_from_single_device_arrays(shape, sharding, shards)

@@ -75,23 +75,24 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(i, carry):
-        m, l, o, k_cur, v_cur = carry
+        m, l, o, kv_cur = carry
         # block currently held arrived from rank (my - i) mod n
         src = (my - i) % n
         k_off = src * t
-        m, l, o = _block_attn(q, k_cur, v_cur, m, l, o, q_off, k_off,
+        m, l, o = _block_attn(q, kv_cur[0], kv_cur[1], m, l, o, q_off, k_off,
                               causal, scale)
-        # rotate K/V to the next rank
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return m, l, o, k_nxt, v_nxt
+        # rotate K and V to the next rank as ONE stacked buffer: a single
+        # collective launch per hop, one large DMA for XLA to overlap with
+        # the block matmuls
+        kv_nxt = lax.ppermute(kv_cur, axis_name, perm)
+        return m, l, o, kv_nxt
 
+    kv0 = jnp.stack([k, v])
     # blocks 0..n-2 rotate; the final block is processed outside the loop so
-    # no wasted ppermute pair trails the last compute step
-    m, l, o, k_last, v_last = lax.fori_loop(0, n - 1, body,
-                                            (m0, l0, o0, k, v))
+    # no wasted ppermute trails the last compute step
+    m, l, o, kv_last = lax.fori_loop(0, n - 1, body, (m0, l0, o0, kv0))
     src = (my - (n - 1)) % n
-    m, l, o = _block_attn(q, k_last, v_last, m, l, o, q_off, src * t,
+    m, l, o = _block_attn(q, kv_last[0], kv_last[1], m, l, o, q_off, src * t,
                           causal, scale)
     l_safe = jnp.where(l == 0, 1.0, l)
     out = o / l_safe.transpose(0, 2, 1)[..., None]

@@ -1,0 +1,317 @@
+"""PyTorch binding surface — `horovod.torch` parity on the TPU-native engine.
+
+Reference parity: `horovod/torch/__init__.py` + `torch/mpi_ops.py`:
+  * ``allreduce[_async][_]``, ``allgather[_async]``, ``broadcast[_async][_]``,
+    ``alltoall``, ``poll``, ``synchronize``, ``join`` (`torch/mpi_ops.py`).
+  * ``DistributedOptimizer`` — per-parameter hooks fire async allreduce during
+    backward; ``synchronize()`` drains before ``step()``;
+    ``backward_passes_per_step`` accumulation; ``skip_synchronize``
+    (`torch/__init__.py:115-209`).
+  * ``broadcast_parameters`` (:437-466), ``broadcast_optimizer_state``
+    (:469-585), ``Compression`` (`torch/compression.py`).
+
+Torch tensors live on CPU (no CUDA in this build); the collective executes on
+the TPU/device mesh through the shared engine — the torch<->engine boundary is
+a zero-copy numpy view where possible, matching the reference's adapter layer
+(`torch/adapter_v2.cc`) in role.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .. import basics
+from ..basics import (  # noqa: F401  (re-exported API surface)
+    Adasum,
+    Average,
+    Sum,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..exceptions import HorovodInternalError  # noqa: F401
+from ..ops import collective_ops as _ops
+from .compression import Compression  # noqa: F401
+
+
+def _require_torch():
+    import torch
+
+    return torch
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy()
+
+
+def _from_result(result, like):
+    torch = _require_torch()
+    arr = np.asarray(result)
+    return torch.from_numpy(arr.copy()).to(like.dtype)
+
+
+# ------------------------------------------------------------- collectives
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[int] = None) -> int:
+    op = _resolve_op(average, op)
+    return _ops.allreduce_async(_to_numpy(tensor), name=name, op=op)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=Compression.none,
+              op: Optional[int] = None):
+    """Returns a NEW tensor with the averaged/summed value
+    (`torch/mpi_ops.py:133-168`)."""
+    op_ = _resolve_op(average, op)
+    comp, ctx = compression.compress(tensor)
+    handle = allreduce_async(comp, name=name, op=op_)
+    out = _from_result(_ops.synchronize(handle), comp)
+    return compression.decompress(out, ctx)
+
+
+def allreduce_async_(tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[int] = None) -> int:
+    """In-place async allreduce: result is copied back into ``tensor`` at
+    synchronize time (`torch/mpi_ops.py:170-205` inplace semantics)."""
+    h = allreduce_async(tensor, average=average, name=name, op=op)
+    _INPLACE_TARGETS[h] = tensor
+    return h
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[int] = None):
+    return synchronize(allreduce_async_(tensor, average=average, name=name,
+                                        op=op))
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    return _ops.allgather_async(_to_numpy(tensor), name=name)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return _from_result(_ops.synchronize(allgather_async(tensor, name=name)),
+                        tensor)
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
+    return _ops.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    return _from_result(
+        _ops.synchronize(broadcast_async(tensor, root_rank, name=name)),
+        tensor)
+
+
+def broadcast_async_(tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    h = broadcast_async(tensor, root_rank, name=name)
+    _INPLACE_TARGETS[h] = tensor
+    return h
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
+    return synchronize(broadcast_async_(tensor, root_rank, name=name))
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    return _from_result(
+        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor), name=name)),
+        tensor)
+
+
+_INPLACE_TARGETS: Dict[int, Any] = {}
+
+
+def poll(handle: int) -> bool:
+    return _ops.poll(handle)
+
+
+def synchronize(handle: int):
+    """Blocks; for in-place ops copies the result back into the original
+    tensor and returns it."""
+    result = _ops.synchronize(handle)
+    target = _INPLACE_TARGETS.pop(handle, None)
+    if target is not None:
+        torch = _require_torch()
+        arr = np.asarray(result)
+        with torch.no_grad():
+            target.copy_(torch.from_numpy(arr.copy()).to(target.dtype))
+        return target
+    return result
+
+
+def join() -> int:
+    return _ops.join()
+
+
+def _resolve_op(average: Optional[bool], op: Optional[int]) -> int:
+    # reference deprecation dance (torch/mpi_ops.py:90-130): average kw wins
+    # if given; default Average
+    if average is not None:
+        return Average if average else Sum
+    return Average if op is None else op
+
+
+# ------------------------------------------------------- parameter broadcast
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a ``model.state_dict()`` or named-parameter
+    iterable (`torch/__init__.py:437-466`)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        handles.append(broadcast_async_(p.data if hasattr(p, "data") else p,
+                                        root_rank, name=f"bp.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """In-place broadcast of optimizer state incl. scalar hyper-state wrapped
+    into tensors (`torch/__init__.py:469-585`)."""
+    torch = _require_torch()
+    state_dict = optimizer.state_dict()
+
+    # scalar-wrapping: non-tensor leaves are broadcast as 0-d tensors and cast
+    # back (the reference's _create_callback machinery, :497-560)
+    scalars: List[Tuple[str, Any]] = []
+    tensors: List[Tuple[str, Any]] = []
+    for gi, group_state in enumerate(state_dict.get("state", {}).items()):
+        pid, pstate = group_state
+        for k, v in sorted(pstate.items()):
+            key = f"opt.{pid}.{k}"
+            if torch.is_tensor(v):
+                tensors.append((key, v))
+            else:
+                scalars.append((key, v))
+    handles = [broadcast_async_(t, root_rank, name=n) for n, t in tensors]
+    for h in handles:
+        synchronize(h)
+    if scalars:
+        from ..optim.broadcast import broadcast_object
+
+        synced = broadcast_object([v for _, v in scalars], root_rank,
+                                  name="opt.scalars")
+        it = iter(synced)
+        for (key, _), new in zip(scalars, it):
+            pid_s, k = key.split(".")[1:]
+            state_dict["state"][int(pid_s) if pid_s.isdigit() else pid_s][k] \
+                = new
+        optimizer.load_state_dict(state_dict)
+
+
+# ----------------------------------------------------- DistributedOptimizer
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: per-parameter backward hooks fire async
+    allreduce; ``step()`` drains handles first (`torch/__init__.py:115-209`)."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1, op: int = Average):
+        torch = _require_torch()
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        self._counts: Dict[str, int] = {}
+        self._handles: Dict[str, int] = {}
+        self._ctxs: Dict[str, Any] = {}
+        self._should_sync = True
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, g in enumerate(optimizer.param_groups)
+                     for j, p in enumerate(g["params"])]
+        dups = {n for n in (x[0] for x in named)
+                if [x[0] for x in named].count(n) > 1}
+        if dups:
+            raise ValueError(f"duplicate parameter names: {sorted(dups)} "
+                             "(namedparameters must be unique, "
+                             "torch/__init__.py:93-105)")
+        self._named = named
+        self._name_of = {p: n for n, p in named}
+        if basics.size() > 1:
+            for name, p in named:
+                if p.requires_grad:
+                    self._register_hook(name, p)
+
+    def _register_hook(self, name, p):
+        # post-accumulate hook = the grad-accumulator hook of the reference
+        # (`torch/__init__.py:115-150`)
+        def hook(param):
+            self._counts[name] = self._counts.get(name, 0) + 1
+            if self._counts[name] == self.backward_passes_per_step:
+                self._counts[name] = 0
+                grad = param.grad
+                if self.backward_passes_per_step > 1:
+                    grad = grad / self.backward_passes_per_step
+                comp, ctx = self._compression.compress(grad)
+                self._handles[name] = _ops.allreduce_async(
+                    _to_numpy(comp), name=f"grad.{name}", op=self._op)
+                self._ctxs[name] = (ctx, param)
+
+        p.register_post_accumulate_grad_hook(hook)
+
+    def synchronize(self) -> None:
+        """Drain outstanding gradient allreduces into .grad
+        (`torch/__init__.py:152-169`)."""
+        torch = _require_torch()
+        for name, h in list(self._handles.items()):
+            out = _ops.synchronize(h)
+            ctx, param = self._ctxs.pop(name)
+            arr = np.asarray(out)
+            t = torch.from_numpy(arr.copy())
+            t = self._compression.decompress(t, ctx)
+            with torch.no_grad():
+                param.grad.copy_(t.to(param.grad.dtype))
+        self._handles.clear()
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """(`torch/__init__.py:171-189`) — use after a manual synchronize()
+        (e.g. for gradient clipping) so step() doesn't re-drain."""
+        self._should_sync = False
+        try:
+            yield
+        finally:
+            self._should_sync = True
+
+    def step(self, closure=None):
+        if self._should_sync and basics.size() > 1:
+            self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **k):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize()")
+        return self._opt.zero_grad(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: int = Average):
+    return _DistributedOptimizer(optimizer, named_parameters, compression,
+                                 backward_passes_per_step, op)

@@ -1,0 +1,63 @@
+"""Torch-native gradient compression (`horovod/torch/compression.py` parity).
+
+The shared :mod:`horovod_tpu.ops.compression` operates on JAX arrays; torch
+tensors carry torch dtypes, so the torch surface gets its own compressor pair
+exactly as the reference splits `tensorflow/compression.py` /
+`torch/compression.py`.
+"""
+
+from __future__ import annotations
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.half(), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else tensor.to(ctx)
+
+
+class BF16Compressor(Compressor):
+    """TPU-native 16-bit wire format (fp32 exponent range)."""
+
+    @staticmethod
+    def compress(tensor):
+        import torch
+
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else tensor.to(ctx)
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -65,24 +65,28 @@ def test_ulysses_attention_matches_full(causal):
 
 def test_hierarchical_allreduce_matches_psum():
     import jax
-    import jax.numpy as jnp
 
     from horovod_tpu.parallel.hierarchical import (
-        hierarchical_allreduce, make_hierarchical_allreduce,
-        make_two_level_mesh)
+        make_hierarchical_allreduce, make_two_level_mesh,
+        stack_contributions)
 
     hvd.init()
     mesh = make_two_level_mesh(ici_size=4)  # 2 "slices" x 4 "chips"
     assert mesh.axis_names == ("dcn", "ici")
 
-    x = jnp.asarray(np.random.RandomState(0).randn(8, 6).astype(np.float32))
+    rng = np.random.RandomState(0)
+    # DISTINCT per-device contributions, dim0=7 exercises the ici padding
+    contribs = [rng.randn(7, 6).astype(np.float32) for _ in range(8)]
+    g = stack_contributions(mesh, contribs)
     fn = make_hierarchical_allreduce(mesh)
-    out = np.asarray(fn(x))
-    # every replica contributed the same x (replicated input) -> 8x
-    np.testing.assert_allclose(out, np.asarray(x) * 8, rtol=1e-5)
+    out = np.asarray(fn(g))
+    np.testing.assert_allclose(out, np.sum(contribs, axis=0), rtol=1e-4,
+                               atol=1e-5)
 
     favg = make_hierarchical_allreduce(mesh, average=True)
-    np.testing.assert_allclose(np.asarray(favg(x)), np.asarray(x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(favg(g)),
+                               np.mean(contribs, axis=0), rtol=1e-4,
+                               atol=1e-5)
 
 
 def test_ring_attention_long_sequence_memory_shape():

@@ -1,0 +1,153 @@
+"""PyTorch binding tests.
+
+Parity model: `test/test_torch.py` — op matrix, inplace variants, optimizer
+hook flow, parameter/optimizer-state broadcast, duplicate names, grad
+clipping with synchronize/skip_synchronize."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd  # noqa: E402
+from horovod_tpu import testing  # noqa: E402
+
+
+def test_torch_allreduce():
+    def fn():
+        r = hvd.rank()
+        t = torch.full((3, 2), float(r + 1))
+        out = hvd.allreduce(t, name="t_ar", op=hvd.Sum)
+        assert torch.allclose(out, torch.full((3, 2), 3.0))
+        assert torch.allclose(t, torch.full((3, 2), float(r + 1)))  # unchanged
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_allreduce_inplace_average():
+    def fn():
+        r = hvd.rank()
+        t = torch.full((4,), float(r))
+        out = hvd.allreduce_(t, name="t_ar_")
+        assert out is t
+        assert torch.allclose(t, torch.full((4,), 1.5))
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_torch_allgather_broadcast():
+    def fn():
+        r = hvd.rank()
+        g = hvd.allgather(torch.full((2, 2), float(r)), name="t_ag")
+        assert g.shape == (4, 2)
+        assert torch.allclose(g[2:], torch.full((2, 2), 1.0))
+        b = hvd.broadcast(torch.full((2,), float(r * 5)), root_rank=1,
+                          name="t_bc")
+        assert torch.allclose(b, torch.full((2,), 5.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_distributed_optimizer_training():
+    """Hook-driven gradient allreduce: both ranks end with identical weights
+    and the gradient equals the cross-rank average."""
+
+    def fn():
+        r = hvd.rank()
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 2)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        rng = np.random.RandomState(100 + r)
+        for step in range(10):
+            opt.zero_grad()
+            x = torch.from_numpy(rng.randn(8, 4).astype(np.float32))
+            y = x @ torch.tensor([[1., 0], [0, 1], [1, 1], [0, 0]])
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+        return model.weight.detach().numpy().copy()
+
+    res = testing.run_cluster(fn, np=2)
+    np.testing.assert_array_equal(res[0], res[1])
+
+
+def test_torch_optimizer_state_broadcast():
+    def fn():
+        r = hvd.rank()
+        model = torch.nn.Linear(2, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        # build momentum state with rank-divergent values
+        (model(torch.full((1, 2), float(r + 1))).sum()).backward()
+        opt.step()
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        buf = opt.state_dict()["state"][0]["momentum_buffer"]
+        return buf.numpy().copy()
+
+    res = testing.run_cluster(fn, np=2)
+    np.testing.assert_array_equal(res[0], res[1])
+
+
+def test_torch_zero_grad_misuse_raises():
+    def fn():
+        if hvd.size() != 2:
+            return True
+        model = torch.nn.Linear(2, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        model(torch.ones(1, 2)).sum().backward()
+        import time
+        time.sleep(0.05)  # let hooks enqueue
+        with pytest.raises(AssertionError):
+            opt.zero_grad()
+        opt.synchronize()
+        opt._opt.step()
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_duplicate_named_parameters_rejected():
+    def fn():
+        model = torch.nn.Linear(2, 1)
+        params = list(model.named_parameters())
+        dup = params + [params[0]]
+        with pytest.raises(ValueError, match="[Dd]uplicate"):
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=dup)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_skip_synchronize_grad_clipping():
+    """The reference's grad-clipping pattern (`test_torch.py:1356`):
+    synchronize manually, clip, then step inside skip_synchronize."""
+
+    def fn():
+        r = hvd.rank()
+        model = torch.nn.Linear(2, 1, bias=False)
+        with torch.no_grad():
+            model.weight.fill_(1.0)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0),
+            named_parameters=model.named_parameters())
+        out = model(torch.full((1, 2), float(10 * (r + 1))))
+        out.sum().backward()
+        opt.synchronize()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 0.5)
+        with opt.skip_synchronize():
+            opt.step()
+        return model.weight.detach().numpy().copy()
+
+    res = testing.run_cluster(fn, np=2)
+    np.testing.assert_array_equal(res[0], res[1])
+    # gradient was clipped to norm 0.5 -> weight moved by at most 0.5
+    assert np.all(np.abs(res[0] - 1.0) <= 0.5 + 1e-6)
