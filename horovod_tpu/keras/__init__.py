@@ -1,0 +1,115 @@
+"""Keras-surface parity for the TPU framework.
+
+Reference parity: `horovod/keras/__init__.py` (150 LoC) and
+`horovod/_keras/__init__.py` (127 LoC). The reference wraps a Keras
+optimizer so `get_gradients` allreduces before applying
+(`_keras/__init__.py:35-63`), re-exports the collective ops and basics, and
+`load_model` re-wraps the deserialized optimizer in a DistributedOptimizer
+(`keras/__init__.py:111-127`, `_keras/__init__.py:111-127`).
+
+On TPU the "Keras model" is a flax module + an optax optimizer; this module
+maps the same surface onto that world:
+
+  * ``DistributedOptimizer(tx)`` — optax GradientTransformation wrapper that
+    allreduces gradients before the inner update (same object as
+    ``horovod_tpu.DistributedOptimizer``; re-exported here so
+    ``hvd.keras.DistributedOptimizer`` reads like the reference).
+  * ``broadcast_global_variables(state, root_rank)`` — rank-0 state sync
+    (`keras/__init__.py:75-83`).
+  * ``save_model`` / ``load_model`` — msgpack (flax.serialization) round-trip
+    of ``{"params", "opt_state"}``; ``load_model`` re-wraps the optimizer.
+  * ``callbacks`` — BroadcastGlobalVariablesCallback, MetricAverageCallback,
+    LearningRateScheduleCallback, LearningRateWarmupCallback.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .. import basics
+from ..basics import (  # noqa: F401  (reference re-exports `keras/__init__.py:20-46`)
+    Adasum,
+    Average,
+    Sum,
+    init,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from ..ops.collective_ops import allgather, allreduce, broadcast  # noqa: F401
+from ..ops.compression import Compression  # noqa: F401
+from ..optim.broadcast import broadcast_optimizer_state, broadcast_parameters
+from ..optim.distributed import DistributedOptimizer  # noqa: F401
+from . import callbacks  # noqa: F401
+
+
+def broadcast_global_variables(state: Dict[str, Any], root_rank: int = 0):
+    """Broadcast a training-state dict (``params`` + optional ``opt_state``)
+    from ``root_rank`` to all ranks (`keras/__init__.py:75-83`).
+
+    Returns the state dict with synced values (functional: caller rebinds).
+    """
+    out = dict(state)
+    if "params" in out:
+        out["params"] = broadcast_parameters(out["params"], root_rank)
+    if "opt_state" in out and out["opt_state"] is not None:
+        out["opt_state"] = broadcast_optimizer_state(out["opt_state"],
+                                                     root_rank)
+    return out
+
+
+def save_model(path: str, params, opt_state=None, extra: Optional[dict] = None):
+    """Serialize training state to ``path`` (msgpack via flax.serialization).
+
+    The reference pattern is rank-0 saves, everyone restores-then-broadcasts
+    (SURVEY §5 checkpoint/resume); this helper is the save half. Only rank 0
+    writes; other ranks no-op.
+    """
+    if basics.is_initialized() and basics.rank() != 0:
+        return
+    from flax import serialization
+
+    payload = {"params": params,
+               "opt_state": opt_state if opt_state is not None else {},
+               "extra": extra or {}}
+    data = serialization.to_bytes(payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def load_model(path: str, template: Dict[str, Any], tx=None,
+               compression=Compression.none, broadcast: bool = True):
+    """Deserialize training state and re-wrap the optimizer, the
+    `keras/__init__.py:111-127` flow: load → wrap optimizer in
+    DistributedOptimizer → broadcast so every rank starts identical.
+
+    ``template`` is a dict with the same structure as what ``save_model``
+    wrote (``{"params": ..., "opt_state": ...}``) used as the
+    deserialization target. Returns ``(state_dict, wrapped_tx)`` where
+    ``wrapped_tx`` is ``DistributedOptimizer(tx)`` (or None if no ``tx``).
+    """
+    from flax import serialization
+
+    tmpl_opt = template.get("opt_state")
+    # {} is the "absent" marker save_model writes; a present-but-falsy optax
+    # state (e.g. EmptyState()) must NOT be treated as absent
+    has_opt = tmpl_opt is not None and not (
+        isinstance(tmpl_opt, dict) and not tmpl_opt)
+    target = {"params": template["params"],
+              "opt_state": tmpl_opt if has_opt else {},
+              "extra": template.get("extra") or {}}
+    with open(path, "rb") as f:
+        state = serialization.from_bytes(target, f.read())
+    if broadcast and basics.is_initialized() and basics.size() > 1:
+        state["params"] = broadcast_parameters(state["params"], 0)
+        if has_opt:
+            state["opt_state"] = broadcast_optimizer_state(state["opt_state"], 0)
+    wrapped = DistributedOptimizer(tx, compression=compression) \
+        if tx is not None else None
+    return state, wrapped

@@ -1,0 +1,15 @@
+"""Keras-namespace callbacks (`horovod/keras/callbacks.py` parity).
+
+The reference's ``horovod.keras.callbacks`` module re-exports the shared
+implementations from ``horovod/_keras/callbacks.py``; same shape here — the
+framework-agnostic implementations live in ``horovod_tpu.callbacks``.
+"""
+
+from ..callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    CallbackList,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)

@@ -79,9 +79,11 @@ class DistributedOptimizer:
     def update(self, grads, state, params=None):
         # Local accumulation first, ONE communication every k micro-steps —
         # that is the point of backward_passes_per_step
-        # (`torch/__init__.py:171-189`). Stable tensor names across steps
-        # (like torch parameter names); safe because the communicating step
-        # drains all handles before returning.
+        # (`torch/__init__.py:171-189`). The raw accumulated SUM goes on the
+        # wire — the reference does not divide by the pass count; users scale
+        # their loss. Stable tensor names across steps (like torch parameter
+        # names); safe because the communicating step drains all handles
+        # before returning.
         if self._k > 1:
             if self._acc is None:
                 self._acc = grads
@@ -91,8 +93,7 @@ class DistributedOptimizer:
             if self._micro < self._k:
                 zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
                 return zero, state
-            grads = jax.tree_util.tree_map(
-                lambda g: g / self._k, self._acc)
+            grads = self._acc
             self._acc = None
             self._micro = 0
         grads = allreduce_gradients(

@@ -85,6 +85,11 @@ def make_hierarchical_allreduce(mesh: Mesh, average: bool = False):
     dcn_axis, ici_axis = mesh.axis_names
 
     def body(x):  # x: [1, ...] — this device's row
+        if x.shape[0] != 1:
+            raise ValueError(
+                f"make_hierarchical_allreduce expects dim 0 == n_devices "
+                f"({mesh.size}); got a per-device shard of {x.shape[0]} rows "
+                "— extra rows would be silently dropped")
         return hierarchical_allreduce(x[0], ici_axis=ici_axis,
                                       dcn_axis=dcn_axis, average=average)
 

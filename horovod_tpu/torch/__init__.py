@@ -50,20 +50,38 @@ def _require_torch():
 
 
 def _to_numpy(tensor) -> np.ndarray:
+    torch = _require_torch()
+    if tensor.dtype == torch.bfloat16:
+        # numpy has no native bf16; reinterpret the bits through ml_dtypes so
+        # the wire dtype stays 16-bit (the point of Compression.bf16)
+        import ml_dtypes
+
+        return (tensor.detach().cpu().view(torch.uint16).numpy()
+                .view(ml_dtypes.bfloat16))
     return tensor.detach().cpu().numpy()
 
 
-def _from_result(result, like):
+def _result_to_torch(result, dtype):
     torch = _require_torch()
     arr = np.asarray(result)
-    return torch.from_numpy(arr.copy()).to(like.dtype)
+    if arr.dtype.name == "bfloat16":
+        t = torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
+    else:
+        t = torch.from_numpy(arr.copy())
+    return t if dtype is None else t.to(dtype)
+
+
+def _from_result(result, like):
+    return _result_to_torch(result, like.dtype)
 
 
 # ------------------------------------------------------------- collectives
 def allreduce_async(tensor, average: Optional[bool] = None,
                     name: Optional[str] = None, op: Optional[int] = None) -> int:
     op = _resolve_op(average, op)
-    return _ops.allreduce_async(_to_numpy(tensor), name=name, op=op)
+    h = _ops.allreduce_async(_to_numpy(tensor), name=name, op=op)
+    _HANDLE_DTYPES[h] = tensor.dtype
+    return h
 
 
 def allreduce(tensor, average: Optional[bool] = None,
@@ -73,8 +91,7 @@ def allreduce(tensor, average: Optional[bool] = None,
     (`torch/mpi_ops.py:133-168`)."""
     op_ = _resolve_op(average, op)
     comp, ctx = compression.compress(tensor)
-    handle = allreduce_async(comp, name=name, op=op_)
-    out = _from_result(_ops.synchronize(handle), comp)
+    out = synchronize(allreduce_async(comp, name=name, op=op_))
     return compression.decompress(out, ctx)
 
 
@@ -95,22 +112,23 @@ def allreduce_(tensor, average: Optional[bool] = None,
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:
-    return _ops.allgather_async(_to_numpy(tensor), name=name)
+    h = _ops.allgather_async(_to_numpy(tensor), name=name)
+    _HANDLE_DTYPES[h] = tensor.dtype
+    return h
 
 
 def allgather(tensor, name: Optional[str] = None):
-    return _from_result(_ops.synchronize(allgather_async(tensor, name=name)),
-                        tensor)
+    return synchronize(allgather_async(tensor, name=name))
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
-    return _ops.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+    h = _ops.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+    _HANDLE_DTYPES[h] = tensor.dtype
+    return h
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
-    return _from_result(
-        _ops.synchronize(broadcast_async(tensor, root_rank, name=name)),
-        tensor)
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
 
 
 def broadcast_async_(tensor, root_rank: int,
@@ -131,6 +149,7 @@ def alltoall(tensor, name: Optional[str] = None):
 
 
 _INPLACE_TARGETS: Dict[int, Any] = {}
+_HANDLE_DTYPES: Dict[int, Any] = {}
 
 
 def poll(handle: int) -> bool:
@@ -138,17 +157,18 @@ def poll(handle: int) -> bool:
 
 
 def synchronize(handle: int):
-    """Blocks; for in-place ops copies the result back into the original
-    tensor and returns it."""
+    """Blocks and returns a torch tensor in the submitted tensor's dtype
+    (`torch/mpi_ops.py:476-492`); for in-place ops copies the result back into
+    the original tensor and returns it."""
+    torch = _require_torch()
     result = _ops.synchronize(handle)
+    dtype = _HANDLE_DTYPES.pop(handle, None)
     target = _INPLACE_TARGETS.pop(handle, None)
     if target is not None:
-        torch = _require_torch()
-        arr = np.asarray(result)
         with torch.no_grad():
-            target.copy_(torch.from_numpy(arr.copy()).to(target.dtype))
+            target.copy_(_result_to_torch(result, target.dtype))
         return target
-    return result
+    return _result_to_torch(result, dtype)
 
 
 def join() -> int:
@@ -182,17 +202,32 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
 
 
 def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
-    """In-place broadcast of optimizer state incl. scalar hyper-state wrapped
-    into tensors (`torch/__init__.py:469-585`)."""
+    """In-place broadcast of optimizer state incl. scalar hyper-state and
+    param_groups hyperparameters (lr, momentum, ...) wrapped into a pickled
+    object broadcast (`torch/__init__.py:469-585`)."""
     torch = _require_torch()
+
+    # Checkpoint-resume pattern: rank 0 restored state, workers constructed a
+    # fresh optimizer with empty state. Materialize state on every rank with a
+    # zero-grad dummy step first (the reference's flow, torch/__init__.py:
+    # 477-493) so all ranks submit the same broadcast set — otherwise the
+    # name negotiation would wait forever on tensors only root enqueued.
+    if not optimizer.state_dict().get("state"):
+        restore = []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                restore.append((p, p.grad))
+                p.grad = torch.zeros_like(p)
+        optimizer.step()
+        for p, g in restore:
+            p.grad = g
     state_dict = optimizer.state_dict()
 
-    # scalar-wrapping: non-tensor leaves are broadcast as 0-d tensors and cast
+    # scalar-wrapping: non-tensor leaves are broadcast as objects and written
     # back (the reference's _create_callback machinery, :497-560)
     scalars: List[Tuple[str, Any]] = []
     tensors: List[Tuple[str, Any]] = []
-    for gi, group_state in enumerate(state_dict.get("state", {}).items()):
-        pid, pstate = group_state
+    for pid, pstate in state_dict.get("state", {}).items():
         for k, v in sorted(pstate.items()):
             key = f"opt.{pid}.{k}"
             if torch.is_tensor(v):
@@ -202,17 +237,22 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     handles = [broadcast_async_(t, root_rank, name=n) for n, t in tensors]
     for h in handles:
         synchronize(h)
-    if scalars:
-        from ..optim.broadcast import broadcast_object
 
-        synced = broadcast_object([v for _, v in scalars], root_rank,
-                                  name="opt.scalars")
-        it = iter(synced)
-        for (key, _), new in zip(scalars, it):
-            pid_s, k = key.split(".")[1:]
-            state_dict["state"][int(pid_s) if pid_s.isdigit() else pid_s][k] \
-                = new
-        optimizer.load_state_dict(state_dict)
+    # param_groups hyperparameters (lr, momentum, weight_decay, ...) sync too
+    # (`torch/__init__.py:560-582`); the rank-local 'params' index lists stay
+    hypers = [{k: v for k, v in g.items() if k != "params"}
+              for g in state_dict.get("param_groups", [])]
+    from ..optim.broadcast import broadcast_object
+
+    synced_scalars, synced_hypers = broadcast_object(
+        ([v for _, v in scalars], hypers), root_rank, name="opt.scalars")
+    for (key, _), new in zip(scalars, synced_scalars):
+        pid_s, k = key.split(".")[1:]
+        state_dict["state"][int(pid_s) if pid_s.isdigit() else pid_s][k] = new
+    for group, new_hyper in zip(state_dict.get("param_groups", []),
+                                synced_hypers):
+        group.update(new_hyper)
+    optimizer.load_state_dict(state_dict)
 
 
 # ----------------------------------------------------- DistributedOptimizer
@@ -239,14 +279,15 @@ class _DistributedOptimizer:
             named = [(f"param.{i}.{j}", p)
                      for i, g in enumerate(optimizer.param_groups)
                      for j, p in enumerate(g["params"])]
-        dups = {n for n in (x[0] for x in named)
-                if [x[0] for x in named].count(n) > 1}
+        import collections
+
+        counts = collections.Counter(n for n, _ in named)
+        dups = {n for n, c in counts.items() if c > 1}
         if dups:
             raise ValueError(f"duplicate parameter names: {sorted(dups)} "
                              "(namedparameters must be unique, "
                              "torch/__init__.py:93-105)")
         self._named = named
-        self._name_of = {p: n for n, p in named}
         if basics.size() > 1:
             for name, p in named:
                 if p.requires_grad:
@@ -259,10 +300,10 @@ class _DistributedOptimizer:
             self._counts[name] = self._counts.get(name, 0) + 1
             if self._counts[name] == self.backward_passes_per_step:
                 self._counts[name] = 0
-                grad = param.grad
-                if self.backward_passes_per_step > 1:
-                    grad = grad / self.backward_passes_per_step
-                comp, ctx = self._compression.compress(grad)
+                # the raw ACCUMULATED gradient goes on the wire — the
+                # reference does not divide by the pass count
+                # (`torch/__init__.py:115-150`); users scale their loss
+                comp, ctx = self._compression.compress(param.grad)
                 self._handles[name] = _ops.allreduce_async(
                     _to_numpy(comp), name=f"grad.{name}", op=self._op)
                 self._ctxs[name] = (ctx, param)
@@ -276,9 +317,7 @@ class _DistributedOptimizer:
         for name, h in list(self._handles.items()):
             out = _ops.synchronize(h)
             ctx, param = self._ctxs.pop(name)
-            arr = np.asarray(out)
-            t = torch.from_numpy(arr.copy())
-            t = self._compression.decompress(t, ctx)
+            t = self._compression.decompress(_result_to_torch(out, None), ctx)
             with torch.no_grad():
                 param.grad.copy_(t.to(param.grad.dtype))
         self._handles.clear()

@@ -129,9 +129,11 @@ def test_backward_passes_per_step():
         # first micro-step: no update applied yet (accumulating)
         np.testing.assert_allclose(np.asarray(updates["w"]), 0.0)
         updates, state = tx.update(g, state, params)
-        # second micro-step: mean of accumulated grads applied
+        # second micro-step: the raw accumulated sum (2 passes x 1.0) is
+        # allreduce-averaged across ranks — reference semantics: no division
+        # by the pass count (`torch/__init__.py:115-150`)
         np.testing.assert_allclose(np.asarray(updates["w"]),
-                                   np.full((2,), -1.0, np.float32))
+                                   np.full((2,), -2.0, np.float32))
         return True
 
     assert all(testing.run_cluster(fn, np=2))

@@ -151,3 +151,86 @@ def test_torch_skip_synchronize_grad_clipping():
     np.testing.assert_array_equal(res[0], res[1])
     # gradient was clipped to norm 0.5 -> weight moved by at most 0.5
     assert np.all(np.abs(res[0] - 1.0) <= 0.5 + 1e-6)
+
+
+def test_torch_bf16_compression_wire():
+    """Compression.bf16 must survive the torch->numpy wire (numpy has no
+    native bf16; the binding reinterprets through ml_dtypes)."""
+
+    def fn():
+        r = hvd.rank()
+        t = torch.full((8,), float(r + 1))
+        out = hvd.allreduce(t, name="t_bf16", compression=hvd.Compression.bf16)
+        assert out.dtype == torch.float32  # decompressed back
+        assert torch.allclose(out, torch.full((8,), 1.5))
+        # raw bf16 tensors also cross the wire
+        tb = torch.full((4,), float(r), dtype=torch.bfloat16)
+        ob = hvd.allreduce(tb, name="t_rawbf16", op=hvd.Sum)
+        assert ob.dtype == torch.bfloat16
+        assert torch.allclose(ob.float(), torch.full((4,), 1.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_async_synchronize_returns_tensor():
+    """synchronize() on a non-inplace handle returns a torch.Tensor in the
+    submitted dtype (`torch/mpi_ops.py:476-492`), not a raw array."""
+
+    def fn():
+        r = hvd.rank()
+        t = torch.full((3,), float(r + 1), dtype=torch.float64)
+        h = hvd.allreduce_async(t, name="t_async", op=hvd.Sum)
+        out = hvd.synchronize(h)
+        assert isinstance(out, torch.Tensor)
+        assert out.dtype == torch.float64
+        assert torch.allclose(out, torch.full((3,), 3.0, dtype=torch.float64))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_broadcast_optimizer_state_syncs_lr():
+    """param_groups hyperparameters (lr) must sync, not just state tensors
+    (`torch/__init__.py:560-582`)."""
+
+    def fn():
+        r = hvd.rank()
+        model = torch.nn.Linear(2, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1 * (r + 1),
+                              momentum=0.9, weight_decay=0.01 * r)
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        g = opt.param_groups[0]
+        return g["lr"], g["momentum"], g["weight_decay"]
+
+    res = testing.run_cluster(fn, np=2)
+    for lr, mom, wd in res:
+        assert lr == pytest.approx(0.1)
+        assert mom == pytest.approx(0.9)
+        assert wd == pytest.approx(0.0)
+
+
+def test_torch_broadcast_optimizer_state_fresh_workers():
+    """Checkpoint-resume: only rank 0 has materialized optimizer state; the
+    broadcast must materialize worker state and not deadlock
+    (`torch/__init__.py:477-493`)."""
+
+    def fn():
+        r = hvd.rank()
+        torch.manual_seed(42 + r)
+        model = torch.nn.Linear(2, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        if r == 0:  # only root takes a real step -> momentum state exists
+            model(torch.ones(1, 2)).sum().backward()
+            opt.step()
+            opt.zero_grad()
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        bufs = [v["momentum_buffer"].numpy().copy()
+                for v in opt.state_dict()["state"].values()]
+        return bufs
+
+    res = testing.run_cluster(fn, np=2)
+    assert len(res[0]) == 2  # weight + bias momentum exists everywhere
+    for b0, b1 in zip(res[0], res[1]):
+        np.testing.assert_array_equal(b0, b1)
+        assert np.any(b0 != 0)  # root's real momentum won
