@@ -104,12 +104,22 @@ def load_model(path: str, template: Dict[str, Any], tx=None,
     target = {"params": template["params"],
               "opt_state": tmpl_opt if has_opt else {},
               "extra": template.get("extra") or {}}
-    with open(path, "rb") as f:
-        state = serialization.from_bytes(target, f.read())
-    if broadcast and basics.is_initialized() and basics.size() > 1:
-        state["params"] = broadcast_parameters(state["params"], 0)
-        if has_opt:
-            state["opt_state"] = broadcast_optimizer_state(state["opt_state"], 0)
+    multi = broadcast and basics.is_initialized() and basics.size() > 1
+    if multi:
+        # only rank 0 is guaranteed to see the file (save_model writes on
+        # rank 0 only; on a multi-host pod the path may be host-local) —
+        # root reads, the bytes ride the broadcast wire
+        from ..optim.broadcast import broadcast_object
+
+        data = None
+        if basics.rank() == 0:
+            with open(path, "rb") as f:
+                data = f.read()
+        data = broadcast_object(data, 0, name="load_model.bytes")
+    else:
+        with open(path, "rb") as f:
+            data = f.read()
+    state = serialization.from_bytes(target, data)
     wrapped = DistributedOptimizer(tx, compression=compression) \
         if tx is not None else None
     return state, wrapped

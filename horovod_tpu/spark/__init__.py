@@ -1,0 +1,107 @@
+"""Spark cluster integration — ``horovod_tpu.spark.run(fn, ...)``.
+
+Reference parity: `horovod/spark/__init__.py:101-236` — `run(fn)` creates
+``num_proc`` Spark tasks, collects host hashes through driver/task services,
+then launches `mpirun` with a ``plm_rsh_agent`` that spawns orteds *inside*
+Spark executors (`spark/driver/mpirun_rsh.py`, `spark/task/mpirun_exec_fn.py`)
+and gathers per-rank results.
+
+TPU-native redesign: there is no MPI control plane to smuggle into executors —
+`jax.distributed` only needs every process to agree on a coordinator address
+and a (rank, size) assignment. Spark *barrier mode* already gives both: all
+``num_proc`` tasks run simultaneously, each knows its partition id (= rank)
+and the full task-address list, and ``BarrierTaskContext.allGather`` is the
+rendezvous. So the Spark tasks ARE the worker processes: each task sets the
+same ``HVD_*`` env the `hvdrun` launcher would inject
+(`run/launcher.py:61-78`), calls ``fn`` in-process, and results come back
+through Spark's own collect — no ssh, no rsh agent, no result KV store.
+
+Usage (driver program, e.g. a notebook)::
+
+    import horovod_tpu.spark
+    results = horovod_tpu.spark.run(train_fn, args=(lr,), num_proc=8)
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable, List, Optional
+
+from .task import make_mapper
+
+
+def _check_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark (pip install pyspark); "
+            "it is not part of the base TPU image") from e
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None, extra_env: Optional[dict] = None,
+        start_timeout: float = 600.0, verbose: bool = False) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark tasks as a distributed
+    job; returns per-rank results in rank order (`spark/__init__.py:101-236`).
+
+    Raises ``RuntimeError`` if any rank fails (first traceback included) and
+    ``TimeoutError`` if the job does not finish within ``start_timeout``
+    seconds (the reference's settings.timeout flow, `spark/__init__.py:142`).
+    """
+    _check_pyspark()
+    from pyspark import SparkContext
+
+    sc = SparkContext.getOrCreate()
+    if num_proc is None:
+        num_proc = sc.defaultParallelism
+        if verbose:
+            print(f"horovod_tpu.spark: num_proc defaulting to "
+                  f"{num_proc} (spark default parallelism)")
+
+    payload = _serialize((fn, tuple(args), dict(kwargs or {})))
+    mapper = make_mapper(payload, num_proc, dict(extra_env or {}))
+
+    rdd = sc.parallelize(range(num_proc), num_proc).barrier()
+
+    out: dict = {}
+
+    def _collect():
+        try:
+            out["results"] = rdd.mapPartitions(mapper).collect()
+        except BaseException as e:  # surfaced after join
+            out["error"] = e
+
+    t = threading.Thread(target=_collect, daemon=True)
+    t.start()
+    t.join(start_timeout if start_timeout and start_timeout > 0 else None)
+    if t.is_alive():
+        try:
+            sc.cancelAllJobs()
+        except Exception:
+            pass
+        raise TimeoutError(
+            f"horovod_tpu.spark.run timed out after {start_timeout}s waiting "
+            f"for {num_proc} tasks; is the cluster large enough for barrier "
+            "mode to schedule all of them at once?")
+    if "error" in out:
+        raise out["error"]
+
+    by_rank = sorted(out["results"], key=lambda r: r[0])
+    failures = [(rank, err) for rank, ok, err in by_rank if not ok]
+    if failures:
+        rank, err = failures[0]
+        raise RuntimeError(
+            f"{len(failures)}/{num_proc} ranks failed; first failure "
+            f"(rank {rank}):\n{err}")
+    return [pickle.loads(blob) for _, _, blob in by_rank]
+
+
+def _serialize(obj) -> bytes:
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj)
+    except ImportError:
+        return pickle.dumps(obj)

@@ -1,0 +1,79 @@
+"""Executor-side task body for :func:`horovod_tpu.spark.run`.
+
+Reference parity: `horovod/spark/task/mpirun_exec_fn.py` +
+`spark/__init__.py:36-68` (``_task_fn``) — but instead of exec-ing an orted
+under mpirun, the barrier task IS the rank process: it derives its rank
+assignment from the barrier context, performs rendezvous via ``allGather``,
+injects the `hvdrun`-style env (`run/launcher.py:61-78`), and runs the user
+function in-process.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import traceback
+from typing import Dict
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def rank_env_from_hosts(rank: int, hosts, coordinator_addr: str) -> Dict[str, str]:
+    """Compute the LOCAL/CROSS communicator split (`mpi/mpi_context.cc:150-158`
+    analogue) from the partition-ordered host list."""
+    host = hosts[rank]
+    local_rank = sum(1 for h in hosts[:rank] if h == host)
+    local_size = sum(1 for h in hosts if h == host)
+    host_order = list(dict.fromkeys(hosts))  # first-appearance order
+    cross_rank = host_order.index(host)
+    cross_size = len(host_order)
+    return {
+        "HVD_NUM_PROCS": str(len(hosts)),
+        "HVD_PROCESS_ID": str(rank),
+        "HVD_COORDINATOR_ADDR": coordinator_addr,
+        "HVD_LOCAL_RANK": str(local_rank),
+        "HVD_LOCAL_SIZE": str(local_size),
+        "HVD_CROSS_RANK": str(cross_rank),
+        "HVD_CROSS_SIZE": str(cross_size),
+    }
+
+
+def make_mapper(payload: bytes, num_proc: int, extra_env: Dict[str, str]):
+    """Returns the mapPartitions body shipped to executors. The returned
+    closure only captures picklable values (payload bytes, ints, dicts)."""
+
+    def mapper(_iterator):
+        from pyspark import BarrierTaskContext
+
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        try:
+            # rendezvous: everyone shares host + a locally-free port; rank 0's
+            # pair becomes the jax.distributed coordinator address
+            me = f"{socket.gethostname()}:{_free_port()}"
+            members = ctx.allGather(me)
+            hosts = [m.rsplit(":", 1)[0] for m in members]
+            env = rank_env_from_hosts(rank, hosts, coordinator_addr=members[0])
+            env.update(extra_env)
+            os.environ.update(env)
+
+            fn, args, kwargs = pickle.loads(payload)
+            ok, blob = True, pickle.dumps(fn(*args, **kwargs))
+        except Exception:
+            ok, blob = False, traceback.format_exc()
+        try:
+            # failed ranks still join the final barrier so healthy ranks don't
+            # die in it and mask the root cause; no rank exits before all
+            # finished (uneven-exit teardown would kill stragglers'
+            # collectives)
+            ctx.barrier()
+        except Exception:
+            pass  # the stage is failing; the per-rank report survives
+        yield (rank, ok, blob)
+
+    return mapper

@@ -1,0 +1,207 @@
+"""TensorFlow binding surface — `horovod.tensorflow` parity on the TPU engine.
+
+Reference parity: `horovod/tensorflow/__init__.py` (530 LoC) +
+`tensorflow/mpi_ops.py`: eager-mode ``allreduce`` (Average division in
+framework, `__init__.py:117`), ``allgather``, ``broadcast``,
+``broadcast_variables`` (:139-171), ``DistributedGradientTape`` (:473-530),
+``DistributedOptimizer`` via ``compute_gradients`` wrap (:281-295), and
+``Compression`` (`tensorflow/compression.py`).
+
+TensorFlow is NOT part of the TPU image — JAX is the native surface
+(`horovod_tpu.spmd` / `horovod_tpu.optim`). This module exists for users
+porting TF2 eager scripts: it requires an environment with tensorflow
+installed and routes TF eager tensors through the shared engine (numpy at
+the boundary, like the reference's `TFTensor` adapter in role,
+`tensorflow/mpi_ops.cc:78-250`). Graph-mode/tf.function custom ops are out
+of scope — XLA-jitted training belongs on the JAX path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .. import basics
+from ..basics import (  # noqa: F401  (re-exported API surface)
+    Adasum,
+    Average,
+    Sum,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..exceptions import HorovodInternalError  # noqa: F401
+from ..ops import collective_ops as _ops
+from .compression import Compression  # noqa: F401
+
+try:
+    import tensorflow as tf
+
+    _HAVE_TF = True
+except ImportError:  # pragma: no cover - exercised only without tensorflow
+    tf = None
+    _HAVE_TF = False
+
+
+def _require_tf():
+    if not _HAVE_TF:
+        raise ImportError(
+            "horovod_tpu.tensorflow requires the 'tensorflow' package, which "
+            "is not installed. The TPU-native training surface is JAX "
+            "(horovod_tpu / horovod_tpu.spmd); install tensorflow only if "
+            "you are porting a TF2 eager script.")
+    return tf
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    _require_tf()
+    return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
+
+
+def _from_result(result, like):
+    t = _require_tf()
+    return t.convert_to_tensor(np.asarray(result), dtype=like.dtype)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=Compression.none,
+              op: Optional[int] = None):
+    """Eager allreduce (`tensorflow/__init__.py:44-118`): compress → engine →
+    decompress; Average division happens in-framework (:117)."""
+    op_ = Average if op is None and average is None else (
+        (Average if average else Sum) if average is not None else op)
+    comp, ctx = compression.compress(tensor)
+    out = _from_result(
+        _ops.synchronize(_ops.allreduce_async(_to_numpy(comp), name=name,
+                                              op=op_)), comp)
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return _from_result(
+        _ops.synchronize(_ops.allgather_async(_to_numpy(tensor), name=name)),
+        tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    return _from_result(
+        _ops.synchronize(_ops.broadcast_async(_to_numpy(tensor), root_rank,
+                                              name=name)), tensor)
+
+
+def join() -> int:
+    return _ops.join()
+
+
+def broadcast_variables(variables: List[Any], root_rank: int = 0) -> None:
+    """Assign every tf.Variable its root-rank value
+    (`tensorflow/__init__.py:139-171`)."""
+    _require_tf()
+    for i, v in enumerate(variables):
+        name = getattr(v, "name", None) or f"var.{i}"
+        v.assign(broadcast(v.value() if hasattr(v, "value") else v,
+                           root_rank, name=f"bv.{name}"))
+
+
+class DistributedGradientTape:
+    """Wraps ``tf.GradientTape`` so ``gradient()`` returns rank-averaged
+    gradients (`tensorflow/__init__.py:473-530`)."""
+
+    def __init__(self, tape, compression=Compression.none, op: int = Average):
+        _require_tf()
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        flat = grads if isinstance(grads, (list, tuple)) else [grads]
+        handles, ctxs = [], []
+        for i, g in enumerate(flat):
+            if g is None:
+                handles.append(None)
+                ctxs.append((None, None))
+                continue
+            comp, ctx = self._compression.compress(g)
+            handles.append(_ops.allreduce_async(_to_numpy(comp),
+                                                name=f"tape.{i}", op=self._op))
+            ctxs.append((ctx, comp))
+        outs = []
+        for h, (ctx, comp) in zip(handles, ctxs):
+            if h is None:
+                outs.append(None)
+                continue
+            out = _from_result(_ops.synchronize(h), comp)
+            outs.append(self._compression.decompress(out, ctx))
+        if isinstance(grads, tuple):
+            return tuple(outs)
+        return outs if isinstance(grads, list) else outs[0]
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+
+class DistributedOptimizer:
+    """Keras-optimizer wrapper: gradients are allreduced before ``apply_
+    gradients`` (`tensorflow/__init__.py:281-295` compute_gradients wrap)."""
+
+    def __init__(self, optimizer, compression=Compression.none,
+                 op: int = Average):
+        _require_tf()
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        grads_and_vars = list(grads_and_vars)
+        reduced = []
+        handles, metas = [], []
+        for i, (g, v) in enumerate(grads_and_vars):
+            if g is None:
+                handles.append(None)
+                metas.append((None, None, v))
+                continue
+            comp, ctx = self._compression.compress(g)
+            name = getattr(v, "name", None) or f"opt.{i}"
+            handles.append(_ops.allreduce_async(_to_numpy(comp),
+                                                name=f"grad.{name}",
+                                                op=self._op))
+            metas.append((ctx, comp, v))
+        for h, (ctx, comp, v) in zip(handles, metas):
+            if h is None:
+                reduced.append((None, v))
+                continue
+            out = _from_result(_ops.synchronize(h), comp)
+            reduced.append((self._compression.decompress(out, ctx), v))
+        return self._opt.apply_gradients(reduced, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+class BroadcastGlobalVariablesHook:
+    """tf.estimator-style hook parity (`tensorflow/__init__.py:173-227`):
+    call ``after_create_session`` (or just ``broadcast_variables``) once."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def after_create_session(self, session=None, coord=None):
+        t = _require_tf()
+        broadcast_variables(
+            [v for v in t.compat.v1.global_variables()], self.root_rank)

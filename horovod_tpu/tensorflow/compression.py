@@ -1,0 +1,62 @@
+"""TF-surface gradient compression (`horovod/tensorflow/compression.py`
+parity): ``Compression.none`` / ``Compression.fp16`` compressor pairs, plus a
+TPU-native ``bf16``."""
+
+from __future__ import annotations
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    _wire_dtype_name = None
+
+    @classmethod
+    def compress(cls, tensor):
+        import tensorflow as tf
+
+        if tensor.dtype.is_floating:
+            wire = getattr(tf, cls._wire_dtype_name)
+            return tf.cast(tensor, wire), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is None:
+            return tensor
+        import tensorflow as tf
+
+        return tf.cast(tensor, ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    _wire_dtype_name = "float16"
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-native 16-bit wire format (fp32 exponent range)."""
+
+    _wire_dtype_name = "bfloat16"
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -56,8 +56,10 @@ def _to_numpy(tensor) -> np.ndarray:
         # the wire dtype stays 16-bit (the point of Compression.bf16)
         import ml_dtypes
 
-        return (tensor.detach().cpu().view(torch.uint16).numpy()
-                .view(ml_dtypes.bfloat16))
+        t = tensor.detach().cpu().contiguous()
+        if hasattr(torch, "uint16"):  # torch >= 2.3
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return t.float().numpy().astype(ml_dtypes.bfloat16)
     return tensor.detach().cpu().numpy()
 
 
@@ -216,11 +218,15 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
         restore = []
         for group in optimizer.param_groups:
             for p in group["params"]:
-                restore.append((p, p.grad))
+                # snapshot: step() with zero grads still mutates params when
+                # weight_decay/momentum hyperparameters are active
+                restore.append((p, p.grad, p.detach().clone()))
                 p.grad = torch.zeros_like(p)
         optimizer.step()
-        for p, g in restore:
-            p.grad = g
+        with torch.no_grad():
+            for p, g, snap in restore:
+                p.copy_(snap)
+                p.grad = g
     state_dict = optimizer.state_dict()
 
     # scalar-wrapping: non-tensor leaves are broadcast as objects and written

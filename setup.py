@@ -63,9 +63,10 @@ def _probe_compiler(cxx):
 
 def _build_native(required):
     cxx = os.environ.get("CXX", "g++")
-    if not _probe_compiler(cxx):
-        msg = (f"C++ compiler probe failed for {cxx!r}; the native engine "
-               f"core will not be built (pure-Python controller fallback).")
+    if not _probe_compiler(cxx) or shutil.which("make") is None:
+        msg = (f"toolchain probe failed (CXX={cxx!r}, make="
+               f"{shutil.which('make')}); the native engine core will not be "
+               "built (pure-Python controller fallback).")
         if required:
             raise RuntimeError(msg + " HOROVOD_TPU_WITH_NATIVE=1 was set.")
         print("WARNING:", msg, file=sys.stderr)

@@ -1,0 +1,124 @@
+"""Minimal in-process pyspark stand-in for spark-integration tests.
+
+The reference tests run against a local Spark session (`test/test_spark.py`);
+pyspark is not in the TPU image, so this fake implements exactly the barrier-
+mode surface `horovod_tpu.spark` uses: ``SparkContext.getOrCreate``,
+``parallelize(...).barrier().mapPartitions(f).collect()``, and
+``BarrierTaskContext`` with ``partitionId/allGather/barrier``. Tasks run as
+forked subprocesses (like real executors — each owns its os.environ).
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import pickle
+
+_mp = multiprocessing.get_context("fork")
+_live_procs = []
+
+
+class BarrierTaskContext:
+    _current = None
+
+    def __init__(self, pid, barrier, gather_dict, gather_barrier):
+        self._pid = pid
+        self._barrier = barrier
+        self._gdict = gather_dict
+        self._gbar = gather_barrier
+        self._gen = 0
+
+    @classmethod
+    def get(cls):
+        return cls._current
+
+    def partitionId(self):
+        return self._pid
+
+    def allGather(self, message=""):
+        self._gdict[(self._gen, self._pid)] = message
+        self._gbar.wait(timeout=60)
+        out = [self._gdict[(self._gen, i)]
+               for i in range(self._barrier.parties)]
+        self._gbar.wait(timeout=60)  # nobody reuses slots mid-read
+        self._gen += 1
+        return out
+
+    def barrier(self):
+        self._barrier.wait(timeout=60)
+
+
+class _BarrierRDD:
+    def __init__(self, n):
+        self.n = n
+
+    def mapPartitions(self, f):
+        return _Runnable(self.n, f)
+
+
+def _worker(pid, f, barrier, gdict, gbar, q):
+    BarrierTaskContext._current = BarrierTaskContext(pid, barrier, gdict, gbar)
+    try:
+        items = list(f(iter([pid])))
+        q.put(("ok", pickle.dumps(items)))
+    except BaseException as e:  # noqa: BLE001 — surfaced to the driver
+        q.put(("err", f"{type(e).__name__}: {e}"))
+
+
+class _Runnable:
+    def __init__(self, n, f):
+        self.n = n
+        self.f = f
+
+    def collect(self):
+        barrier = _mp.Barrier(self.n)
+        gbar = _mp.Barrier(self.n)
+        mgr = _mp.Manager()
+        gdict = mgr.dict()
+        q = _mp.Queue()
+        procs = [_mp.Process(target=_worker,
+                             args=(i, self.f, barrier, gdict, gbar, q),
+                             daemon=True) for i in range(self.n)]
+        _live_procs.extend(procs)
+        for p in procs:
+            p.start()
+        items, errors = [], []
+        for _ in range(self.n):
+            kind, blob = q.get()
+            if kind == "ok":
+                items.extend(pickle.loads(blob))
+            else:
+                errors.append(blob)
+        for p in procs:
+            p.join(timeout=30)
+        mgr.shutdown()
+        if errors:
+            raise RuntimeError("; ".join(errors))
+        return items
+
+
+class _RDD:
+    def __init__(self, n):
+        self.n = n
+
+    def barrier(self):
+        return _BarrierRDD(self.n)
+
+
+class SparkContext:
+    _instance = None
+    defaultParallelism = 2
+
+    @classmethod
+    def getOrCreate(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def parallelize(self, data, numSlices=None):
+        return _RDD(numSlices or len(list(data)))
+
+    def cancelAllJobs(self):
+        for p in _live_procs:
+            if p.is_alive():
+                p.terminate()
+        _live_procs.clear()

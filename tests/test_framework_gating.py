@@ -1,0 +1,53 @@
+"""The TF and MXNet binding surfaces import cleanly without their frameworks
+and raise actionable errors on use (neither framework ships in the TPU
+image; the reference gates extensions the same way —
+`horovod/common/util.py` check_extension)."""
+
+import importlib
+
+import pytest
+
+
+def _installed(mod):
+    try:
+        importlib.import_module(mod)
+        return True
+    except ImportError:
+        return False
+
+
+def test_tensorflow_surface_importable():
+    import horovod_tpu.tensorflow as hvd_tf
+
+    for name in ("allreduce", "allgather", "broadcast", "broadcast_variables",
+                 "DistributedGradientTape", "DistributedOptimizer",
+                 "BroadcastGlobalVariablesHook", "Compression", "init",
+                 "rank", "size", "join"):
+        assert hasattr(hvd_tf, name), name
+
+
+def test_mxnet_surface_importable():
+    import horovod_tpu.mxnet as hvd_mx
+
+    for name in ("allreduce", "allreduce_", "allgather", "broadcast",
+                 "broadcast_", "DistributedOptimizer", "DistributedTrainer",
+                 "broadcast_parameters", "init", "rank", "size"):
+        assert hasattr(hvd_mx, name), name
+
+
+@pytest.mark.skipif(_installed("tensorflow"), reason="tensorflow installed")
+def test_tensorflow_use_without_tf_raises_actionable():
+    import horovod_tpu.tensorflow as hvd_tf
+
+    with pytest.raises(ImportError, match="tensorflow"):
+        hvd_tf.allreduce(object())
+    with pytest.raises(ImportError, match="JAX"):
+        hvd_tf.DistributedGradientTape(None)
+
+
+@pytest.mark.skipif(_installed("mxnet"), reason="mxnet installed")
+def test_mxnet_use_without_mx_raises_actionable():
+    import horovod_tpu.mxnet as hvd_mx
+
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.allreduce(object())

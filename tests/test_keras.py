@@ -105,3 +105,31 @@ def test_load_model_empty_optax_state(tmp_path):
     updates, _ = wrapped.update({"w": np.ones((2,), np.float32)},
                                 state["opt_state"], state["params"])
     np.testing.assert_allclose(np.asarray(updates["w"]), -np.ones((2,)))
+
+
+def test_load_model_file_only_on_root():
+    """Multi-host pattern: the checkpoint exists only on rank 0's filesystem;
+    the bytes must ride the broadcast wire."""
+    import os
+    import tempfile
+
+    import jax
+
+    d = tempfile.mkdtemp()
+    root_path = os.path.join(d, "root_only.msgpack")
+
+    def fn():
+        r = hvd.rank()
+        tx = optax.sgd(1.0)
+        if r == 0:
+            hvd_keras.save_model(root_path,
+                                 {"w": np.arange(3, dtype=np.float32)})
+        # non-root ranks pass a path that does not exist anywhere
+        path = root_path if r == 0 else os.path.join(d, "missing.msgpack")
+        template = {"params": {"w": np.zeros((3,), np.float32)}}
+        state, _ = hvd_keras.load_model(path, template, tx=tx)
+        return np.asarray(state["params"]["w"])
+
+    res = testing.run_cluster(fn, np=2)
+    for w in res:
+        np.testing.assert_allclose(w, np.arange(3, dtype=np.float32))

@@ -234,3 +234,27 @@ def test_torch_broadcast_optimizer_state_fresh_workers():
     for b0, b1 in zip(res[0], res[1]):
         np.testing.assert_array_equal(b0, b1)
         assert np.any(b0 != 0)  # root's real momentum won
+
+
+def test_torch_broadcast_optimizer_state_preserves_params():
+    """The empty-state materialization step must not mutate parameters even
+    with weight_decay/momentum active."""
+
+    def fn():
+        r = hvd.rank()
+        model = torch.nn.Linear(3, 1)
+        before = {k: v.detach().clone()
+                  for k, v in model.state_dict().items()}
+        opt = torch.optim.SGD(model.parameters(), lr=0.5, momentum=0.9,
+                              weight_decay=0.1)
+        if r == 0:
+            model(torch.ones(1, 3)).sum().backward()
+            opt.step()
+            opt.zero_grad()
+            before = {k: v.detach().clone()
+                      for k, v in model.state_dict().items()}
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        after = model.state_dict()
+        return all(torch.equal(before[k], after[k]) for k in before)
+
+    assert all(testing.run_cluster(fn, np=2))
