@@ -8,10 +8,10 @@ gradients by 1/size (`__init__.py:40-67`), gluon ``DistributedTrainer``
 
 MXNet is NOT part of the TPU image (the project is retired upstream); this
 module exists for users porting MXNet scripts from the reference — it
-requires an environment with mxnet installed. Priority is accepted for API
-compatibility and used to order enqueue (higher priority first within a
-drain), standing in for MXNet's dependency-engine priority
-(`mxnet/mpi_ops.cc:132-200`).
+requires an environment with mxnet installed. The **priority** argument is
+accepted for API compatibility only: these ops synchronize inline, so there
+is no pending queue for priority to reorder (the reference feeds MXNet's
+dependency engine, `mxnet/mpi_ops.cc:132-200`, which has no analogue here).
 """
 
 from __future__ import annotations
@@ -144,8 +144,17 @@ def DistributedTrainer(params, optimizer, optimizer_params=None):
 def broadcast_parameters(params, root_rank: int = 0) -> None:
     """Broadcast a gluon ParameterDict / dict of NDArrays
     (`mxnet/__init__.py:109-153`); deferred-init parameters are skipped (the
-    reference attaches a hook; porting scripts should initialize first)."""
-    _require_mx()
+    reference attaches a hook; porting scripts should initialize first).
+
+    Only ``DeferredInitializationError`` is skipped: any other per-parameter
+    error must fail loudly — silently skipping on a subset of ranks would
+    desynchronize the collective schedule (ranks pairing broadcasts of
+    *different* parameters under the same names).
+    """
+    mx = _require_mx()
+    deferred = getattr(getattr(mx, "gluon", None), "parameter", None)
+    deferred = getattr(deferred, "DeferredInitializationError", None)
+    skip_types = (deferred,) if deferred is not None else ()
     if hasattr(params, "items"):
         items = sorted(params.items())
     else:
@@ -153,6 +162,6 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
     for name, p in items:
         try:
             data = p.data() if hasattr(p, "data") and callable(p.data) else p
-        except Exception:
+        except skip_types:
             continue  # deferred init — nothing to broadcast yet
         broadcast_(data, root_rank=root_rank, name=f"bp.{name}")

@@ -1,0 +1,408 @@
+"""Pallas TPU kernels for the hot ops.
+
+No reference counterpart file — Horovod 0.18.2 keeps its hot loops in CUDA
+(`horovod/common/ops/nccl_operations.cc`, `adasum/adasum.h:98-131` SSE/AVX
+kernels); on TPU the equivalent "hand kernel" layer is Pallas/Mosaic. Two
+kernels live here:
+
+* ``flash_attention`` / ``flash_attention_step`` — blockwise-softmax attention
+  tiled for the MXU (128-row q tiles against k/v tiles streamed through VMEM,
+  running max/normalizer in f32). ``flash_attention_step`` has carry-in/out
+  ``(m, l, o)`` statistics so it slots directly into the ring-attention loop
+  (`horovod_tpu/parallel/ring_attention.py`) as the per-hop block compute.
+* ``adasum_combine`` — the Adasum pairwise combine
+  (`adasum/adasum.h:331+`: ``a' = (1-dot/2|a|^2) a + (1-dot/2|b|^2) b``) as a
+  fused two-pass kernel: one VMEM-tiled pass accumulating dot/|a|^2/|b|^2 in
+  SMEM, one elementwise apply pass — the TPU analogue of the reference's
+  fused SSE/AVX dot+norm loops.
+
+Gating: kernels engage only where they help — by default on the TPU backend
+with tile-aligned shapes; ``HVD_PALLAS=0`` forces them off,
+``HVD_PALLAS=interpret`` runs them through the Pallas interpreter (any
+backend; this is how the CPU test suite exercises the kernel code paths).
+Callers always have a pure-jnp fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def mode() -> str:
+    """'on' | 'off' | 'interpret' — resolved from HVD_PALLAS + backend."""
+    env = os.environ.get("HVD_PALLAS", "").lower()
+    if env in ("0", "off", "false"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "on", "true") or jax.default_backend() == "tpu":
+        return "on"
+    return "off"
+
+
+def _interpret() -> bool:
+    return mode() == "interpret"
+
+
+def _tile_ok(t: int, block: int) -> bool:
+    return t % block == 0
+
+
+def _struct(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of the inputs' varying-mesh-axes —
+    required for pallas_call outputs inside ``shard_map(check_vma=True)``."""
+    vma = frozenset()
+    for x in like:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def vma_active(*arrays) -> bool:
+    """True when tracing inside ``shard_map(check_vma=True)`` with varying
+    operands — pallas_call kernels can't satisfy the vma checker's
+    constant-vs-varying rules there, so callers fall back to jnp. The perf
+    paths (plain jit/GSPMD, ``shard_map(check_vma=False)``) report empty vma
+    and keep the kernels."""
+    return any(getattr(jax.typeof(x), "vma", frozenset()) for x in arrays)
+
+
+def _pick_block(t: int, preferred: int = 128) -> Optional[int]:
+    """Largest power-of-2 tile ≤ preferred dividing t (None if none ≥ 8)."""
+    b = preferred
+    while b >= 8:
+        if t % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+# =========================================================== flash attention
+def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
+                       mo_ref, lo_ref, oo_ref, *, causal, scale, block_k):
+    """One q-tile of flash accumulation against the whole resident k/v block.
+
+    Refs (VMEM): q [1, BQ, D], k/v [1, TK, D], m/l [1, BQ, 1] (trailing
+    singleton keeps the block tile-legal: (BQ, 1) instead of (1, BQ)),
+    o [1, BQ, D]; offs (scalar prefetch): [q_off, k_off] global sequence
+    origins for causal masking (ring hop offsets).
+    """
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    m = m_ref[0, :, 0].astype(jnp.float32)            # [BQ]
+    l = l_ref[0, :, 0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)                  # [BQ, D]
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1]
+
+    nk = tk // block_k
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        # [BQ, BK] logits on the MXU
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = (k_off + j * block_k
+                    + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])              # exp(-inf) == 0
+        alpha = jnp.exp(m - m_safe)                   # m=-inf -> 0
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        o_new = o * alpha[:, None] + pv
+        return m_new, l_new, o_new
+
+    if causal:
+        # k blocks past the last unmasked key for this q tile contribute
+        # nothing — bound the loop (exact: those blocks are fully masked)
+        hi = jnp.clip((q_off + bq - k_off + block_k - 1) // block_k, 0, nk)
+    else:
+        hi = nk
+    m, l, o = lax.fori_loop(0, hi, body, (m, l, o))
+    mo_ref[0, :, 0] = m
+    lo_ref[0, :, 0] = l
+    oo_ref[0] = o
+
+
+def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
+                     block_q, block_k, interpret):
+    """qt/ot: [BH, T, D]; kt/vt: [BH, TK, D]; mt/lt: [BH, T, 1] f32."""
+    bh, tq, d = qt.shape
+    tk = kt.shape[1]
+    grid = (bh, tq // block_q)
+    kernel = functools.partial(_flash_step_kernel, causal=causal, scale=scale,
+                               block_k=block_k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+        ],
+    )
+    flops = 4 * bh * tq * tk * d  # 2 matmuls
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _struct((bh, tq, 1), jnp.float32, qt, kt, mt, offs),
+            _struct((bh, tq, 1), jnp.float32, qt, kt, mt, offs),
+            _struct((bh, tq, d), jnp.float32, qt, kt, mt, offs),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=4 * (2 * bh * tq * d + 2 * bh * tk * d),
+            transcendentals=bh * tq * tk),
+        interpret=interpret,
+    )(offs, qt, kt, vt, mt, lt, ot)
+
+
+# Per-operand VMEM budget for the resident k/v block: the pipeline double-
+# buffers input blocks, so worst-case VMEM ≈ 2 (buffering) × 2 (k+v) × this.
+_KV_VMEM_CAP = 3 * 2 ** 20
+
+
+def step_supported(q, k) -> bool:
+    """True if ``flash_attention_step`` can run these shapes as a TPU kernel
+    (tile-aligned seq lens, lane-aligned head dim, k/v block fits VMEM)."""
+    if mode() == "off":
+        return False
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if d % 128 != 0 and d not in (64,):  # MXU lane width; 64 still maps
+        return False
+    if tk * d * k.dtype.itemsize > _KV_VMEM_CAP:
+        return False  # longer K shards must fall back until k/v is grid-tiled
+    if vma_active(q, k):
+        return False
+    return (_pick_block(tq) is not None and _pick_block(tk) is not None)
+
+
+def flash_attention_step(q, k, v, m, l, o, q_off, k_off, *,
+                         causal: bool = False, scale: float = 1.0):
+    """Flash-accumulate ``q`` against one resident ``(k, v)`` block.
+
+    Same contract as the ring-attention inner step: shapes
+    q/o ``[B, T, H, D]``, k/v ``[B, TK, H, D]``, m/l ``[B, H, T]`` (f32 running
+    max / normalizer), ``q_off``/``k_off`` global sequence origins (traced
+    scalars OK). Returns updated ``(m, l, o)``.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = _pick_block(tq)
+    block_k = _pick_block(tk)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    mt = m.reshape(b * h, tq, 1)
+    lt = l.reshape(b * h, tq, 1)
+    ot = o.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    mt, lt, ot = _flash_step_call(
+        qt, kt, vt, mt, lt, ot, offs, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=_interpret())
+    m_new = mt.reshape(b, h, tq)
+    l_new = lt.reshape(b, h, tq)
+    o_new = ot.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return m_new, l_new, o_new
+
+
+@functools.lru_cache(maxsize=None)
+def flash_step_vjp(causal: bool, scale: float):
+    """Differentiable flash step: Pallas kernel forward, rematerialized jnp
+    flash-accumulation backward (``pallas_call`` has no AD rule; the jnp step
+    is mathematically identical, so its VJP is exact and the residuals are
+    just the step inputs — flash-style O(T) memory).
+
+    Returns ``step(q, k, v, m, l, o, q_off, k_off) -> (m', l', o')``.
+    """
+
+    @jax.custom_vjp
+    def step(q, k, v, m, l, o, q_off, k_off):
+        return flash_attention_step(q, k, v, m, l, o, q_off, k_off,
+                                    causal=causal, scale=scale)
+
+    def fwd(q, k, v, m, l, o, q_off, k_off):
+        out = step(q, k, v, m, l, o, q_off, k_off)
+        return out, (q, k, v, m, l, o, q_off, k_off)
+
+    def bwd(res, g):
+        from ..parallel.ring_attention import _block_attn
+
+        q, k, v, m, l, o, q_off, k_off = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_, m_, l_, o_: _block_attn(
+                q_, k_, v_, m_, l_, o_, q_off, k_off, causal, scale),
+            q, k, v, m, l, o)
+        dq, dk, dv, dm, dl, do = vjp(g)
+
+        def int_zero(x):  # integer offsets take float0 cotangents
+            return np.zeros(np.shape(x), jax.dtypes.float0)
+
+        return dq, dk, dv, dm, dl, do, int_zero(q_off), int_zero(k_off)
+
+    step.defvjp(fwd, bwd)
+    return step
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Single-device flash attention, ``[B, T, H, D]`` layout.
+
+    The full-sequence special case of the ring step (one hop, offsets 0).
+    Falls back to plain jnp attention when the kernel is gated off or shapes
+    are not tile-aligned.
+    """
+    b, tq, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    if not step_supported(q, k):
+        from ..parallel.ring_attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    m0 = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    step = flash_step_vjp(causal, float(scale))
+    m, l, o = step(q, k, v, m0, l0, o0, 0, 0)
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+# ==================================================================== adasum
+def _adasum_reduce_kernel(a_ref, b_ref, out_ref, acc_ref):
+    """Accumulate [dot(a,b), |a|^2, |b|^2] over row-tiles into SMEM scratch;
+    emit into a (8,128) VMEM tile (positions [0,0..2]; the only tile-legal
+    home for 3 scalars) on the pair's last grid step. One read pass over
+    both operands."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[0] = 0.0
+        acc_ref[1] = 0.0
+        acc_ref[2] = 0.0
+
+    a = a_ref[0].astype(jnp.float32)
+    b = b_ref[0].astype(jnp.float32)
+    acc_ref[0] += jnp.sum(a * b)
+    acc_ref[1] += jnp.sum(a * a)
+    acc_ref[2] += jnp.sum(b * b)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        # place the 3 scalars at [0, 0..2] via iota masks (scatter/.at[].set
+        # does not lower in Mosaic)
+        row = lax.broadcasted_iota(jnp.int32, (8, _LANES), 0)
+        col = lax.broadcasted_iota(jnp.int32, (8, _LANES), 1)
+        buf = jnp.where(
+            (row == 0) & (col == 0), acc_ref[0],
+            jnp.where((row == 0) & (col == 1), acc_ref[1],
+                      jnp.where((row == 0) & (col == 2), acc_ref[2], 0.0)))
+        out_ref[0] = buf
+
+
+def _adasum_apply_kernel(s_ref, a_ref, b_ref, out_ref):
+    """out = ac*a + bc*b with coefficients from the reduced scalars
+    (zero-norm guard as `adasum/adasum.h:331+` / executor combine)."""
+    dot, na, nb = s_ref[0, 0, 0], s_ref[0, 0, 1], s_ref[0, 0, 2]
+    ac = jnp.where(na == 0.0, 1.0, 1.0 - dot / (2.0 * jnp.where(na == 0.0, 1.0, na)))
+    bc = jnp.where(nb == 0.0, 1.0, 1.0 - dot / (2.0 * jnp.where(nb == 0.0, 1.0, nb)))
+    a = a_ref[0].astype(jnp.float32)
+    b = b_ref[0].astype(jnp.float32)
+    out_ref[0] = (ac * a + bc * b).astype(out_ref.dtype)
+
+
+_LANES = 128
+_ROWS = 512  # 512x128 f32 tile = 256 KB per operand per step
+
+
+def adasum_supported(n_elements: int) -> bool:
+    return mode() != "off" and n_elements % _LANES == 0
+
+
+def adasum_combine_pairs(a, b):
+    """Fused Adasum combine of ``m`` independent pairs: ``a``/``b`` are
+    ``[m, ...]``; pair ``i`` combines ``a[i]`` with ``b[i]``.
+
+    ``a' = (1 - dot/(2|a|^2)) a + (1 - dot/(2|b|^2)) b`` with dot/norms
+    accumulated in f32 (`adasum/adasum.h:331+`). Two passes over HBM instead
+    of the unfused three (dot+norms, then apply); the pair dimension rides
+    the grid, so one launch covers a whole tree level of `spmd.adasum`.
+    """
+    shape, dtype = a.shape, a.dtype
+    m = shape[0]
+    n = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+    if not adasum_supported(n):
+        raise ValueError("adasum_combine: per-pair size must be lane-aligned "
+                         f"({_LANES}); got {n}")
+    rows = n // _LANES
+    block_rows = min(_ROWS, rows)
+    while rows % block_rows:
+        block_rows //= 2
+    af = a.reshape(m, rows, _LANES)
+    bf = b.reshape(m, rows, _LANES)
+    grid = (m, rows // block_rows)
+    interpret = _interpret()
+    tile = pl.BlockSpec((1, block_rows, _LANES), lambda i, j: (i, j, 0))
+    # one (8,128) scalar tile per pair; same block for every j (kept resident)
+    s_tile = pl.BlockSpec((1, 8, _LANES), lambda i, j: (i, 0, 0))
+
+    scalars = pl.pallas_call(
+        _adasum_reduce_kernel,
+        grid=grid,
+        in_specs=[tile, tile],
+        out_specs=s_tile,
+        out_shape=_struct((m, 8, _LANES), jnp.float32, af, bf),
+        scratch_shapes=[pltpu.SMEM((3,), jnp.float32)],
+        interpret=interpret,
+    )(af, bf)
+
+    out = pl.pallas_call(
+        _adasum_apply_kernel,
+        grid=grid,
+        in_specs=[s_tile, tile, tile],
+        out_specs=tile,
+        out_shape=_struct((m, rows, _LANES), dtype, af, bf),
+        interpret=interpret,
+    )(scalars, af, bf)
+    return out.reshape(shape)
+
+
+def adasum_combine(a, b):
+    """Fused Adasum pairwise combine of two same-shape arrays (single-pair
+    convenience over :func:`adasum_combine_pairs`)."""
+    return adasum_combine_pairs(a[None], b[None])[0]

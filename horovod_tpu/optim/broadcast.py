@@ -84,13 +84,28 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None):
         return obj
     name = name or "broadcast_object"
     if basics.rank() == root_rank:
-        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        # a root-side failure must fail every rank symmetrically — if root
+        # raised before the collective, peers would hang in broadcast forever.
+        # A negative length header marks "payload is a pickled error string".
+        try:
+            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+            header = payload.size
+        except Exception as e:  # pickling/serialization failure of any kind
+            msg = f"broadcast_object root failure: {type(e).__name__}: {e}"
+            payload = np.frombuffer(pickle.dumps(msg), dtype=np.uint8).copy()
+            header = -payload.size
     else:
         payload = np.zeros((0,), dtype=np.uint8)
-    n = ops.broadcast(np.array([payload.size], np.int32), root_rank,
+        header = 0
+    # int64 header: checkpoints >= 2 GiB must not overflow the length wire
+    n = ops.broadcast(np.array([header], np.int64), root_rank,
                       name=f"{name}.len")
-    nbytes = int(np.asarray(n)[0])
+    signed = int(np.asarray(n)[0])
+    nbytes = abs(signed)
     if basics.rank() != root_rank:
         payload = np.zeros((nbytes,), dtype=np.uint8)
     data = ops.broadcast(payload, root_rank, name=f"{name}.data")
-    return pickle.loads(np.asarray(data).tobytes())
+    result = pickle.loads(np.asarray(data).tobytes())
+    if signed < 0:
+        raise RuntimeError(result)  # same error, every rank
+    return result

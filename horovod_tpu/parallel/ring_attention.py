@@ -16,6 +16,11 @@ in this framework. Design follows the blockwise ring-attention construction
 
 Causal masking uses global positions derived from each block's ring origin, so
 the result matches full causal attention exactly.
+
+The per-hop block compute runs as a Pallas flash kernel
+(`horovod_tpu/ops/pallas_kernels.py`) when shapes are MXU-tile-aligned on the
+TPU backend (``HVD_PALLAS`` gates it), with this file's jnp flash step as the
+always-available fallback — same (m, l, o) carry either way.
 """
 
 from __future__ import annotations
@@ -72,6 +77,19 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     o0 = jnp.zeros((b, t, h, d), jnp.float32)
     q_off = my * t
 
+    from ..ops import pallas_kernels
+
+    if pallas_kernels.step_supported(q, k):
+        # Pallas forward / rematerialized-jnp backward (differentiable)
+        _step = pallas_kernels.flash_step_vjp(causal, float(scale))
+
+        def step(qq, kk, vv, m, l, o, k_off):
+            return _step(qq, kk, vv, m, l, o, q_off, k_off)
+    else:
+        def step(qq, kk, vv, m, l, o, k_off):
+            return _block_attn(qq, kk, vv, m, l, o, q_off, k_off, causal,
+                               scale)
+
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(i, carry):
@@ -79,8 +97,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         # block currently held arrived from rank (my - i) mod n
         src = (my - i) % n
         k_off = src * t
-        m, l, o = _block_attn(q, kv_cur[0], kv_cur[1], m, l, o, q_off, k_off,
-                              causal, scale)
+        m, l, o = step(q, kv_cur[0], kv_cur[1], m, l, o, k_off)
         # rotate K and V to the next rank as ONE stacked buffer: a single
         # collective launch per hop, one large DMA for XLA to overlap with
         # the block matmuls
@@ -92,8 +109,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     # no wasted ppermute trails the last compute step
     m, l, o, kv_last = lax.fori_loop(0, n - 1, body, (m0, l0, o0, kv0))
     src = (my - (n - 1)) % n
-    m, l, o = _block_attn(q, kv_last[0], kv_last[1], m, l, o, q_off, src * t,
-                          causal, scale)
+    m, l, o = step(q, kv_last[0], kv_last[1], m, l, o, src * t)
     l_safe = jnp.where(l == 0, 1.0, l)
     out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)

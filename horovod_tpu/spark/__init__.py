@@ -47,10 +47,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     job; returns per-rank results in rank order (`spark/__init__.py:101-236`).
 
     Raises ``RuntimeError`` if any rank fails (first traceback included) and
-    ``TimeoutError`` if the job does not finish within ``start_timeout``
-    seconds (the reference's settings.timeout flow, `spark/__init__.py:142`).
+    ``TimeoutError`` if Spark has not *scheduled and started* all ``num_proc``
+    barrier tasks within ``start_timeout`` seconds — the classic barrier-mode
+    failure when the cluster is too small (the reference's settings.timeout
+    likewise bounds startup only, `spark/__init__.py:142`). Once the tasks are
+    running, the driver waits for completion with no time bound.
     """
     _check_pyspark()
+    import time as _time
+
     from pyspark import SparkContext
 
     sc = SparkContext.getOrCreate()
@@ -66,25 +71,48 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     rdd = sc.parallelize(range(num_proc), num_proc).barrier()
 
     out: dict = {}
+    import uuid as _uuid
+
+    job_group = f"horovod-tpu-{_uuid.uuid4().hex[:8]}"
 
     def _collect():
         try:
+            # job groups are thread-local: tag in the submitting thread so
+            # timeout/cancel touch only THIS job, never other work sharing
+            # the SparkContext (e.g. a notebook's ETL jobs)
+            try:
+                sc.setJobGroup(job_group, "horovod_tpu.spark.run",
+                               interruptOnCancel=True)
+            except Exception:
+                pass
             out["results"] = rdd.mapPartitions(mapper).collect()
         except BaseException as e:  # surfaced after join
             out["error"] = e
 
     t = threading.Thread(target=_collect, daemon=True)
     t.start()
-    t.join(start_timeout if start_timeout and start_timeout > 0 else None)
-    if t.is_alive():
-        try:
-            sc.cancelAllJobs()
-        except Exception:
-            pass
-        raise TimeoutError(
-            f"horovod_tpu.spark.run timed out after {start_timeout}s waiting "
-            f"for {num_proc} tasks; is the cluster large enough for barrier "
-            "mode to schedule all of them at once?")
+    deadline = (_time.time() + start_timeout
+                if start_timeout and start_timeout > 0 else None)
+    started = deadline is None
+    while t.is_alive():
+        if not started and _tasks_running(sc, num_proc, job_group):
+            started = True  # startup done; stop watching the clock
+        if started:
+            t.join(1.0)
+        elif _time.time() >= deadline:
+            try:
+                sc.cancelJobGroup(job_group)
+            except Exception:
+                try:
+                    sc.cancelAllJobs()
+                except Exception:
+                    pass
+            raise TimeoutError(
+                f"horovod_tpu.spark.run: not all {num_proc} tasks were "
+                f"running after {start_timeout}s; is the cluster large "
+                "enough for barrier mode to schedule all of them at once?")
+        else:
+            t.join(0.1)
     if "error" in out:
         raise out["error"]
 
@@ -96,6 +124,28 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
             f"{len(failures)}/{num_proc} ranks failed; first failure "
             f"(rank {rank}):\n{err}")
     return [pickle.loads(blob) for _, _, blob in by_rank]
+
+
+def _tasks_running(sc, num_proc: int, job_group: str) -> bool:
+    """True once Spark reports >= num_proc active tasks in OUR job group
+    (barrier mode starts all-or-nothing; scoping to the group keeps
+    concurrent unrelated jobs from masking a stuck barrier stage).
+    Unobservable trackers count as started — better to wait forever on a
+    live job than kill one we cannot see."""
+    try:
+        tracker = sc.statusTracker()
+        total = 0
+        for jid in tracker.getJobIdsForGroup(job_group):
+            jinfo = tracker.getJobInfo(jid)
+            if jinfo is None:
+                continue
+            for sid in jinfo.stageIds:
+                sinfo = tracker.getStageInfo(sid)
+                if sinfo is not None:
+                    total += sinfo.numActiveTasks
+        return total >= num_proc
+    except Exception:
+        return True
 
 
 def _serialize(obj) -> bytes:

@@ -81,16 +81,28 @@ def adasum(x, axis: str = MESH_AXIS):
 
     Pairwise tree as in `adasum/adasum.h:185-331`: at level k, partners are
     distance 2^k apart; coefficients from psum'd dots/norms restricted to each
-    pair. Implemented via all_gather + local tree (replica count is static),
-    which XLA turns into one gather plus vectorized math — efficient for the
-    gradient-sized tensors Adasum targets.
+    pair. Implemented via all_gather + local tree (replica count is static).
+    After the gather the tree is device-local math, so each pairwise combine
+    runs as the fused Pallas dot+norm+apply kernel
+    (`ops/pallas_kernels.adasum_combine`) when enabled — the TPU analogue of
+    the reference's SSE/AVX fused loops (`adasum/adasum.h:98-131`) — with the
+    vectorized-jnp tree as fallback (zero-padding to lane width is exact:
+    zeros contribute nothing to dot or norms).
     """
+    from .ops import pallas_kernels as _pk
+
     g = jax.lax.all_gather(x, axis)  # [n, ...]
     n = g.shape[0]
     if n & (n - 1):
         raise ValueError("Adasum requires a power-of-2 replica count "
                          "(parity: torch/mpi_ops.py:104-120)")
     flat = g.reshape(n, -1).astype(jnp.float32)
+    if _pk.mode() != "off" and not _pk.vma_active(flat):
+        pad = (-flat.shape[1]) % 128
+        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+        while padded.shape[0] > 1:  # one batched launch per tree level
+            padded = _pk.adasum_combine_pairs(padded[0::2], padded[1::2])
+        return padded[0, :flat.shape[1]].reshape(x.shape).astype(x.dtype)
     while flat.shape[0] > 1:
         a, b = flat[0::2], flat[1::2]
         dot = jnp.sum(a * b, axis=1, keepdims=True)

@@ -74,7 +74,11 @@ def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None, compression=Compression.none,
               op: Optional[int] = None):
     """Eager allreduce (`tensorflow/__init__.py:44-118`): compress → engine →
-    decompress; Average division happens in-framework (:117)."""
+    decompress; Average division happens in-framework (:117). Passing both
+    ``average`` and ``op`` is rejected, as in the reference (:51-55)."""
+    if average is not None and op is not None:
+        raise ValueError("The op parameter supersedes average; please provide "
+                         "only one of them.")
     op_ = Average if op is None and average is None else (
         (Average if average else Sum) if average is not None else op)
     comp, ctx = compression.compress(tensor)

@@ -16,6 +16,13 @@ import pickle
 _mp = multiprocessing.get_context("fork")
 _live_procs = []
 
+# Test hook: while True, collect() leaves tasks in "pending" (not scheduled)
+# so drivers can exercise the startup-timeout path; cancelAllJobs releases it.
+HOLD_SCHEDULING = False
+_cancelled = False
+_thread_groups: dict = {}   # submitting-thread id -> job group
+_active_group = None        # group of the (single) currently running job
+
 
 class BarrierTaskContext:
     _current = None
@@ -70,6 +77,19 @@ class _Runnable:
         self.f = f
 
     def collect(self):
+        import threading
+        import time
+
+        global _cancelled, _active_group
+        # like real Spark, cancelAllJobs() only hits jobs already running —
+        # a stale cancel from a previous job must not kill this one
+        _cancelled = False
+        _active_group = _thread_groups.get(threading.get_ident())
+        while HOLD_SCHEDULING and not _cancelled:
+            time.sleep(0.02)
+        if _cancelled:
+            _cancelled = False
+            raise RuntimeError("job cancelled before scheduling")
         barrier = _mp.Barrier(self.n)
         gbar = _mp.Barrier(self.n)
         mgr = _mp.Manager()
@@ -118,7 +138,43 @@ class SparkContext:
         return _RDD(numSlices or len(list(data)))
 
     def cancelAllJobs(self):
+        global _cancelled
+        _cancelled = True
         for p in _live_procs:
             if p.is_alive():
                 p.terminate()
         _live_procs.clear()
+
+    def setJobGroup(self, group, description=None, interruptOnCancel=False):
+        import threading
+
+        _thread_groups[threading.get_ident()] = group
+
+    def cancelJobGroup(self, group):
+        if _active_group == group:
+            self.cancelAllJobs()
+
+    def statusTracker(self):
+        return _StatusTracker()
+
+
+class _StatusTracker:
+    """Mirrors pyspark.status.StatusTracker for the surface run() polls."""
+
+    def getActiveStageIds(self):
+        return [0] if any(p.is_alive() for p in _live_procs) else []
+
+    def getJobIdsForGroup(self, group):
+        return [0] if _active_group == group else []
+
+    def getJobInfo(self, job_id):
+        class _Job:
+            stageIds = [0]
+
+        return _Job()
+
+    def getStageInfo(self, stage_id):
+        class _Info:
+            numActiveTasks = sum(1 for p in _live_procs if p.is_alive())
+
+        return _Info()

@@ -55,12 +55,25 @@ def test_spark_run_failure_propagates():
         hvd_spark.run(fn, num_proc=2)
 
 
-def test_spark_run_timeout():
-    def fn():
-        time.sleep(30)
+def test_spark_run_startup_timeout():
+    """start_timeout fires when the cluster never schedules the tasks."""
+    fake_pyspark.HOLD_SCHEDULING = True
+    try:
+        with pytest.raises(TimeoutError, match="running after"):
+            hvd_spark.run(lambda: True, num_proc=2, start_timeout=0.5)
+    finally:
+        fake_pyspark.HOLD_SCHEDULING = False
 
-    with pytest.raises(TimeoutError):
-        hvd_spark.run(fn, num_proc=2, start_timeout=0.5)
+
+def test_spark_run_longer_than_start_timeout_succeeds():
+    """start_timeout bounds startup only — a slow job must NOT be killed
+    (regression: total-runtime cap masquerading as a start timeout)."""
+
+    def fn():
+        time.sleep(1.5)
+        return "done"
+
+    assert hvd_spark.run(fn, num_proc=2, start_timeout=0.5) == ["done", "done"]
 
 
 def test_spark_num_proc_defaults_to_parallelism():
