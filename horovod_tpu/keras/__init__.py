@@ -108,25 +108,16 @@ def load_model(path: str, template: Dict[str, Any], tx=None,
     if multi:
         # only rank 0 is guaranteed to see the file (save_model writes on
         # rank 0 only; on a multi-host pod the path may be host-local) —
-        # root reads, the bytes ride the broadcast wire
-        from ..optim.broadcast import broadcast_object
+        # root reads, the bytes ride the broadcast wire; a rank-0 read
+        # failure re-raises symmetrically on EVERY rank (peers must not hang
+        # waiting for a broadcast that never comes)
+        from ..optim.broadcast import broadcast_from_root
 
-        # a rank-0 read failure must fail EVERY rank, not just rank 0 — if
-        # root raised before the collective, peers would hang forever in
-        # broadcast_object; so root broadcasts the error as a sentinel and
-        # all ranks re-raise symmetrically
-        data = None
-        if basics.rank() == 0:
-            try:
-                with open(path, "rb") as f:
-                    data = f.read()
-            except Exception as e:  # ANY root failure must reach all ranks
-                data = ("__load_model_error__", type(e).__name__, str(e))
-        data = broadcast_object(data, 0, name="load_model.bytes")
-        if isinstance(data, tuple) and data[:1] == ("__load_model_error__",):
-            raise IOError(
-                f"load_model: rank 0 failed to read {path!r}: "
-                f"{data[1]}: {data[2]}")
+        def _read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        data = broadcast_from_root(_read, 0, name="load_model.bytes")
     else:
         with open(path, "rb") as f:
             data = f.read()

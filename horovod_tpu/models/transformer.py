@@ -1,0 +1,117 @@
+"""Decoder-only transformer LM in Flax — the long-context flagship model.
+
+No reference counterpart (Horovod 0.18.2 ships CNN benchmark models only,
+`examples/tensorflow2_synthetic_benchmark.py:35-40`); the transformer is this
+framework's vehicle for its first-class long-context story. TPU-first design:
+
+  * bfloat16 compute / fp32 params; every matmul is MXU-shaped
+    (d_model and head_dim multiples of 128/64).
+  * Attention is **pluggable**: the default is the Pallas flash kernel
+    (`ops/pallas_kernels.flash_attention`, jnp fallback off-TPU); sequence
+    parallelism injects ring attention (`parallel/ring_attention.ring_attention`)
+    so the SAME model definition trains with the sequence axis sharded over
+    an ``sp`` mesh axis (`parallel/sp_training.py`).
+  * ``pos_offset`` lets a sequence-sharded caller feed LOCAL token blocks
+    while position embeddings stay GLOBAL (offset = shard_index * local_len).
+  * Pre-LN blocks, GELU MLP (4x), learned positions, weight-tied output head —
+    the standard GPT-2-ish recipe, chosen so parameter counts line up with
+    public configs for benchmarking.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttnFn = Callable[..., Any]  # (q, k, v) -> out, all [B, T, H, Dh]
+
+
+def default_attention(q, k, v):
+    """Causal attention via the Pallas flash kernel (falls back to plain jnp
+    attention when the kernel is gated off or shapes are ragged)."""
+    from ..ops.pallas_kernels import flash_attention
+
+    return flash_attention(q, k, v, causal=True)
+
+
+class Block(nn.Module):
+    num_heads: int
+    dtype: Any
+    attn_fn: AttnFn
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+        ln = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
+
+        h = ln(name="ln_attn")(x)
+        qkv = dense(3 * d_model, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, t = q.shape[:2]
+        shp = (b, t, self.num_heads, head_dim)
+        out = self.attn_fn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        out = dense(d_model, name="proj",
+                    kernel_init=nn.initializers.normal(0.02))(
+                        out.astype(self.dtype).reshape(b, t, d_model))
+        x = x + out
+
+        h = ln(name="ln_mlp")(x)
+        h = dense(4 * d_model, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = dense(d_model, name="mlp_out")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[AttnFn] = None  # default: causal flash attention
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        """tokens: int [B, T_local]; pos_offset: global position of column 0
+        (nonzero when the sequence axis is sharded across devices)."""
+        attn = self.attn_fn if self.attn_fn is not None else default_attention
+        emb = nn.Embed(self.vocab_size, self.d_model,
+                       embedding_init=nn.initializers.normal(0.02),
+                       param_dtype=jnp.float32, dtype=self.dtype,
+                       name="tok_emb")
+        pos_table = self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (self.max_seq_len, self.d_model), jnp.float32)
+
+        t = tokens.shape[1]
+        pos = pos_offset + jnp.arange(t)
+        x = emb(tokens) + jnp.take(pos_table, pos, axis=0).astype(self.dtype)
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.dtype, attn, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        # weight-tied head: logits = x @ tok_emb.T
+        logits = emb.attend(x.astype(jnp.float32))
+        return logits.astype(jnp.float32)
+
+
+def lm_loss(logits, targets):
+    """Mean next-token cross entropy; with equal-size shards the global loss
+    is the pmean of per-shard values (exact)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# compact configs for tests / dry runs / benches
+TransformerLMTiny = partial(TransformerLM, num_layers=2, num_heads=2,
+                            d_model=128, max_seq_len=512)
+TransformerLM124M = partial(TransformerLM, num_layers=12, num_heads=12,
+                            d_model=768, max_seq_len=2048)

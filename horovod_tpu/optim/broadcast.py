@@ -80,32 +80,49 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None):
     Serialization rides the byte-collective: length broadcast first (so
     non-root ranks can size their buffer), then the payload as uint8.
     """
+    return broadcast_from_root(lambda: obj, root_rank, name=name)
+
+
+def broadcast_from_root(producer, root_rank: int = 0,
+                        name: Optional[str] = None):
+    """Run ``producer()`` on the root rank and broadcast its (picklable)
+    result to every rank.
+
+    Root-side failures — in ``producer`` itself (file reads, deserialization)
+    or in pickling — are broadcast as an error sentinel and re-raised as the
+    SAME ``RuntimeError`` on every rank: if root raised before the collective,
+    peers would hang in broadcast forever. Non-root ranks never call
+    ``producer`` (the resource may only exist on root's host).
+
+    Wire format: a 2xint32 header (error flag, then the payload length split
+    into two int32 halves — int64 would be silently canonicalized to int32 by
+    the collective layer when jax_enable_x64 is off, wrapping for >= 2 GiB
+    payloads) followed by the uint8 payload.
+    """
     if basics.size() == 1:
-        return obj
+        return producer()
     name = name or "broadcast_object"
     if basics.rank() == root_rank:
-        # a root-side failure must fail every rank symmetrically — if root
-        # raised before the collective, peers would hang in broadcast forever.
-        # A negative length header marks "payload is a pickled error string".
         try:
-            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-            header = payload.size
-        except Exception as e:  # pickling/serialization failure of any kind
-            msg = f"broadcast_object root failure: {type(e).__name__}: {e}"
+            payload = np.frombuffer(pickle.dumps(producer()),
+                                    dtype=np.uint8).copy()
+            failed = 0
+        except Exception as e:  # ANY root failure must reach all ranks
+            msg = (f"broadcast_from_root: root rank {root_rank} failed: "
+                   f"{type(e).__name__}: {e}")
             payload = np.frombuffer(pickle.dumps(msg), dtype=np.uint8).copy()
-            header = -payload.size
+            failed = 1
+        header = np.array([failed, payload.size >> 31,
+                           payload.size & 0x7FFFFFFF], np.int32)
     else:
         payload = np.zeros((0,), dtype=np.uint8)
-        header = 0
-    # int64 header: checkpoints >= 2 GiB must not overflow the length wire
-    n = ops.broadcast(np.array([header], np.int64), root_rank,
-                      name=f"{name}.len")
-    signed = int(np.asarray(n)[0])
-    nbytes = abs(signed)
+        header = np.zeros((3,), np.int32)
+    h = np.asarray(ops.broadcast(header, root_rank, name=f"{name}.len"))
+    failed, nbytes = int(h[0]), (int(h[1]) << 31) | int(h[2])
     if basics.rank() != root_rank:
         payload = np.zeros((nbytes,), dtype=np.uint8)
     data = ops.broadcast(payload, root_rank, name=f"{name}.data")
     result = pickle.loads(np.asarray(data).tobytes())
-    if signed < 0:
+    if failed:
         raise RuntimeError(result)  # same error, every rank
     return result

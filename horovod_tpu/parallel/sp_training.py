@@ -1,0 +1,116 @@
+"""Sequence-parallel (+ data-parallel) LM training over a (dp, sp) mesh.
+
+No reference counterpart — Horovod 0.18.2 is data-parallel only (SURVEY §5
+"Long-context: absent") — this is the framework's first-class long-context
+training path. Composition:
+
+  * mesh ``(dp, sp)``: batch sharded over ``dp``, sequence sharded over
+    ``sp``; params and optimizer state replicated.
+  * the model's attention is ring attention over ``sp``
+    (`ring_attention.py`): K/V blocks rotate the ring via ``lax.ppermute``
+    (ICI neighbor hops) while each hop's block compute runs the Pallas flash
+    kernel; activations per chip stay O(T/sp).
+  * backward: AD of ``ppermute`` is the reverse ring — XLA schedules the
+    reverse hops exactly like the forward ones. Parameter gradients are the
+    ``pmean`` over BOTH axes of each shard's local-loss gradient — with
+    equal-size shards this equals the gradient of the global mean loss, the
+    same invariant as the reference's DP gradient averaging
+    (`tensorflow/__init__.py:117`), extended to the sequence axis.
+
+Usage::
+
+    mesh  = make_dp_sp_mesh(dp=2, sp=4)
+    model = sp_model(TransformerLMTiny, vocab_size=V)   # ring attention
+    step  = make_sp_train_step(model, optax.adamw(3e-4), mesh)
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring_attention import ring_attention
+
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+
+
+def make_dp_sp_mesh(dp: int, sp: int, devices=None) -> Mesh:
+    """(dp, sp) mesh over the first dp*sp devices. On real hardware, lay sp
+    along the ICI ring (ring attention hops are neighbor transfers)."""
+    devices = list(jax.devices() if devices is None else devices)[:dp * sp]
+    if len(devices) < dp * sp:
+        raise ValueError(f"need {dp * sp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(dp, sp), (DP_AXIS, SP_AXIS))
+
+
+def sp_model(model_cls, sp_axis: str = SP_AXIS, **kwargs):
+    """Instantiate a model class (e.g. ``TransformerLM``) with ring attention
+    over ``sp_axis`` as its attention function."""
+    attn = partial(ring_attention, axis_name=sp_axis, causal=True)
+    return model_cls(attn_fn=attn, **kwargs)
+
+
+def make_sp_train_step(model, tx, mesh: Mesh, dp_axis: str = DP_AXIS,
+                       sp_axis: str = SP_AXIS):
+    """Jitted full training step: ``(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss)``.
+
+    ``tokens``/``targets`` are GLOBAL ``[B, T]`` int arrays (shift-by-one
+    target construction happens before sharding, so next-token targets are
+    correct across shard boundaries); the step shards them ``P(dp, sp)``.
+    """
+    import optax
+
+    def local_step(params, opt_state, tokens, targets):
+        t_local = tokens.shape[1]
+        off = lax.axis_index(sp_axis) * t_local
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, pos_offset=off)
+            from ..models.transformer import lm_loss
+
+            return lm_loss(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = lax.pmean(grads, (dp_axis, sp_axis))
+        loss = lax.pmean(loss, (dp_axis, sp_axis))
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    data_spec = P(dp_axis, sp_axis)
+    fn = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), data_spec, data_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def make_sp_forward(model, mesh: Mesh, dp_axis: str = DP_AXIS,
+                    sp_axis: str = SP_AXIS):
+    """Jitted sequence-parallel forward: global [B, T] tokens -> logits."""
+
+    def local_fwd(params, tokens):
+        off = lax.axis_index(sp_axis) * tokens.shape[1]
+        return model.apply({"params": params}, tokens, pos_offset=off)
+
+    data_spec = P(dp_axis, sp_axis)
+    fn = jax.shard_map(local_fwd, mesh=mesh,
+                       in_specs=(P(), data_spec),
+                       out_specs=P(dp_axis, sp_axis), check_vma=False)
+    return jax.jit(fn)
+
+
+def replicate_to_mesh(tree, mesh: Mesh):
+    """Place a pytree replicated on every device of ``mesh``."""
+    return jax.device_put(tree, NamedSharding(mesh, P()))

@@ -84,7 +84,9 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 sc.setJobGroup(job_group, "horovod_tpu.spark.run",
                                interruptOnCancel=True)
             except Exception:
-                pass
+                # untagged job = unobservable by the watchdog; mark it so the
+                # driver waits instead of cancelling healthy work it can't see
+                out["untagged"] = True
             out["results"] = rdd.mapPartitions(mapper).collect()
         except BaseException as e:  # surfaced after join
             out["error"] = e
@@ -95,8 +97,9 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 if start_timeout and start_timeout > 0 else None)
     started = deadline is None
     while t.is_alive():
-        if not started and _tasks_running(sc, num_proc, job_group):
-            started = True  # startup done; stop watching the clock
+        if not started and ("untagged" in out
+                            or _tasks_running(sc, num_proc, job_group)):
+            started = True  # startup done (or unobservable); stop the clock
         if started:
             t.join(1.0)
         elif _time.time() >= deadline:

@@ -1,0 +1,56 @@
+"""Stall inspector behavior (parity: `test/test_stall.py` + the warn/shutdown
+knobs `stall_inspector.h:39-80`, env `common.h:73-75`).
+
+The reference drives a real 2-rank run where one rank delays its submission;
+here the ranks are the in-process cluster threads, and the engine's background
+tick performs the same coordinator-side bookkeeping."""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+
+
+def test_stall_warning_then_completion(monkeypatch, caplog):
+    """A rank submitting late triggers the coordinator warning, then the op
+    completes normally once all ranks arrive."""
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.2")
+
+    def fn():
+        if hvd.rank() == 1:
+            time.sleep(0.8)  # > stall warning threshold
+        out = hvd.allreduce(np.full((4,), float(hvd.rank() + 1),
+                                    np.float32), name="slow", op=hvd.Sum)
+        return np.asarray(out)
+
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        results = testing.run_cluster(fn, np=2)
+    for r in results:
+        np.testing.assert_allclose(r, np.full((4,), 3.0))
+    messages = [rec.getMessage() for rec in caplog.records]
+    assert any("waiting for remainder of ranks" in m for m in messages), messages
+    assert any("slow" in m for m in messages)
+
+
+def test_stall_shutdown(monkeypatch):
+    """HOROVOD_STALL_SHUTDOWN_TIME_SECONDS kills the job when a rank never
+    shows up (`stall_inspector.h:80`): outstanding handles fail instead of
+    hanging forever."""
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.1")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.3")
+
+    def fn():
+        if hvd.rank() == 0:
+            # rank 1 never submits "never" — this must raise, not hang
+            with pytest.raises(hvd.HorovodInternalError):
+                hvd.allreduce(np.ones((4,), np.float32), name="never",
+                              op=hvd.Sum)
+            return True
+        time.sleep(1.0)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
