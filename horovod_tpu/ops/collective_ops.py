@@ -137,11 +137,13 @@ def join() -> int:
     (`operations.cc:908-934`, `torch/mpi_ops.py:495-509`). Returns the id of
     the last rank to join."""
     st = basics._require_init()
-    if st.mode == "multiprocess" and st.size > 1:
-        raise NotImplementedError(
-            "join() requires the cross-process control plane, which is not "
-            "yet implemented in multiprocess mode.")
     eng = basics._engine()
+    if (st.mode == "multiprocess" and st.size > 1
+            and not getattr(eng.controller, "coordinated", False)):
+        raise NotImplementedError(
+            "join() in multiprocess mode requires the cross-process control "
+            "plane (launch via hvdrun / horovod_tpu.run so ranks share a "
+            "coordinator address channel).")
     h = eng.join(basics.rank())
     return eng.handles.synchronize(h)
 

@@ -53,6 +53,37 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
     fusion_threshold = int(_env_float("HOROVOD_FUSION_THRESHOLD",
                                       DEFAULT_FUSION_BYTES))
     cycle_ms = _env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_MS)
+    if mode == "multiprocess" and world > 1:
+        # cross-process control plane: negotiation/validation/fusion/
+        # allgather/join coordinated at rank 0 (controller.cc:55-336 +
+        # mpi_controller.cc:107-161 parity). The decision to use it must be
+        # IDENTICAL on every rank — has_address_channel() depends only on
+        # launcher env / jax.distributed state, which are uniform across the
+        # job — and once taken, a setup failure is fatal: a per-rank silent
+        # fallback would leave ranks on different control planes and hang.
+        from .coordinator import CoordController, has_address_channel
+
+        if has_address_channel():
+            ctrl = CoordController(
+                world=world,
+                fusion_threshold=fusion_threshold,
+                stall_warning_s=_env_float(
+                    "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+                stall_shutdown_s=_env_float(
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+                cache_capacity=int(_env_float("HOROVOD_CACHE_CAPACITY", 1024)),
+                fusion_enabled=True,
+                timeline_path=(os.environ.get("HOROVOD_TIMELINE")
+                               if self_rank == 0 else None),
+                autotune=False,
+                cycle_time_ms=cycle_ms,
+                self_rank=self_rank,
+            )
+            return ctrl, False
+        logger.warning(
+            "no coordinator address channel (no HVD_KV_ADDR and no "
+            "jax.distributed KV); using SPMD program-order agreement "
+            "(fusion/allgather/join disabled)")
     kwargs = dict(
         world=world,
         fusion_threshold=fusion_threshold,
@@ -124,6 +155,10 @@ class Engine:
                 return
             self._shutdown = True
             self._wake.notify_all()
+        # a coordinated controller may be blocked mid-exchange; unblock it
+        interrupt = getattr(self.controller, "interrupt", None)
+        if interrupt is not None:
+            interrupt()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -219,6 +254,15 @@ class Engine:
                         "Stalled tensors exceeded "
                         "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting "
                         "(stall_inspector.h:80).")
+            except ShutdownError as exc:
+                # coordinated shutdown (a peer sent BYE / the coordinator
+                # broadcast the shutdown flag): drain quietly — this is the
+                # normal end-of-job path in multiprocess mode
+                logger.info("engine: %s", exc)
+                with self._lock:
+                    self._shutdown = True
+                    self._drain()
+                return
             except Exception as exc:
                 logger.error("engine thread aborting: %s", exc)
                 with self._lock:

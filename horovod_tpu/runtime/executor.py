@@ -50,6 +50,12 @@ class Executor:
         pid = jax.process_index()
         self._local_ranks = [r for r, d in enumerate(self._rank_devices)
                              if d.process_index == pid]
+        # multiprocess: only this process's entries are visible; shapes/
+        # dtypes of remote contributions come from the negotiated Response
+        # metadata (coordinator.py), letting joined ranks execute collectives
+        # they have no local entries for
+        self._multiproc = state.mode == "multiprocess"
+        self._self_rank = state.rank0
         # compiled-collective cache (ResponseCache analogue)
         self._fn_cache: Dict[Tuple, Any] = {}
 
@@ -234,6 +240,8 @@ class Executor:
         import jax.numpy as jnp
 
         world = self._world
+        if self._multiproc and response.tensor_shapes:
+            return self._exec_allreduce_mp(response, entries_by_rank, adasum)
         ranks = sorted(entries_by_rank)
         template = entries_by_rank[ranks[0]]
         shapes = [tuple(e.array.shape) for e in template]
@@ -271,6 +279,40 @@ class Executor:
             for r in ranks
         }
 
+    def _exec_allreduce_mp(self, response, entries_by_rank, adasum):
+        """Coordinated multiprocess allreduce/adasum: shapes, dtype and scale
+        factors come from the negotiated Response so a joined rank (no local
+        entries) still executes the identical multi-controller program,
+        contributing zeros (`controller.cc:202-256`, `operations.cc:908-934`).
+        """
+        import jax.numpy as jnp
+
+        world = self._world
+        r = self._self_rank
+        shapes = [tuple(s) for s in response.tensor_shapes]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        dtype = response.tensor_dtype
+        length = int(sum(sizes))
+
+        entries = entries_by_rank.get(r)
+        if entries is not None:
+            buf = self._pack(entries)
+        else:
+            buf = self._jax.device_put(jnp.zeros((length,), dtype=dtype),
+                                       self._rank_devices[r])
+        g = self._global_array([buf], length)
+        if adasum:
+            fn = self._adasum_fn(world, length, dtype)
+        else:
+            fn = self._allreduce_fn(world, length, dtype, response.average,
+                                    response.prescale, response.postscale)
+        out = fn(g)
+        if entries is None:
+            self._jax.block_until_ready(out)
+            return {}
+        rows = self._shard_by_rank(out)
+        return {r: self._unpack_row(rows[r], shapes, sizes)}
+
     def _unpack_row(self, row, shapes, sizes):
         # row: (1, L) on the rank's device; slice back out
         # (MemcpyOutFusionBuffer analogue)
@@ -283,6 +325,8 @@ class Executor:
 
     def _exec_allgather(self, response, entries_by_rank):
         world = self._world
+        if self._multiproc and response.tensor_sizes:
+            return self._exec_allgather_mp(response, entries_by_rank)
         ranks = sorted(entries_by_rank)
         nt = len(entries_by_rank[ranks[0]])
         # per-rank buffer layout and lengths (ragged -> pad to max)
@@ -318,6 +362,45 @@ class Executor:
         return {r: [self._jax.device_put(o, self._rank_devices[r])
                     for o in outs]
                 for r in ranks}
+
+    def _exec_allgather_mp(self, response, entries_by_rank):
+        """Coordinated multiprocess allgather: every rank's dim0 comes from
+        the negotiated ``Response.tensor_sizes`` (the reference's allgatherv
+        displacement math, `collective_operations.h:91-125`), so ragged
+        gathers work with only the local entries visible."""
+        import jax.numpy as jnp
+
+        world = self._world
+        r = self._self_rank
+        entries = entries_by_rank[r]  # allgather+join is rejected upstream
+        nt = len(response.tensor_names)
+        tails = [tuple(s[1:]) for s in response.tensor_shapes]
+        elems = [int(np.prod(t)) if t else 1 for t in tails]
+        dtype = response.tensor_dtype
+        # per-source total buffer length (entries packed in response order)
+        len_r = [sum(int(response.tensor_sizes[t][src]) * elems[t]
+                     for t in range(nt)) for src in range(world)]
+        lmax = max(len_r)
+
+        buf = self._pack(entries, pad_to=lmax)
+        g = self._global_array([buf], lmax)
+        full = self._allgather_fn(world, lmax, dtype)(g)  # replicated
+        # slice on this process's addressable copy (the global replicated
+        # array is not device_put-able across processes)
+        local = full.addressable_data(0)
+
+        outs = []
+        for t in range(nt):
+            segs = []
+            for src in range(world):
+                off = sum(int(response.tensor_sizes[u][src]) * elems[u]
+                          for u in range(t))
+                sz = int(response.tensor_sizes[t][src]) * elems[t]
+                segs.append(jnp.ravel(local[src])[off:off + sz])
+            cat = jnp.concatenate(segs)
+            d0 = int(sum(response.tensor_sizes[t]))
+            outs.append(cat.reshape((d0,) + tails[t]))
+        return {r: outs}
 
     def _exec_broadcast(self, response, entries_by_rank):
         world = self._world

@@ -66,6 +66,17 @@ class Response:
     # this division in-framework (`tensorflow/__init__.py:117`) — here it fuses
     # into the compiled collective.
     average: bool = False
+    prescale: float = 1.0
+    postscale: float = 1.0
+    root_rank: int = -1
+    # Metadata the cross-process plane negotiates so a rank can participate in
+    # a collective it has no local entries for (joined ranks contribute zeros,
+    # `controller.cc:202-256`) and so ragged allgathers know every rank's dim0
+    # (Response::tensor_sizes in the reference):
+    tensor_dtype: str = ""
+    # per-tensor shape of the rank-0 instance (allgather: dim0 is rank 0's;
+    # use tensor_sizes for the negotiated per-rank dim0s)
+    tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
 
 
 @dataclass

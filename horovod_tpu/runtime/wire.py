@@ -14,6 +14,39 @@ from typing import List, Tuple
 from .messages import Response, ResponseType
 
 
+class Writer:
+    """Symmetric encoder (the C++ core has its own in `_core/wire.h`; this one
+    serves the Python-owned cross-process control plane)."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def u8(self, v: int) -> None:
+        self.parts.append(struct.pack("<B", v))
+
+    def u32(self, v: int) -> None:
+        self.parts.append(struct.pack("<I", v))
+
+    def i32(self, v: int) -> None:
+        self.parts.append(struct.pack("<i", v))
+
+    def i64(self, v: int) -> None:
+        self.parts.append(struct.pack("<q", v))
+
+    def f64(self, v: float) -> None:
+        self.parts.append(struct.pack("<d", v))
+
+    def str(self, s: str) -> None:
+        b = s.encode("utf-8")
+        self.u32(len(b))
+        self.parts.append(b)
+
+    def getvalue(self) -> bytes:
+        return b"".join(self.parts)
+
+
 class Reader:
     __slots__ = ("buf", "off")
 
@@ -92,3 +125,167 @@ def decode_tick(buf: bytes):
 def decode_handle_list(buf: bytes) -> List[int]:
     rd = Reader(buf)
     return [rd.i64() for _ in range(rd.u32())]
+
+
+# --------------------------------------------------------------------------
+# Cross-process control plane messages (coordinator gather/bcast payloads).
+# Parity: the serialized RequestList/ResponseList the reference gathers to
+# rank 0 and broadcasts back (`message.cc:143-170` FlatBuffers encode;
+# `mpi/mpi_controller.cc:107-161` transport). Layout is this repo's
+# little-endian length-prefixed wire format, not FlatBuffers.
+# --------------------------------------------------------------------------
+
+class ReqMeta:
+    """One rank's request metadata as seen by the coordinator
+    (message.h Request)."""
+
+    __slots__ = ("name", "rtype", "dtype", "shape", "root_rank", "average",
+                 "prescale", "postscale")
+
+    def __init__(self, name: str, rtype: int, dtype: str,
+                 shape: Tuple[int, ...], root_rank: int = -1,
+                 average: bool = False, prescale: float = 1.0,
+                 postscale: float = 1.0):
+        self.name = name
+        self.rtype = rtype
+        self.dtype = dtype
+        self.shape = tuple(shape)
+        self.root_rank = root_rank
+        self.average = average
+        self.prescale = prescale
+        self.postscale = postscale
+
+    def sig(self) -> Tuple:
+        """Cache signature: everything negotiation depends on
+        (`response_cache.h:45-97` keys entries the same way)."""
+        return (self.name, self.rtype, self.dtype, self.shape,
+                self.root_rank, self.average, self.prescale, self.postscale)
+
+
+# RequestList flags
+REQ_JOIN = 1
+
+# ResponseList flags
+RESP_SHUTDOWN = 1
+RESP_JOIN_RELEASE = 2
+
+
+def encode_request_list(flags: int, cached_ids: List[int],
+                        new_reqs: List[ReqMeta]) -> bytes:
+    w = Writer()
+    w.u8(flags)
+    w.u32(len(cached_ids))
+    for cid in cached_ids:
+        w.u32(cid)
+    w.u32(len(new_reqs))
+    for m in new_reqs:
+        w.str(m.name)
+        w.i32(m.rtype)
+        w.str(m.dtype)
+        w.u32(len(m.shape))
+        for d in m.shape:
+            w.i64(d)
+        w.i32(m.root_rank)
+        w.u8(int(m.average))
+        w.f64(m.prescale)
+        w.f64(m.postscale)
+    return w.getvalue()
+
+
+def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta]]:
+    rd = Reader(buf)
+    flags = rd.u8()
+    cached = [rd.u32() for _ in range(rd.u32())]
+    reqs = []
+    for _ in range(rd.u32()):
+        name = rd.str()
+        rtype = rd.i32()
+        dtype = rd.str()
+        shape = tuple(rd.i64() for _ in range(rd.u32()))
+        root = rd.i32()
+        avg = rd.u8() != 0
+        pre = rd.f64()
+        post = rd.f64()
+        reqs.append(ReqMeta(name, rtype, dtype, shape, root, avg, pre, post))
+    return flags, cached, reqs
+
+
+def encode_response_list(flags: int, last_joined: int,
+                         responses: List[Response],
+                         cache_assignments: List[List[int]],
+                         stall_warnings: List[str],
+                         shutdown_reason: str = "") -> bytes:
+    """``cache_assignments[i]`` parallels ``responses[i].tensor_names``:
+    coordinator-assigned cache id per tensor (-1 = uncached).
+    ``shutdown_reason`` distinguishes a normal end-of-job shutdown (empty)
+    from an abnormal abort (stall shutdown, peer loss)."""
+    w = Writer()
+    w.u8(flags)
+    w.str(shutdown_reason)
+    w.i32(last_joined)
+    w.u32(len(responses))
+    for resp, cids in zip(responses, cache_assignments):
+        w.i32(int(resp.response_type))
+        w.u32(len(resp.tensor_names))
+        for n in resp.tensor_names:
+            w.str(n)
+        w.str(resp.error_message)
+        w.str(resp.tensor_dtype)
+        w.u8(int(resp.average))
+        w.f64(resp.prescale)
+        w.f64(resp.postscale)
+        w.i32(resp.root_rank)
+        w.u32(len(resp.tensor_shapes))
+        for shp in resp.tensor_shapes:
+            w.u32(len(shp))
+            for d in shp:
+                w.i64(d)
+        w.u32(len(resp.tensor_sizes))
+        for sizes in resp.tensor_sizes:
+            w.u32(len(sizes))
+            for d in sizes:
+                w.i64(d)
+        w.u32(len(cids))
+        for cid in cids:
+            w.i32(cid)
+    w.u32(len(stall_warnings))
+    for s in stall_warnings:
+        w.str(s)
+    return w.getvalue()
+
+
+def decode_response_list(buf: bytes):
+    rd = Reader(buf)
+    flags = rd.u8()
+    shutdown_reason = rd.str()
+    last_joined = rd.i32()
+    responses: List[Response] = []
+    assignments: List[List[int]] = []
+    for _ in range(rd.u32()):
+        rtype = ResponseType(rd.i32())
+        names = [rd.str() for _ in range(rd.u32())]
+        err = rd.str()
+        dtype = rd.str()
+        avg = rd.u8() != 0
+        pre = rd.f64()
+        post = rd.f64()
+        root = rd.i32()
+        shapes = []
+        for _ in range(rd.u32()):
+            shapes.append(tuple(rd.i64() for _ in range(rd.u32())))
+        sizes = []
+        for _ in range(rd.u32()):
+            sizes.append([rd.i64() for _ in range(rd.u32())])
+        cids = [rd.i32() for _ in range(rd.u32())]
+        resp = Response(rtype, names, error_message=err, average=avg)
+        resp.tensor_dtype = dtype
+        resp.prescale = pre
+        resp.postscale = post
+        resp.root_rank = root
+        resp.tensor_shapes = shapes
+        resp.tensor_sizes = sizes
+        responses.append(resp)
+        assignments.append(cids)
+    warnings = [rd.str() for _ in range(rd.u32())]
+    return flags, last_joined, responses, assignments, warnings, \
+        shutdown_reason
