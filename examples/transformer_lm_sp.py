@@ -72,9 +72,11 @@ def main():
     params = replicate_to_mesh(params, mesh)
     opt_state = replicate_to_mesh(opt_state, mesh)
 
+    loss = None
     for i in range(args.warmup):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
-    jax.block_until_ready(loss)
+    if loss is not None:
+        jax.block_until_ready(loss)
 
     t0 = time.time()
     for i in range(args.steps):

@@ -71,22 +71,32 @@ class LearningRateScheduleCallback(Callback):
 
     def __init__(self, multiplier, start_epoch: int = 0,
                  end_epoch: Optional[int] = None, staircase: bool = True,
-                 initial_lr: Optional[float] = None):
+                 initial_lr: Optional[float] = None,
+                 steps_per_epoch: Optional[int] = None):
         self.start_epoch = start_epoch
         self.end_epoch = end_epoch
         self.staircase = staircase
         self.initial_lr = initial_lr
+        self.steps_per_epoch = steps_per_epoch
         if not callable(multiplier):
             self._mult = lambda epoch: multiplier
         else:
             self._mult = multiplier
         self._current_epoch = 0
+        self._batches_this_epoch = 0
+        self._learned_steps: Optional[int] = None
+        self._warned_no_steps = False
 
     def _in_range(self, epoch):
         return (epoch >= self.start_epoch
                 and (self.end_epoch is None or epoch < self.end_epoch))
 
     def on_epoch_begin(self, epoch, state):
+        if self._batches_this_epoch:
+            # learn steps/epoch from the epoch just finished so smooth
+            # schedules work even when the loop never declared it
+            self._learned_steps = self._batches_this_epoch
+        self._batches_this_epoch = 0
         self._current_epoch = epoch
         base = self.initial_lr if self.initial_lr is not None else \
             state.get("base_lr", state.get("lr"))
@@ -97,9 +107,27 @@ class LearningRateScheduleCallback(Callback):
             state["lr"] = state["base_lr"] * self._mult(epoch)
 
     def on_batch_end(self, batch, state):
+        self._batches_this_epoch += 1
         if not self.staircase and self._in_range(self._current_epoch):
-            # smooth schedule: fractional epoch
-            frac = self._current_epoch + state.get("_batch_frac", 0.0)
+            # Smooth schedule needs a fractional epoch (reference reads
+            # Keras `params['steps']`): declared steps_per_epoch wins;
+            # otherwise use the count learned from the previous epoch.
+            # During the very first epoch with neither, hold the
+            # epoch-begin lr and warn once instead of crashing the loop.
+            steps = (self.steps_per_epoch or state.get("steps_per_epoch")
+                     or self._learned_steps)
+            if not steps:
+                if not self._warned_no_steps:
+                    import warnings
+
+                    warnings.warn(
+                        "smooth LR schedule has no steps_per_epoch yet "
+                        "(pass it to the callback or set "
+                        "state['steps_per_epoch']); lr will move at epoch "
+                        "granularity until one epoch has completed")
+                    self._warned_no_steps = True
+                return
+            frac = self._current_epoch + min(1.0, (batch + 1) / float(steps))
             state["lr"] = state["base_lr"] * self._mult(frac)
 
 
@@ -108,7 +136,8 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     (`_keras/callbacks.py:137-185`, Goyal et al. linear scaling)."""
 
     def __init__(self, warmup_epochs: int = 5, momentum_correction: bool = True,
-                 initial_lr: Optional[float] = None, verbose: bool = False):
+                 initial_lr: Optional[float] = None, verbose: bool = False,
+                 steps_per_epoch: Optional[int] = None):
         self.warmup_epochs = warmup_epochs
         self.verbose = verbose
         size = basics.size() if basics.is_initialized() else 1
@@ -121,9 +150,13 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
             p = epoch / float(warmup_epochs)
             return size * p + (1 - p)
 
+        # The smooth ramp only applies within [0, warmup_epochs); afterwards
+        # on_epoch_begin pins lr at base*size (reference passes the same
+        # end_epoch, `_keras/callbacks.py:137-185`).
         super().__init__(multiplier, start_epoch=0,
-                         end_epoch=None, staircase=False,
-                         initial_lr=initial_lr)
+                         end_epoch=warmup_epochs, staircase=False,
+                         initial_lr=initial_lr,
+                         steps_per_epoch=steps_per_epoch)
 
     def on_epoch_begin(self, epoch, state):
         super().on_epoch_begin(epoch, state)

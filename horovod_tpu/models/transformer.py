@@ -47,7 +47,8 @@ class Block(nn.Module):
     def __call__(self, x):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
-        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
+                        kernel_init=nn.initializers.normal(0.02))
         ln = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
 
         h = ln(name="ln_attn")(x)
@@ -56,9 +57,8 @@ class Block(nn.Module):
         b, t = q.shape[:2]
         shp = (b, t, self.num_heads, head_dim)
         out = self.attn_fn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
-        out = dense(d_model, name="proj",
-                    kernel_init=nn.initializers.normal(0.02))(
-                        out.astype(self.dtype).reshape(b, t, d_model))
+        out = dense(d_model, name="proj")(
+            out.astype(self.dtype).reshape(b, t, d_model))
         x = x + out
 
         h = ln(name="ln_mlp")(x)
@@ -90,7 +90,22 @@ class TransformerLM(nn.Module):
             "pos_emb", nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model), jnp.float32)
 
+        # jnp.take clips out-of-range indices, which would silently reuse the
+        # last position embedding — fail loudly instead. pos_offset is traced
+        # under sequence parallelism (lax.axis_index), so only statically
+        # checkable pieces are validated here.
         t = tokens.shape[1]
+        import numpy as _np
+        if isinstance(pos_offset, (int, _np.integer)):
+            pos_offset = int(pos_offset)
+            if pos_offset + t > self.max_seq_len:
+                raise ValueError(
+                    f"sequence [{pos_offset}, {pos_offset + t}) exceeds "
+                    f"max_seq_len={self.max_seq_len}")
+        elif t > self.max_seq_len:
+            raise ValueError(
+                f"local sequence length {t} exceeds "
+                f"max_seq_len={self.max_seq_len}")
         pos = pos_offset + jnp.arange(t)
         x = emb(tokens) + jnp.take(pos_table, pos, axis=0).astype(self.dtype)
         for i in range(self.num_layers):

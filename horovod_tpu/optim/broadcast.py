@@ -94,7 +94,7 @@ def broadcast_from_root(producer, root_rank: int = 0,
     peers would hang in broadcast forever. Non-root ranks never call
     ``producer`` (the resource may only exist on root's host).
 
-    Wire format: a 2xint32 header (error flag, then the payload length split
+    Wire format: a 3xint32 header (error flag, then the payload length split
     into two int32 halves — int64 would be silently canonicalized to int32 by
     the collective layer when jax_enable_x64 is off, wrapping for >= 2 GiB
     payloads) followed by the uint8 payload.

@@ -59,6 +59,22 @@ def sp_model(model_cls, sp_axis: str = SP_AXIS, **kwargs):
     return model_cls(attn_fn=attn, **kwargs)
 
 
+def _check_global_seq_len(model, t_local: int, mesh: Mesh, sp_axis: str):
+    """Inside shard_map the model only sees the LOCAL block, so its own
+    bounds check can't catch a GLOBAL sequence longer than max_seq_len
+    (pos_offset is traced). The global length sp * t_local is static here —
+    enforce it at trace time so over-length SP runs fail loudly instead of
+    jnp.take silently clipping position embeddings."""
+    max_len = getattr(model, "max_seq_len", None)
+    if max_len is not None:
+        t_global = mesh.shape[sp_axis] * t_local
+        if t_global > max_len:
+            raise ValueError(
+                f"global sequence length {t_global} "
+                f"({mesh.shape[sp_axis]} sp shards x {t_local}) exceeds "
+                f"model max_seq_len={max_len}")
+
+
 def make_sp_train_step(model, tx, mesh: Mesh, dp_axis: str = DP_AXIS,
                        sp_axis: str = SP_AXIS):
     """Jitted full training step: ``(params, opt_state, tokens, targets) ->
@@ -72,6 +88,7 @@ def make_sp_train_step(model, tx, mesh: Mesh, dp_axis: str = DP_AXIS,
 
     def local_step(params, opt_state, tokens, targets):
         t_local = tokens.shape[1]
+        _check_global_seq_len(model, t_local, mesh, sp_axis)
         off = lax.axis_index(sp_axis) * t_local
 
         def loss_fn(p):
@@ -101,6 +118,7 @@ def make_sp_forward(model, mesh: Mesh, dp_axis: str = DP_AXIS,
     """Jitted sequence-parallel forward: global [B, T] tokens -> logits."""
 
     def local_fwd(params, tokens):
+        _check_global_seq_len(model, tokens.shape[1], mesh, sp_axis)
         off = lax.axis_index(sp_axis) * tokens.shape[1]
         return model.apply({"params": params}, tokens, pos_offset=off)
 

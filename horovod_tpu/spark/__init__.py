@@ -96,12 +96,35 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     deadline = (_time.time() + start_timeout
                 if start_timeout and start_timeout > 0 else None)
     started = deadline is None
+    tracker_dead_since = None
     while t.is_alive():
-        if not started and ("untagged" in out
-                            or _tasks_running(sc, num_proc, job_group)):
-            started = True  # startup done (or unobservable); stop the clock
+        if not started:
+            running = _tasks_running(sc, num_proc, job_group)
+            if running is None:
+                tracker_dead_since = tracker_dead_since or _time.time()
+            else:
+                tracker_dead_since = None
+            if (tracker_dead_since is not None
+                    and _time.time() - tracker_dead_since >= 30.0):
+                # Tracker continuously unobservable for 30s — API missing on
+                # this Spark version/config, not a transient hiccup: better
+                # to wait forever on a live job than kill one we cannot see,
+                # but say so.
+                import warnings
+
+                warnings.warn(
+                    "horovod_tpu.spark.run: Spark status tracker has been "
+                    "unavailable for 30s; startup timeout is disabled for "
+                    "this job")
+                started = True
+            elif "untagged" in out or running:
+                started = True  # startup done (or unobservable); stop the clock
         if started:
             t.join(1.0)
+        elif running is None:
+            # tracker blind right now: never kill a job we cannot see, even
+            # past the deadline (the 30s disarm above bounds this state)
+            t.join(0.1)
         elif _time.time() >= deadline:
             try:
                 sc.cancelJobGroup(job_group)
@@ -129,12 +152,13 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     return [pickle.loads(blob) for _, _, blob in by_rank]
 
 
-def _tasks_running(sc, num_proc: int, job_group: str) -> bool:
+def _tasks_running(sc, num_proc: int, job_group: str):
     """True once Spark reports >= num_proc active tasks in OUR job group
     (barrier mode starts all-or-nothing; scoping to the group keeps
     concurrent unrelated jobs from masking a stuck barrier stage).
-    Unobservable trackers count as started — better to wait forever on a
-    live job than kill one we cannot see."""
+    Returns None when the tracker query itself fails, so the caller can
+    tell "not started yet" apart from "tracker unobservable" — a transient
+    query error must not silently disarm the startup timeout."""
     try:
         tracker = sc.statusTracker()
         total = 0
@@ -148,7 +172,7 @@ def _tasks_running(sc, num_proc: int, job_group: str) -> bool:
                     total += sinfo.numActiveTasks
         return total >= num_proc
     except Exception:
-        return True
+        return None
 
 
 def _serialize(obj) -> bytes:

@@ -50,6 +50,72 @@ def test_lr_schedule_staircase():
     assert state["lr"] == pytest.approx(0.1)
 
 
+def test_lr_schedule_smooth_moves_within_epoch():
+    """Non-staircase schedules must update lr every batch using the
+    fractional epoch (reference `_keras/callbacks.py:87-134`)."""
+    hvd.init()
+    cb = LearningRateScheduleCallback(
+        multiplier=lambda e: 1.0 / (1.0 + e), staircase=False,
+        initial_lr=1.0, steps_per_epoch=4)
+    state = {"lr": 1.0}
+    cb.on_epoch_begin(0, state)
+    seen = []
+    for b in range(4):
+        cb.on_batch_end(b, state)
+        seen.append(state["lr"])
+    # frac epochs 0.25, 0.5, 0.75, 1.0 -> lr strictly decreasing
+    assert seen == sorted(seen, reverse=True)
+    assert seen[0] == pytest.approx(1.0 / 1.25)
+    assert seen[-1] == pytest.approx(0.5)
+    # steps_per_epoch may come from state instead of the ctor
+    cb2 = LearningRateScheduleCallback(
+        multiplier=lambda e: 1.0 / (1.0 + e), staircase=False, initial_lr=1.0)
+    state2 = {"lr": 1.0, "steps_per_epoch": 2}
+    cb2.on_epoch_begin(0, state2)
+    cb2.on_batch_end(0, state2)
+    assert state2["lr"] == pytest.approx(1.0 / 1.5)
+    # With no steps info at all: warn once, hold lr for the first epoch,
+    # then auto-learn steps/epoch from the completed epoch's batch count.
+    cb3 = LearningRateScheduleCallback(
+        multiplier=lambda e: 1.0 / (1.0 + e), staircase=False, initial_lr=1.0)
+    state3 = {"lr": 1.0}
+    cb3.on_epoch_begin(0, state3)
+    with pytest.warns(UserWarning, match="steps_per_epoch"):
+        cb3.on_batch_end(0, state3)
+    cb3.on_batch_end(1, state3)
+    assert state3["lr"] == pytest.approx(1.0)  # held during epoch 0
+    cb3.on_epoch_begin(1, state3)
+    cb3.on_batch_end(0, state3)                # learned steps=2 -> frac 1.5
+    assert state3["lr"] == pytest.approx(1.0 / 2.5)
+
+
+def test_lr_warmup_smooth_ramp_within_epoch():
+    """Warmup with steps_per_epoch ramps lr inside each warmup epoch."""
+    def fn():
+        cb = LearningRateWarmupCallback(warmup_epochs=2, initial_lr=0.1,
+                                        steps_per_epoch=2)
+        state = {"lr": 0.1}
+        cb.on_epoch_begin(0, state)
+        lrs = [state["lr"]]
+        for b in range(2):
+            cb.on_batch_end(b, state)
+            lrs.append(state["lr"])
+        # after warmup the multiplier is constant; on_batch_end is inert
+        cb.on_epoch_begin(5, state)
+        lr5 = state["lr"]
+        cb.on_batch_end(0, state)
+        return lrs, lr5, state["lr"]
+
+    res = testing.run_cluster(fn, np=4)
+    for lrs, lr5, lr5_after_batch in res:
+        assert lrs == sorted(lrs)          # monotone ramp
+        assert lrs[0] == pytest.approx(0.1)
+        # frac epoch 1.0 of 2 -> halfway between 1x and size(=4)x: 2.5x
+        assert lrs[-1] == pytest.approx(0.25)
+        assert lr5 == pytest.approx(0.4)   # pinned at lr*size post-warmup
+        assert lr5_after_batch == pytest.approx(0.4)
+
+
 def test_lr_warmup_reaches_size_scale():
     def fn():
         cb = LearningRateWarmupCallback(warmup_epochs=4, initial_lr=0.1)

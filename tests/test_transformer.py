@@ -130,6 +130,45 @@ def test_pos_offset_changes_output():
     assert float(jnp.max(jnp.abs(a - b))) > 1e-4
 
 
+def test_over_length_sequence_fails_loudly():
+    """Positions past max_seq_len must raise, not silently clip to the last
+    position embedding (jnp.take clips by default)."""
+    model = _tiny()
+    rng = np.random.RandomState(5)
+    tokens, _ = _data(rng, 1, 32)
+    params = model.init(jax.random.PRNGKey(5), tokens)["params"]
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.apply({"params": params}, tokens,
+                    pos_offset=model.max_seq_len - 16)
+    long_toks = np.zeros((1, model.max_seq_len + 1), np.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.apply({"params": params}, long_toks)
+
+
+def test_sp_over_length_global_sequence_fails_loudly():
+    """Inside shard_map pos_offset is traced, so the model can't see the
+    GLOBAL length; the step builder must enforce sp*t_local <= max_seq_len
+    at trace time (silent jnp.take clipping otherwise)."""
+    import optax
+
+    mesh = make_dp_sp_mesh(dp=1, sp=4)
+    model = sp_model(TransformerLMTiny, vocab_size=VOCAB, dtype=jnp.float32)
+    rng = np.random.RandomState(6)
+    # global T = 4 * 160 = 640 > TransformerLMTiny max_seq_len 512
+    tokens, targets = _data(rng, 2, 640)
+    params = _tiny().init(jax.random.PRNGKey(6),
+                          tokens[:, :128])["params"]
+    fwd = make_sp_forward(model, mesh)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        fwd(replicate_to_mesh(params, mesh), tokens)
+    tx = optax.sgd(1e-3)
+    step = make_sp_train_step(model, tx, mesh)
+    opt_state = tx.init(params)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        step(replicate_to_mesh(params, mesh),
+             replicate_to_mesh(opt_state, mesh), tokens, targets)
+
+
 def test_sp_mesh_validation():
     with pytest.raises(ValueError, match="need 16 devices"):
         make_dp_sp_mesh(dp=4, sp=4)
