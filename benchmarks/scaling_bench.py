@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Weak-scaling harness — the BASELINE headline metric, finally measured.
+
+The reference's headline claim is *scaling efficiency*: 90% on Inception
+V3/ResNet-101 at 512 GPUs (`README.rst:74-79`, `docs/benchmarks.rst:13-14`),
+measured by running the same synthetic per-device batch at increasing world
+sizes. This harness does the TPU-native version: the jitted data-parallel
+train step (`spmd.make_train_step`) over meshes of 1, 2, 4, ... devices with
+a fixed per-device batch; efficiency(n) = throughput(n) / (n x throughput(1)).
+
+On real hardware the mesh is ICI; in CI it's the 8-device virtual CPU
+platform (same strategy as the test suite), which still measures the
+collective + SPMD-partitioning overhead share, just not ICI bandwidth.
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python benchmarks/scaling_bench.py
+
+Prints one JSON line per world size; final line is the summary
+{"metric": "weak_scaling_efficiency", ...} with efficiency at the largest n.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor an explicit CPU request even under the axon sitecustomize, which
+# pre-imports jax pointed at the TPU relay (same dance as tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map with replication checking off (the no-comm timing
+    variant deliberately lets params diverge), across jax API renames."""
+    import inspect
+
+    import jax
+
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    params = inspect.signature(jax.shard_map).parameters
+    for flag in ("check_vma", "check_rep"):
+        if flag in params:
+            kw[flag] = False
+            break
+    return jax.shard_map(f, **kw)
+
+
+def run_one(n, batch_per_device, image_size, iters, warmup, model_name):
+    """Returns (img/s with gradient allreduce, img/s without).
+
+    The no-comm variant runs the identical per-device program minus the
+    cross-device gradient reduction — on shared-core virtual devices this
+    isolates collective overhead from core contention; on real chips the
+    ratio is the classic scaling-efficiency numerator.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from horovod_tpu import models, spmd
+    from horovod_tpu.basics import MESH_AXIS
+
+    mesh = Mesh(np.asarray(jax.devices()[:n]), (MESH_AXIS,))
+    batch = batch_per_device * n
+    model_cls = getattr(models, model_name)
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = model_cls(num_classes=100, dtype=dtype)
+
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, image_size, image_size, 3),
+                                          jnp.float32), train=False)
+    tx = optax.sgd(0.01, momentum=0.9)
+
+    def local_loss(p, x, y):
+        logits = model.apply({"params": p,
+                              "batch_stats": variables.get("batch_stats", {})},
+                             x, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    def make_step(with_comm):
+        def local_step(p, o, x, y):
+            loss, grads = jax.value_and_grad(local_loss)(p, x, y)
+            if with_comm:
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, MESH_AXIS), grads)
+            updates, o = tx.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return p, o, loss
+
+        return jax.jit(_shard_map(
+            local_step, mesh,
+            in_specs=(P(), P(), P(MESH_AXIS), P(MESH_AXIS)),
+            out_specs=(P(), P(), P())))
+
+    x = np.random.RandomState(0).randn(
+        batch, image_size, image_size, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 100, (batch,))
+    data = spmd.shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+
+    rates = []
+    for with_comm in (True, False):
+        params = spmd.replicate(variables["params"], mesh)
+        opt_state = spmd.replicate(tx.init(variables["params"]), mesh)
+        step = make_step(with_comm)
+        loss = None
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, *data)
+        if loss is not None:
+            jax.block_until_ready(loss)
+        best = 0.0
+        for _ in range(3):  # best-of-3 rounds: host CPU timing is noisy
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                params, opt_state, loss = step(params, opt_state, *data)
+            jax.block_until_ready(loss)
+            best = max(best, batch * iters / (time.perf_counter() - t0))
+        rates.append(best)
+    return rates[0], rates[1]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="ResNet18",
+                    help="any horovod_tpu.models ResNet variant")
+    ap.add_argument("--batch-per-device", type=int, default=None)
+    ap.add_argument("--image-size", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--world-sizes", default=None,
+                    help="comma-separated; default 1,2,4,... up to all devices")
+    args = ap.parse_args(argv)
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    ndev = len(jax.devices())
+    bpd = args.batch_per_device or (128 if on_tpu else 4)
+    img = args.image_size or (224 if on_tpu else 32)
+    iters = args.iters or (20 if on_tpu else 5)
+    if args.world_sizes:
+        world = [int(s) for s in args.world_sizes.split(",")]
+    else:
+        world = [n for n in (2 ** i for i in range(10)) if n <= ndev]
+
+    shared_cores = jax.default_backend() == "cpu"
+    rates = {}
+    for n in world:
+        comm, nocomm = run_one(n, bpd, img, iters, args.warmup, args.model)
+        rates[n] = (comm, nocomm)
+        weak = comm / (n * rates[world[0]][0] / world[0])
+        print(json.dumps({
+            "world_size": n, "img_per_sec": round(comm, 1),
+            "per_device": round(comm / n, 1),
+            "weak_scaling_pct": round(100 * weak, 1),
+            "collective_efficiency_pct": round(100 * comm / nocomm, 1)}))
+
+    n_max = world[-1]
+    comm, nocomm = rates[n_max]
+    weak = comm / (n_max * rates[world[0]][0] / world[0])
+    # On the virtual CPU platform all "devices" share the host's physical
+    # cores, so raw weak scaling measures core contention; the collective
+    # efficiency (same contention, only the allreduce differs) is the
+    # meaningful number there. On real chips both are meaningful.
+    headline = 100 * comm / nocomm if shared_cores else 100 * weak
+    print(json.dumps({"metric": "weak_scaling_efficiency",
+                      "value": round(headline, 1), "unit": "%",
+                      "weak_scaling_raw_pct": round(100 * weak, 1),
+                      "collective_efficiency_pct":
+                          round(100 * comm / nocomm, 1),
+                      "config": {"model": args.model, "max_devices": n_max,
+                                 "batch_per_device": bpd,
+                                 "backend": jax.default_backend(),
+                                 "shared_core_virtual_devices":
+                                     shared_cores}}))
+    return rates
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""Model zoo: the families the reference benchmarks/examples exercise
+(`examples/tensorflow2_synthetic_benchmark.py:35-40`, Keras/torchvision
+ResNets) plus the long-context transformer flagship."""
+
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
+                     ResNet152)
+from .transformer import TransformerLM
+
+__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+           "ResNet152", "TransformerLM"]

@@ -76,6 +76,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -90,11 +91,15 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # remat trades HBM traffic for recompute: without it the pre-BN conv
+        # outputs are materialised for the backward pass, with it only block
+        # boundaries are stored (see jax.checkpoint; useful when HBM-bound).
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(self.num_filters * 2 ** i, strides,
-                                   conv=conv, norm=norm)(x)
+                x = block_cls(self.num_filters * 2 ** i, strides,
+                              conv=conv, norm=norm)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32)(x)

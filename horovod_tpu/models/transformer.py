@@ -95,9 +95,17 @@ class TransformerLM(nn.Module):
         # under sequence parallelism (lax.axis_index), so only statically
         # checkable pieces are validated here.
         t = tokens.shape[1]
-        import numpy as _np
-        if isinstance(pos_offset, (int, _np.integer)):
+        # Concrete values (python/numpy ints AND un-traced jax scalars) get
+        # the exact offset+t bound; only genuinely traced offsets (sequence
+        # parallelism's lax.axis_index) fall through to the local-length
+        # check.
+        try:
             pos_offset = int(pos_offset)
+            concrete = True
+        except (TypeError, jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError):
+            concrete = False
+        if concrete:
             if pos_offset + t > self.max_seq_len:
                 raise ValueError(
                     f"sequence [{pos_offset}, {pos_offset + t}) exceeds "

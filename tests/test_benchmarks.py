@@ -1,0 +1,50 @@
+"""Smoke tests for the benchmark harnesses (BASELINE headline metrics).
+
+Parity model: the reference measures scaling efficiency with
+`examples/tensorflow2_synthetic_benchmark.py` run at multiple world sizes
+(`docs/benchmarks.rst`); here the harnesses are importable and asserted on
+the 8-device virtual CPU platform the whole suite runs on.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+
+def test_scaling_bench_reports_efficiency(capsys):
+    import scaling_bench
+
+    rates = scaling_bench.main([
+        "--model", "ResNet18", "--batch-per-device", "2",
+        "--image-size", "32", "--iters", "2", "--warmup", "1",
+        "--world-sizes", "1,2"])
+    assert set(rates) == {1, 2}
+    for comm, nocomm in rates.values():
+        assert comm > 0 and nocomm > 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["metric"] == "weak_scaling_efficiency"
+    assert summary["unit"] == "%"
+    assert 0 < summary["value"] < 500  # sanity, CPU timing is noisy
+    assert summary["config"]["shared_core_virtual_devices"] is True
+
+
+def test_allreduce_bench_spmd_and_eager(capsys):
+    import allreduce_bench
+
+    results = allreduce_bench.main(
+        ["--sizes-mb", "0.0625,0.25", "--iters", "3", "--warmup", "1"])
+    paths = {r["path"] for r in results}
+    assert paths == {"spmd", "eager"}
+    for r in results:
+        assert r["time_us"] > 0
+        assert r["busbw_gbps"] > 0
+    spmd_rows = [r for r in results if r["path"] == "spmd"]
+    assert all(r["n"] == 8 for r in spmd_rows)  # real 8-device collective
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["metric"] == "allreduce_busbw_gbps"
