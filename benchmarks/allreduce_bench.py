@@ -79,7 +79,7 @@ def bench_spmd(sizes_mb, iters, warmup):
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / iters
         algbw = nelem * 4 / dt
-        busbw = algbw * (2 * (n - 1) / n)
+        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
         results.append({"path": "spmd", "size_mb": mb, "n": n,
                         "time_us": round(dt * 1e6, 1),
                         "algbw_gbps": round(algbw / 1e9, 3),

@@ -151,6 +151,11 @@ def main(argv=None):
     iters = args.iters or (20 if on_tpu else 5)
     if args.world_sizes:
         world = [int(s) for s in args.world_sizes.split(",")]
+        too_big = [n for n in world if n > ndev]
+        if too_big:
+            raise SystemExit(
+                f"requested world sizes {too_big} exceed the {ndev} "
+                f"available devices")
     else:
         world = [n for n in (2 ** i for i in range(10)) if n <= ndev]
 

@@ -65,6 +65,10 @@ from .ops.collective_ops import (  # noqa: F401
     synchronize,
 )
 from .ops.compression import Compression  # noqa: F401
+from .ops.sparse import (  # noqa: F401
+    IndexedSlices,
+    allreduce_sparse,
+)
 from .optim.broadcast import (  # noqa: F401
     broadcast_object,
     broadcast_optimizer_state,

@@ -1,0 +1,96 @@
+"""Sparse (indexed-slices) gradient collectives.
+
+Reference parity: `horovod/tensorflow/__init__.py:75-91` — an allreduce on a
+`tf.IndexedSlices` is implemented as TWO allgathers (values + indices), i.e.
+the represented dense tensor is summed by concatenating every rank's slice
+contributions; Average divides the gathered values by world size. The rows
+gathered from different ranks may overlap in index — consumers either apply
+them as duplicate scatter-adds (what TF optimizers do) or densify via
+``to_dense``.
+
+This module is the framework-neutral engine path (numpy/JAX arrays at the
+boundary, ragged dim0 negotiated across ranks by the controller). The in-jit
+SPMD variant lives in `horovod_tpu.spmd.allreduce_sparse` (static shapes, XLA
+`all_gather`).
+
+Adasum on sparse tensors is rejected, as in the reference (:77-81).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import basics
+from ..basics import Adasum, Average, Sum
+from . import collective_ops as _ops
+
+
+class IndexedSlices(NamedTuple):
+    """A sparse update: ``dense[indices[i]] += values[i]`` row-wise.
+
+    Mirrors `tf.IndexedSlices` (values ``[k, ...]``, indices ``[k]``,
+    ``dense_shape`` of the represented tensor). ``dense_shape`` may be None
+    when only gather/apply semantics are needed.
+    """
+
+    values: object
+    indices: object
+    dense_shape: Optional[tuple] = None
+
+
+def allreduce_sparse_async(slices: IndexedSlices,
+                           name: Optional[str] = None):
+    """Start the two allgathers; returns a pair of handles."""
+    name = name or _ops._auto_name("sparse_allreduce", None)
+    hv = _ops.allgather_async(slices.values, name=f"{name}.values")
+    hi = _ops.allgather_async(slices.indices, name=f"{name}.indices")
+    return hv, hi
+
+
+def synchronize_sparse(handles, op: int = Average,
+                       dense_shape=None) -> IndexedSlices:
+    hv, hi = handles
+    values = _ops.synchronize(hv)
+    indices = _ops.synchronize(hi)
+    if op == Average:
+        n = basics.size()
+        values = values / jnp.asarray(n, values.dtype) \
+            if jnp.issubdtype(values.dtype, jnp.floating) else values // n
+    return IndexedSlices(values, indices, dense_shape)
+
+
+def allreduce_sparse(slices: IndexedSlices, name: Optional[str] = None,
+                     op: int = Average) -> IndexedSlices:
+    """Allreduce of the dense tensor represented by ``slices``, done as
+    allgathers (`tensorflow/__init__.py:83-91`). Per-rank row counts may
+    differ (ragged dim0 — negotiated like any allgather)."""
+    if op == Adasum:
+        raise NotImplementedError(
+            "The Adasum reduction does not currently support sparse "
+            "tensors. As a workaround please pass sparse_as_dense=True to "
+            "DistributedOptimizer")
+    if op not in (Average, Sum):
+        raise ValueError(f"unsupported op for sparse allreduce: {op}")
+    return synchronize_sparse(allreduce_sparse_async(slices, name), op=op,
+                              dense_shape=slices.dense_shape)
+
+
+def densify_tree(tree):
+    """Replace every IndexedSlices leaf with its dense scatter-add result."""
+    is_sparse = lambda x: isinstance(x, IndexedSlices)  # noqa: E731
+    return jax.tree_util.tree_map(
+        lambda l: to_dense(l) if is_sparse(l) else l, tree,
+        is_leaf=is_sparse)
+
+
+def to_dense(slices: IndexedSlices):
+    """Densify with duplicate-index accumulation (scatter-add)."""
+    if slices.dense_shape is None:
+        raise ValueError("IndexedSlices has no dense_shape; cannot densify")
+    values = jnp.asarray(slices.values)
+    indices = jnp.asarray(slices.indices)
+    out = jnp.zeros(tuple(slices.dense_shape), values.dtype)
+    return out.at[indices].add(values)

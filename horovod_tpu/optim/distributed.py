@@ -32,21 +32,52 @@ from ..ops.compression import Compression
 
 
 def allreduce_gradients(grads, op: int = Average,
-                        compression=Compression.none, prefix: str = "grad"):
+                        compression=Compression.none, prefix: str = "grad",
+                        sparse_as_dense: bool = False):
     """Average a gradient pytree across ranks through the engine: one named
     async allreduce per leaf, all in flight simultaneously (the hook-overlap
-    pattern of `torch/__init__.py:115-150`), then drained in order."""
+    pattern of `torch/__init__.py:115-150`), then drained in order.
+
+    `ops.sparse.IndexedSlices` leaves (embedding-style sparse grads) take
+    the two-allgather path (`tensorflow/__init__.py:75-91`); pass
+    ``sparse_as_dense=True`` to densify them first
+    (`_keras/__init__.py:50-53`).
+    """
+    from ..ops import sparse as _sparse
+
+    is_sparse = lambda x: isinstance(x, _sparse.IndexedSlices)  # noqa: E731
     if basics.size() == 1:
-        return grads
-    pairs, treedef = jax.tree_util.tree_flatten_with_path(grads)
-    handles, ctxs = [], []
+        # Keep single-rank and multi-rank return types consistent:
+        # sparse_as_dense must densify here too, or optax would tree_map
+        # into the IndexedSlices on single-process debug runs.
+        return _sparse.densify_tree(grads) if sparse_as_dense else grads
+    pairs, treedef = jax.tree_util.tree_flatten_with_path(
+        grads, is_leaf=is_sparse)
+    started = []
     for path, leaf in pairs:
         name = prefix + jax.tree_util.keystr(path)
+        if is_sparse(leaf):
+            if sparse_as_dense:
+                leaf = _sparse.to_dense(leaf)
+            else:
+                if op == Adasum:
+                    raise NotImplementedError(
+                        "Adasum does not support sparse gradients; pass "
+                        "sparse_as_dense=True")
+                started.append(
+                    ("sparse", _sparse.allreduce_sparse_async(leaf, name),
+                     leaf))
+                continue
         comp, ctx = compression.compress(jnp.asarray(leaf))
-        handles.append(ops.allreduce_async(comp, name=name, op=op))
-        ctxs.append(ctx)
-    outs = [compression.decompress(ops.synchronize(h), c)
-            for h, c in zip(handles, ctxs)]
+        started.append(("dense", ops.allreduce_async(comp, name=name, op=op),
+                        ctx))
+    outs = []
+    for kind, h, meta in started:
+        if kind == "sparse":
+            outs.append(_sparse.synchronize_sparse(
+                h, op=op, dense_shape=meta.dense_shape))
+        else:
+            outs.append(compression.decompress(ops.synchronize(h), meta))
     return jax.tree_util.tree_unflatten(treedef, outs)
 
 
@@ -64,7 +95,8 @@ class DistributedOptimizer:
     """
 
     def __init__(self, tx, compression=Compression.none, op: int = Average,
-                 backward_passes_per_step: int = 1, prefix: str = "grad"):
+                 backward_passes_per_step: int = 1, prefix: str = "grad",
+                 sparse_as_dense: bool = False):
         self._tx = tx
         self._compression = compression
         self._op = op
@@ -72,6 +104,7 @@ class DistributedOptimizer:
         self._k = backward_passes_per_step
         self._micro = 0
         self._acc = None
+        self._sparse_as_dense = sparse_as_dense
 
     def init(self, params):
         return self._tx.init(params)
@@ -85,6 +118,21 @@ class DistributedOptimizer:
         # names); safe because the communicating step drains all handles
         # before returning.
         if self._k > 1:
+            from ..ops import sparse as _sparse
+
+            has_sparse = any(
+                isinstance(l, _sparse.IndexedSlices)
+                for l in jax.tree_util.tree_leaves(
+                    grads,
+                    is_leaf=lambda x: isinstance(x, _sparse.IndexedSlices)))
+            if has_sparse:
+                if not self._sparse_as_dense:
+                    # accumulating IndexedSlices with tree_map would add
+                    # the *indices* arrays — densify or fail loudly
+                    raise NotImplementedError(
+                        "backward_passes_per_step > 1 with sparse gradient "
+                        "leaves requires sparse_as_dense=True")
+                grads = _sparse.densify_tree(grads)
             if self._acc is None:
                 self._acc = grads
             else:
@@ -98,7 +146,14 @@ class DistributedOptimizer:
             self._micro = 0
         grads = allreduce_gradients(
             grads, op=self._op, compression=self._compression,
-            prefix=self._prefix)
+            prefix=self._prefix, sparse_as_dense=self._sparse_as_dense)
+        # optax transformations tree_map over leaves, which would scale an
+        # IndexedSlices' indices/dense_shape too (TF optimizers handle
+        # IndexedSlices natively; optax does not) — densify the gathered
+        # result before handing it to the inner transformation.
+        from ..ops import sparse as _sparse
+
+        grads = _sparse.densify_tree(grads)
         return self._tx.update(grads, state, params)
 
 

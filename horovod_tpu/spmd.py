@@ -76,6 +76,30 @@ def reduce_scatter(x, axis: str = MESH_AXIS, scatter_axis: int = 0):
                                 tiled=True)
 
 
+def allreduce_sparse(values, indices, op: int = Average, axis: str = MESH_AXIS):
+    """In-jit sparse allreduce (`tensorflow/__init__.py:75-91` rebuilt for
+    SPMD): allgather rows + indices instead of reducing the dense tensor.
+
+    Unlike the eager engine path (`ops.sparse.allreduce_sparse`, ragged dim0
+    negotiated at runtime), XLA requires a static, equal per-device row count
+    — pad with a sentinel row (e.g. index 0, zero values) to equalize.
+    Returns ``(gathered_values [n*k, ...], gathered_indices [n*k])``; apply
+    with scatter-add, duplicates accumulate.
+    """
+    if op == Adasum:
+        raise NotImplementedError(
+            "Adasum does not support sparse tensors; densify first")
+    g_values = jax.lax.all_gather(values, axis, tiled=True)
+    g_indices = jax.lax.all_gather(indices, axis, tiled=True)
+    if op == Average:
+        n = jax.lax.psum(jnp.ones((), jnp.int32), axis)
+        if jnp.issubdtype(g_values.dtype, jnp.integer):
+            g_values = g_values // n.astype(g_values.dtype)
+        else:
+            g_values = g_values / n.astype(g_values.dtype)
+    return g_values, g_indices
+
+
 def adasum(x, axis: str = MESH_AXIS):
     """Adasum combine across the replica axis inside SPMD code.
 

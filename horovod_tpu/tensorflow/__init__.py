@@ -75,12 +75,29 @@ def allreduce(tensor, average: Optional[bool] = None,
               op: Optional[int] = None):
     """Eager allreduce (`tensorflow/__init__.py:44-118`): compress → engine →
     decompress; Average division happens in-framework (:117). Passing both
-    ``average`` and ``op`` is rejected, as in the reference (:51-55)."""
+    ``average`` and ``op`` is rejected, as in the reference (:51-55).
+
+    A ``tf.IndexedSlices`` input takes the sparse path (:75-91): two
+    allgathers (values + indices) instead of a dense reduce, Average divides
+    gathered values by world size, Adasum is rejected. Per-rank slice counts
+    may differ — ragged dim0 is negotiated like any allgather.
+    """
     if average is not None and op is not None:
         raise ValueError("The op parameter supersedes average; please provide "
                          "only one of them.")
     op_ = Average if op is None and average is None else (
         (Average if average else Sum) if average is not None else op)
+    t = _require_tf()
+    if isinstance(tensor, t.IndexedSlices):
+        if op_ == Adasum:
+            raise NotImplementedError(
+                "The Adasum reduction does not currently support sparse "
+                "tensors. As a workaround please pass sparse_as_dense=True "
+                "to DistributedOptimizer")
+        name = _ops._auto_name("sparse_allreduce", name)
+        return _finish_grad(
+            *_start_grad(tensor, name, compression, op_, False),
+            compression, op_)
     comp, ctx = compression.compress(tensor)
     out = _from_result(
         _ops.synchronize(_ops.allreduce_async(_to_numpy(comp), name=name,
@@ -114,15 +131,52 @@ def broadcast_variables(variables: List[Any], root_rank: int = 0) -> None:
                            root_rank, name=f"bv.{name}"))
 
 
+def _start_grad(g, name, compression, op, sparse_as_dense):
+    """Start the async reduction for one gradient; returns (kind, handles,
+    meta). IndexedSlices take the two-allgather path unless sparse_as_dense
+    (`_keras/__init__.py:50-53` densify; `tensorflow/__init__.py:83-91`)."""
+    t = _require_tf()
+    if isinstance(g, t.IndexedSlices):
+        if sparse_as_dense:
+            g = t.convert_to_tensor(g)
+        else:
+            hv = _ops.allgather_async(_to_numpy(g.values),
+                                      name=f"{name}.values")
+            hi = _ops.allgather_async(_to_numpy(g.indices),
+                                      name=f"{name}.indices")
+            return "sparse", (hv, hi), g
+    comp, ctx = compression.compress(g)
+    return "dense", _ops.allreduce_async(_to_numpy(comp), name=name, op=op), \
+        (ctx, comp)
+
+
+def _finish_grad(kind, handles, meta, compression, op):
+    t = _require_tf()
+    if kind == "sparse":
+        g = meta
+        values = _from_result(_ops.synchronize(handles[0]), g.values)
+        indices = t.convert_to_tensor(np.asarray(_ops.synchronize(handles[1])),
+                                      dtype=g.indices.dtype)
+        if op == Average:
+            values = values / t.cast(size(), values.dtype)
+        return t.IndexedSlices(values, indices, dense_shape=g.dense_shape)
+    ctx, comp = meta
+    out = _from_result(_ops.synchronize(handles), comp)
+    return compression.decompress(out, ctx)
+
+
 class DistributedGradientTape:
     """Wraps ``tf.GradientTape`` so ``gradient()`` returns rank-averaged
-    gradients (`tensorflow/__init__.py:473-530`)."""
+    gradients (`tensorflow/__init__.py:473-530`); IndexedSlices gradients
+    (embedding lookups) go through the sparse allgather path."""
 
-    def __init__(self, tape, compression=Compression.none, op: int = Average):
+    def __init__(self, tape, compression=Compression.none, op: int = Average,
+                 sparse_as_dense: bool = False):
         _require_tf()
         self._tape = tape
         self._compression = compression
         self._op = op
+        self._sparse_as_dense = sparse_as_dense
 
     def __enter__(self):
         self._tape.__enter__()
@@ -135,23 +189,13 @@ class DistributedGradientTape:
         grads = self._tape.gradient(target, sources,
                                     output_gradients=output_gradients)
         flat = grads if isinstance(grads, (list, tuple)) else [grads]
-        handles, ctxs = [], []
-        for i, g in enumerate(flat):
-            if g is None:
-                handles.append(None)
-                ctxs.append((None, None))
-                continue
-            comp, ctx = self._compression.compress(g)
-            handles.append(_ops.allreduce_async(_to_numpy(comp),
-                                                name=f"tape.{i}", op=self._op))
-            ctxs.append((ctx, comp))
-        outs = []
-        for h, (ctx, comp) in zip(handles, ctxs):
-            if h is None:
-                outs.append(None)
-                continue
-            out = _from_result(_ops.synchronize(h), comp)
-            outs.append(self._compression.decompress(out, ctx))
+        started = [None if g is None else
+                   _start_grad(g, f"tape.{i}", self._compression, self._op,
+                               self._sparse_as_dense)
+                   for i, g in enumerate(flat)]
+        outs = [None if s is None else
+                _finish_grad(*s, self._compression, self._op)
+                for s in started]
         if isinstance(grads, tuple):
             return tuple(outs)
         return outs if isinstance(grads, list) else outs[0]
@@ -162,36 +206,31 @@ class DistributedGradientTape:
 
 class DistributedOptimizer:
     """Keras-optimizer wrapper: gradients are allreduced before ``apply_
-    gradients`` (`tensorflow/__init__.py:281-295` compute_gradients wrap)."""
+    gradients`` (`tensorflow/__init__.py:281-295` compute_gradients wrap);
+    ``sparse_as_dense`` densifies IndexedSlices first
+    (`_keras/__init__.py:50-53`)."""
 
     def __init__(self, optimizer, compression=Compression.none,
-                 op: int = Average):
+                 op: int = Average, sparse_as_dense: bool = False):
         _require_tf()
         self._opt = optimizer
         self._compression = compression
         self._op = op
+        self._sparse_as_dense = sparse_as_dense
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         grads_and_vars = list(grads_and_vars)
-        reduced = []
-        handles, metas = [], []
+        started = []
         for i, (g, v) in enumerate(grads_and_vars):
             if g is None:
-                handles.append(None)
-                metas.append((None, None, v))
+                started.append((None, v))
                 continue
-            comp, ctx = self._compression.compress(g)
             name = getattr(v, "name", None) or f"opt.{i}"
-            handles.append(_ops.allreduce_async(_to_numpy(comp),
-                                                name=f"grad.{name}",
-                                                op=self._op))
-            metas.append((ctx, comp, v))
-        for h, (ctx, comp, v) in zip(handles, metas):
-            if h is None:
-                reduced.append((None, v))
-                continue
-            out = _from_result(_ops.synchronize(h), comp)
-            reduced.append((self._compression.decompress(out, ctx), v))
+            started.append((_start_grad(g, f"grad.{name}", self._compression,
+                                        self._op, self._sparse_as_dense), v))
+        reduced = [(None if s is None else
+                    _finish_grad(*s, self._compression, self._op), v)
+                   for s, v in started]
         return self._opt.apply_gradients(reduced, **kwargs)
 
     def __getattr__(self, item):

@@ -1,0 +1,262 @@
+"""Sparse (IndexedSlices) allreduce tests.
+
+Parity model: `horovod/tensorflow/__init__.py:75-91` (IndexedSlices →
+two allgathers; Average divides values by size; Adasum rejected) and the
+reference's ragged-allgather test style (`test/test_tensorflow.py`
+variable-size allgathers) — per-rank slice counts differ across ranks.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+from horovod_tpu.ops import sparse as sp
+
+
+# ------------------------------------------------------------ engine (eager)
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
+def test_sparse_allreduce_sum_ragged(dtype):
+    """Ranks contribute different slice counts; Sum keeps raw rows."""
+
+    def fn():
+        r = hvd.rank()
+        k = r + 1  # ragged: rank0 -> 1 row, rank1 -> 2 rows
+        values = np.full((k, 3), r + 1, dtype=dtype)
+        indices = np.arange(k, dtype=np.int64) + 2 * r
+        out = sp.allreduce_sparse(
+            sp.IndexedSlices(values, indices, dense_shape=(4, 3)),
+            name=f"sp_sum_{np.dtype(dtype).name}", op=hvd.Sum)
+        assert np.asarray(out.values).shape == (3, 3)
+        assert np.asarray(out.indices).shape == (3,)
+        return np.asarray(out.values), np.asarray(out.indices)
+
+    for values, indices in testing.run_cluster(fn, np=2):
+        np.testing.assert_array_equal(indices, [0, 2, 3])
+        np.testing.assert_allclose(values[0], np.full(3, 1))
+        np.testing.assert_allclose(values[1:], np.full((2, 3), 2))
+
+
+def test_sparse_allreduce_average_divides_values():
+    def fn():
+        r = hvd.rank()
+        out = sp.allreduce_sparse(
+            sp.IndexedSlices(np.full((2, 2), 4.0, np.float32),
+                             np.array([0, 1]), dense_shape=(2, 2)),
+            name="sp_avg", op=hvd.Average)
+        return np.asarray(out.values)
+
+    for values in testing.run_cluster(fn, np=2):
+        np.testing.assert_allclose(values, np.full((4, 2), 2.0))
+
+
+def test_sparse_allreduce_matches_dense_allreduce():
+    """Densified sparse result == dense allreduce of the represented
+    tensor, including overlapping indices (duplicates accumulate)."""
+
+    def fn():
+        r = hvd.rank()
+        dense = np.zeros((5, 2), np.float32)
+        indices = np.array([1, 3]) if r == 0 else np.array([3, 4])
+        values = np.full((2, 2), float(r + 1), np.float32)
+        dense[indices] += values
+        got = sp.to_dense(sp.allreduce_sparse(
+            sp.IndexedSlices(values, indices, dense_shape=(5, 2)),
+            name="sp_vs_dense", op=hvd.Sum))
+        want = hvd.allreduce(dense, name="dense_ref", op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_sparse_adasum_rejected():
+    def fn():
+        with pytest.raises(NotImplementedError, match="Adasum"):
+            sp.allreduce_sparse(
+                sp.IndexedSlices(np.ones((1, 2), np.float32),
+                                 np.array([0]), (2, 2)),
+                name="sp_adasum", op=hvd.Adasum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_to_dense_requires_shape_and_accumulates_duplicates():
+    s = sp.IndexedSlices(np.array([[1.0], [2.0]], np.float32),
+                         np.array([1, 1]), dense_shape=(3, 1))
+    np.testing.assert_allclose(sp.to_dense(s), [[0.0], [3.0], [0.0]])
+    with pytest.raises(ValueError, match="dense_shape"):
+        sp.to_dense(sp.IndexedSlices(np.ones((1, 1)), np.array([0])))
+
+
+# ------------------------------------------------- optimizer pytree surface
+def test_allreduce_gradients_mixed_sparse_dense():
+    from horovod_tpu.optim.distributed import allreduce_gradients
+
+    def fn():
+        r = hvd.rank()
+        grads = {
+            "emb": sp.IndexedSlices(
+                np.full((1 + r, 2), float(r + 1), np.float32),
+                np.arange(1 + r), dense_shape=(4, 2)),
+            "w": np.full((2,), float(r), np.float32),
+        }
+        out = allreduce_gradients(grads, op=hvd.Sum, prefix=f"mix")
+        assert isinstance(out["emb"], sp.IndexedSlices)
+        return (np.asarray(out["emb"].values), np.asarray(out["w"]))
+
+    for emb_values, w in testing.run_cluster(fn, np=2):
+        assert emb_values.shape == (3, 2)
+        np.testing.assert_allclose(w, [1.0, 1.0])
+
+
+def test_allreduce_gradients_sparse_as_dense():
+    from horovod_tpu.optim.distributed import allreduce_gradients
+
+    def fn():
+        r = hvd.rank()
+        grads = {"emb": sp.IndexedSlices(
+            np.full((1, 2), float(r + 1), np.float32),
+            np.array([r]), dense_shape=(2, 2))}
+        out = allreduce_gradients(grads, op=hvd.Sum, prefix="sad",
+                                  sparse_as_dense=True)
+        return np.asarray(out["emb"])
+
+    for dense in testing.run_cluster(fn, np=2):
+        np.testing.assert_allclose(dense, [[1.0, 1.0], [2.0, 2.0]])
+
+
+def test_distributed_optimizer_densifies_sparse_updates():
+    """optax can't consume IndexedSlices (it would tree_map over indices),
+    so the optimizer wrapper densifies the gathered result."""
+    import optax
+
+    def fn():
+        r = hvd.rank()
+        tx = hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Sum)
+        state = tx.init({"e": np.zeros((3, 2), np.float32)})
+        g = {"e": sp.IndexedSlices(np.full((1, 2), float(r + 1), np.float32),
+                                   np.array([r]), dense_shape=(3, 2))}
+        updates, state = tx.update(g, state)
+        assert not isinstance(updates["e"], sp.IndexedSlices)
+        return np.asarray(updates["e"])
+
+    for u in testing.run_cluster(fn, np=2):
+        np.testing.assert_allclose(u, [[-1, -1], [-2, -2], [0, 0]])
+
+
+def test_distributed_optimizer_accumulation_rejects_sparse():
+    import optax
+
+    def fn():
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                      backward_passes_per_step=2)
+        g = {"e": sp.IndexedSlices(np.ones((1, 2), np.float32),
+                                   np.array([0]), (2, 2))}
+        state = tx.init({"e": np.zeros((2, 2), np.float32)})
+        with pytest.raises(NotImplementedError, match="sparse_as_dense"):
+            tx.update(g, state)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+# ------------------------------------------------------------- SPMD (in-jit)
+def test_spmd_allreduce_sparse():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import spmd
+    from horovod_tpu.basics import MESH_AXIS
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n = hvd.num_replicas()
+    k = 2  # static equal per-device row count (XLA requirement)
+    values = jnp.arange(n * k * 3, dtype=jnp.float32).reshape(n * k, 3)
+    indices = jnp.tile(jnp.arange(k), n)
+
+    def local(v, i):
+        return spmd.allreduce_sparse(v, i, op=hvd.Sum)
+
+    gv, gi = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P(MESH_AXIS), P(MESH_AXIS)),
+        out_specs=(P(MESH_AXIS), P(MESH_AXIS))))(values, indices)
+    # tiled all_gather: every device sees all rows; output is the gathered
+    # set re-sharded, so globally it equals the full concatenation
+    assert gv.shape == (n * n * k, 3)
+    assert gi.shape == (n * n * k,)
+    got = np.asarray(gv[: n * k])
+    np.testing.assert_allclose(got, np.asarray(values))
+
+
+def test_tf_indexed_slices_allreduce():
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd_tf
+
+    def fn():
+        r = hvd.rank()
+        s = tf.IndexedSlices(
+            tf.constant(np.full((1 + r, 2), float(r + 1), np.float32)),
+            tf.constant(np.arange(1 + r, dtype=np.int64)),
+            dense_shape=tf.constant([4, 2], dtype=tf.int64))
+        out = hvd_tf.allreduce(s, name="tf_sparse", op=hvd_tf.Sum)
+        assert isinstance(out, tf.IndexedSlices)
+        avg = hvd_tf.allreduce(s, name="tf_sparse_avg")  # Average default
+        return (out.values.numpy(), out.indices.numpy(), avg.values.numpy())
+
+    for values, indices, avg in testing.run_cluster(fn, np=2):
+        assert values.shape == (3, 2)
+        np.testing.assert_array_equal(indices, [0, 0, 1])
+        np.testing.assert_allclose(avg, values / 2.0)
+
+
+def test_tf_tape_sparse_gradient_roundtrip():
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd_tf
+
+    def fn():
+        r = hvd.rank()
+        emb = tf.Variable(np.ones((4, 3), np.float32))
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            rows = tf.gather(emb, [r, r + 1])
+            loss = tf.reduce_sum(rows) * (r + 1)
+        g = tape.gradient(loss, emb)
+        assert isinstance(g, tf.IndexedSlices)
+        dense = tf.math.unsorted_segment_sum(
+            g.values, g.indices, 4).numpy()
+        return dense
+
+    outs = testing.run_cluster(fn, np=2)
+    # rank0 grad rows {0,1} scaled 1; rank1 rows {1,2} scaled 2; Average /2
+    want = np.zeros((4, 3), np.float32)
+    want[0] += 0.5
+    want[1] += 0.5 + 1.0
+    want[2] += 1.0
+    for dense in outs:
+        np.testing.assert_allclose(dense, want)
+
+
+def test_tf_optimizer_sparse_as_dense():
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd_tf
+
+    def fn():
+        r = hvd.rank()
+        v = tf.Variable(np.zeros((2, 2), np.float32))
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(1.0), sparse_as_dense=True,
+            op=hvd_tf.Sum)
+        g = tf.IndexedSlices(
+            tf.constant(np.full((1, 2), float(r + 1), np.float32)),
+            tf.constant([r], dtype=tf.int64),
+            dense_shape=tf.constant([2, 2], dtype=tf.int64))
+        opt.apply_gradients([(g, v)])
+        return v.numpy()
+
+    for after in testing.run_cluster(fn, np=2):
+        np.testing.assert_allclose(after, [[-1.0, -1.0], [-2.0, -2.0]])
+
+    hvd.shutdown()
