@@ -75,6 +75,7 @@ from .optim.broadcast import (  # noqa: F401
     broadcast_parameters,
 )
 from .optim.distributed import (  # noqa: F401
+    DistributedAdasumOptimizer,
     DistributedGradientTape,
     DistributedOptimizer,
     allreduce_gradients,

@@ -81,18 +81,77 @@ def allreduce_gradients(grads, op: int = Average,
     return jax.tree_util.tree_unflatten(treedef, outs)
 
 
-class DistributedOptimizer:
+class _GradAccumulation:
+    """Shared backward_passes_per_step bookkeeping: accumulate k micro-grads
+    locally, communicate on the k-th (`torch/__init__.py:171-189`; the raw
+    accumulated SUM goes on the wire — the reference does not divide by the
+    pass count; users scale their loss)."""
+
+    def _init_accumulation(self, k: int, sparse_as_dense: bool):
+        self._k = k
+        self._micro = 0
+        self._acc = None
+        self._sparse_as_dense = sparse_as_dense
+
+    def _accumulate(self, grads):
+        """Returns ``(communicate, grads)``: on a communication micro-step
+        the accumulated grads, otherwise the (densified) micro-grads for
+        shaping the zero update."""
+        if self._k <= 1:
+            return True, grads
+        from ..ops import sparse as _sparse
+
+        has_sparse = any(
+            isinstance(l, _sparse.IndexedSlices)
+            for l in jax.tree_util.tree_leaves(
+                grads,
+                is_leaf=lambda x: isinstance(x, _sparse.IndexedSlices)))
+        if has_sparse:
+            if not self._sparse_as_dense:
+                # accumulating IndexedSlices with tree_map would add the
+                # *indices* arrays — densify or fail loudly
+                raise NotImplementedError(
+                    "backward_passes_per_step > 1 with sparse gradient "
+                    "leaves requires sparse_as_dense=True")
+            grads = _sparse.densify_tree(grads)
+        if self._acc is None:
+            self._acc = grads
+        else:
+            self._acc = jax.tree_util.tree_map(jnp.add, self._acc, grads)
+        self._micro += 1
+        if self._micro < self._k:
+            return False, grads
+        grads = self._acc
+        self._acc = None
+        self._micro = 0
+        return True, grads
+
+
+class DistributedOptimizer(_GradAccumulation):
     """optax-compatible GradientTransformation wrapper: allreduces gradients
     across ranks before delegating to the inner transformation.
 
     Parameters mirror the reference surface (`torch/__init__.py:80-113`):
     ``compression``, ``op`` (Average/Sum/Adasum), ``backward_passes_per_step``
-    (local accumulation before communicating). Use with plain optax::
+    (local accumulation before communicating). ``op=Adasum`` on a multi-rank
+    world constructs the delta-flow ``DistributedAdasumOptimizer`` instead,
+    like the reference factory (`torch/__init__.py:428-435`). Use with plain
+    optax::
 
         tx = hvd.DistributedOptimizer(optax.sgd(0.01))
         state = tx.init(params)
         updates, state = tx.update(grads, state, params)
     """
+
+    def __new__(cls, tx=None, compression=Compression.none, op: int = Average,
+                backward_passes_per_step: int = 1, prefix: str = "grad",
+                sparse_as_dense: bool = False):
+        if op == Adasum and basics.size() > 1:
+            return DistributedAdasumOptimizer(
+                tx, compression=compression,
+                backward_passes_per_step=backward_passes_per_step,
+                sparse_as_dense=sparse_as_dense)
+        return super().__new__(cls)
 
     def __init__(self, tx, compression=Compression.none, op: int = Average,
                  backward_passes_per_step: int = 1, prefix: str = "grad",
@@ -101,49 +160,19 @@ class DistributedOptimizer:
         self._compression = compression
         self._op = op
         self._prefix = prefix
-        self._k = backward_passes_per_step
-        self._micro = 0
-        self._acc = None
-        self._sparse_as_dense = sparse_as_dense
+        self._init_accumulation(backward_passes_per_step, sparse_as_dense)
 
     def init(self, params):
         return self._tx.init(params)
 
     def update(self, grads, state, params=None):
-        # Local accumulation first, ONE communication every k micro-steps —
-        # that is the point of backward_passes_per_step
-        # (`torch/__init__.py:171-189`). The raw accumulated SUM goes on the
-        # wire — the reference does not divide by the pass count; users scale
-        # their loss. Stable tensor names across steps (like torch parameter
-        # names); safe because the communicating step drains all handles
-        # before returning.
-        if self._k > 1:
-            from ..ops import sparse as _sparse
-
-            has_sparse = any(
-                isinstance(l, _sparse.IndexedSlices)
-                for l in jax.tree_util.tree_leaves(
-                    grads,
-                    is_leaf=lambda x: isinstance(x, _sparse.IndexedSlices)))
-            if has_sparse:
-                if not self._sparse_as_dense:
-                    # accumulating IndexedSlices with tree_map would add
-                    # the *indices* arrays — densify or fail loudly
-                    raise NotImplementedError(
-                        "backward_passes_per_step > 1 with sparse gradient "
-                        "leaves requires sparse_as_dense=True")
-                grads = _sparse.densify_tree(grads)
-            if self._acc is None:
-                self._acc = grads
-            else:
-                self._acc = jax.tree_util.tree_map(jnp.add, self._acc, grads)
-            self._micro += 1
-            if self._micro < self._k:
-                zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
-                return zero, state
-            grads = self._acc
-            self._acc = None
-            self._micro = 0
+        # Stable tensor names across steps (like torch parameter names);
+        # safe because the communicating step drains all handles before
+        # returning.
+        communicate, grads = self._accumulate(grads)
+        if not communicate:
+            zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
+            return zero, state
         grads = allreduce_gradients(
             grads, op=self._op, compression=self._compression,
             prefix=self._prefix, sparse_as_dense=self._sparse_as_dense)
@@ -155,6 +184,63 @@ class DistributedOptimizer:
 
         grads = _sparse.densify_tree(grads)
         return self._tx.update(grads, state, params)
+
+
+class DistributedAdasumOptimizer(_GradAccumulation):
+    """Delta-flow Adasum optimizer (`torch/__init__.py:211-379`,
+    `tensorflow/__init__.py:313-407`).
+
+    Instead of reducing *gradients* before the update, the inner optimizer
+    runs locally and the resulting parameter *delta* is combined across
+    ranks with the scale-invariant Adasum rule. In optax terms the local
+    delta IS the update pytree (``new_params = params + updates``), so the
+    flow is: inner ``tx.update`` → Adasum-allreduce each update leaf →
+    return the combined updates. With ``backward_passes_per_step=k``,
+    gradients accumulate locally for k micro-steps and one combined
+    update+reduce happens on the k-th (the torch reference's delay
+    counter, `torch/__init__.py:330-339`).
+
+    fp16 compression composes (BASELINE config 5): the Adasum rule is
+    scale-invariant, so the cast loses precision but not correctness.
+    """
+
+    def __init__(self, tx, compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 prefix: str = "adasum", sparse_as_dense: bool = False):
+        self._tx = tx
+        self._compression = compression
+        self._prefix = prefix
+        self._init_accumulation(backward_passes_per_step, sparse_as_dense)
+
+    def init(self, params):
+        return self._tx.init(params)
+
+    def update(self, grads, state, params=None):
+        from ..ops import sparse as _sparse
+
+        # Adasum cannot combine IndexedSlices (parity:
+        # `tensorflow/__init__.py:77-81`) — densify up front or fail loudly
+        # before tree_map could corrupt the indices.
+        has_sparse = any(
+            isinstance(l, _sparse.IndexedSlices)
+            for l in jax.tree_util.tree_leaves(
+                grads,
+                is_leaf=lambda x: isinstance(x, _sparse.IndexedSlices)))
+        if has_sparse:
+            if not self._sparse_as_dense:
+                raise NotImplementedError(
+                    "The Adasum reduction does not support sparse "
+                    "gradients; pass sparse_as_dense=True")
+            grads = _sparse.densify_tree(grads)
+        communicate, grads = self._accumulate(grads)
+        if not communicate:
+            zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
+            return zero, state
+        updates, state = self._tx.update(grads, state, params)
+        updates = allreduce_gradients(
+            updates, op=Adasum, compression=self._compression,
+            prefix=self._prefix)
+        return updates, state
 
 
 class DistributedGradientTape:

@@ -208,7 +208,16 @@ class DistributedOptimizer:
     """Keras-optimizer wrapper: gradients are allreduced before ``apply_
     gradients`` (`tensorflow/__init__.py:281-295` compute_gradients wrap);
     ``sparse_as_dense`` densifies IndexedSlices first
-    (`_keras/__init__.py:50-53`)."""
+    (`_keras/__init__.py:50-53`). ``op=Adasum`` on a multi-rank world
+    constructs the delta-flow ``DistributedAdasumOptimizer`` instead, like
+    the reference factory."""
+
+    def __new__(cls, optimizer=None, compression=Compression.none,
+                op: int = Average, sparse_as_dense: bool = False):
+        if op == Adasum and size() > 1:
+            return DistributedAdasumOptimizer(optimizer,
+                                              compression=compression)
+        return super().__new__(cls)
 
     def __init__(self, optimizer, compression=Compression.none,
                  op: int = Average, sparse_as_dense: bool = False):
@@ -232,6 +241,54 @@ class DistributedOptimizer:
                     _finish_grad(*s, self._compression, self._op), v)
                    for s, v in started]
         return self._opt.apply_gradients(reduced, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+class DistributedAdasumOptimizer:
+    """Delta-flow Adasum for eager Keras optimizers
+    (`tensorflow/__init__.py:313-407` rebuilt without graph slots/conds):
+    the inner optimizer updates locally every step; on each communication
+    step (every ``backward_passes_per_step``-th call) the cumulative delta
+    from the per-variable ``start`` snapshot is Adasum-combined across
+    ranks and ``var = start = start + combined_delta``.
+    """
+
+    def __init__(self, optimizer, compression=Compression.none,
+                 backward_passes_per_step: int = 1):
+        _require_tf()
+        self._opt = optimizer
+        self._compression = compression
+        self._k = backward_passes_per_step
+        self._step_count = 0
+        self._starts = {}  # var.ref() -> tf.Variable snapshot
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        t = _require_tf()
+        gv = [(g, v) for g, v in grads_and_vars if g is not None]
+        for _, v in gv:
+            if v.ref() not in self._starts:
+                self._starts[v.ref()] = t.Variable(v.read_value(),
+                                                   trainable=False)
+        result = self._opt.apply_gradients(gv, **kwargs)
+        self._step_count += 1
+        if self._step_count % self._k != 0:
+            return result
+        started = []
+        for i, (_, v) in enumerate(gv):
+            start = self._starts[v.ref()]
+            delta = v.read_value() - start.read_value()
+            comp, ctx = self._compression.compress(delta)
+            name = getattr(v, "name", None) or f"var.{i}"
+            started.append((v, start, ctx, comp, _ops.allreduce_async(
+                _to_numpy(comp), name=f"adasum.{name}", op=Adasum)))
+        for v, start, ctx, comp, h in started:
+            combined = self._compression.decompress(
+                _from_result(_ops.synchronize(h), comp), ctx)
+            start.assign_add(t.cast(combined, start.dtype))
+            v.assign(start.read_value())
+        return result
 
     def __getattr__(self, item):
         return getattr(self._opt, item)

@@ -262,6 +262,26 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
 
 
 # ----------------------------------------------------- DistributedOptimizer
+def _validate_named_parameters(optimizer, named_parameters):
+    """Default naming + duplicate rejection shared by both optimizer wraps
+    (`torch/__init__.py:93-105`)."""
+    if named_parameters is not None:
+        named = list(named_parameters)
+    else:
+        named = [(f"param.{i}.{j}", p)
+                 for i, g in enumerate(optimizer.param_groups)
+                 for j, p in enumerate(g["params"])]
+    import collections
+
+    counts = collections.Counter(n for n, _ in named)
+    dups = {n for n, c in counts.items() if c > 1}
+    if dups:
+        raise ValueError(f"duplicate parameter names: {sorted(dups)} "
+                         "(named_parameters must be unique, "
+                         "torch/__init__.py:93-105)")
+    return named
+
+
 class _DistributedOptimizer:
     """Wraps a torch optimizer: per-parameter backward hooks fire async
     allreduce; ``step()`` drains handles first (`torch/__init__.py:115-209`)."""
@@ -279,20 +299,7 @@ class _DistributedOptimizer:
         self._ctxs: Dict[str, Any] = {}
         self._should_sync = True
 
-        if named_parameters is not None:
-            named = list(named_parameters)
-        else:
-            named = [(f"param.{i}.{j}", p)
-                     for i, g in enumerate(optimizer.param_groups)
-                     for j, p in enumerate(g["params"])]
-        import collections
-
-        counts = collections.Counter(n for n, _ in named)
-        dups = {n for n, c in counts.items() if c > 1}
-        if dups:
-            raise ValueError(f"duplicate parameter names: {sorted(dups)} "
-                             "(namedparameters must be unique, "
-                             "torch/__init__.py:93-105)")
+        named = _validate_named_parameters(optimizer, named_parameters)
         self._named = named
         if basics.size() > 1:
             for name, p in named:
@@ -354,9 +361,115 @@ class _DistributedOptimizer:
         return getattr(self._opt, item)
 
 
+class _DistributedAdasumOptimizer:
+    """Delta-flow Adasum (`torch/__init__.py:211-379`): each backward pass
+    hook runs the *inner* optimizer step for just that parameter, producing
+    the local delta ``-α·f(g)``; the delta — not the gradient — is combined
+    across ranks with op=Adasum, and ``step()`` applies the combined delta.
+
+    Deviation from the reference mechanics (same math): the reference
+    leaves ``p`` holding the raw delta between hook and ``step()``
+    (`torch/__init__.py:296-312`); here ``p`` is restored to its pre-step
+    value immediately, so the model is never observably corrupted mid-step.
+    """
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1):
+        torch = _require_torch()
+        self._opt = optimizer
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        self._counts: Dict[str, int] = {}
+        self._handles: Dict[str, int] = {}
+        self._ctxs: Dict[str, Any] = {}
+
+        named = _validate_named_parameters(optimizer, named_parameters)
+        self._named = named
+        for name, p in named:
+            if p.requires_grad:
+                self._register_hook(name, p)
+
+    def _allreduce_delta_async(self, name, p):
+        torch = _require_torch()
+        start = p.detach().clone()
+        # run the inner optimizer on just this parameter (reference stashes
+        # param_groups the same way, `torch/__init__.py:299-309`)
+        stash = [g["params"] for g in self._opt.param_groups]
+        for g in self._opt.param_groups:
+            g["params"] = [v for v in g["params"] if v is p]
+        self._opt.step()
+        for g, s in zip(self._opt.param_groups, stash):
+            g["params"] = s
+        with torch.no_grad():
+            delta = p.detach() - start
+            p.copy_(start)
+        comp, ctx = self._compression.compress(delta)
+        self._handles[name] = _ops.allreduce_async(
+            _to_numpy(comp), name=f"adasum.{name}", op=Adasum)
+        self._ctxs[name] = (ctx, p)
+
+    def _register_hook(self, name, p):
+        def hook(param):
+            self._counts[name] = self._counts.get(name, 0) + 1
+            if self._counts[name] == self.backward_passes_per_step:
+                self._counts[name] = 0
+                self._allreduce_delta_async(name, param)
+
+        p.register_post_accumulate_grad_hook(hook)
+
+    def synchronize(self) -> None:
+        # parity: a no-op — draining happens in step()
+        # (`torch/__init__.py:345-347`)
+        pass
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        raise AssertionError("Skipping synchronization is not supported "
+                             "when using Adasum optimizer.")
+
+    def step(self, closure=None):
+        torch = _require_torch()
+        loss = closure() if closure is not None else None
+        # Fire for every hook-registered param missing a handle — even ones
+        # whose grad is None (inner step skips them, producing a zero delta
+        # that is still submitted). Submission must not depend on rank-local
+        # gradient presence or ranks diverge on the negotiated name set and
+        # deadlock (reference fires all of _requires_update,
+        # `torch/__init__.py:352-355`).
+        for name, p in self._named:
+            if p.requires_grad and name not in self._handles:
+                self._counts[name] = 0
+                self._allreduce_delta_async(name, p)
+        for name, h in list(self._handles.items()):
+            ctx, p = self._ctxs.pop(name)
+            combined = self._compression.decompress(
+                _result_to_torch(_ops.synchronize(h), None), ctx)
+            with torch.no_grad():
+                p.add_(combined.to(p.dtype))
+        self._handles.clear()
+        return loss
+
+    def zero_grad(self, *a, **k):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step()")
+        return self._opt.zero_grad(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: int = Average):
+    """op=Adasum routes to the delta-flow optimizer when communicating
+    (`torch/__init__.py:428-435`)."""
+    if op == Adasum and basics.size() > 1:
+        return _DistributedAdasumOptimizer(optimizer, named_parameters,
+                                           compression,
+                                           backward_passes_per_step)
     return _DistributedOptimizer(optimizer, named_parameters, compression,
                                  backward_passes_per_step, op)
