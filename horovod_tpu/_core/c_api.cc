@@ -181,6 +181,11 @@ void hvd_core_timeline_op_end(int64_t eng, const char* tensor) {
   EngineCore* c = Get(eng);
   if (c) c->timeline->OpEnd(tensor, NowUs());
 }
+void hvd_core_timeline_cache(int64_t eng, uint64_t hits, uint64_t misses) {
+  EngineCore* c = Get(eng);
+  if (c) c->timeline->CacheCounter(hits, misses, NowUs());
+}
+
 void hvd_core_timeline_cycle(int64_t eng) {
   EngineCore* c = Get(eng);
   if (c) c->timeline->CycleMarker(NowUs());

@@ -110,6 +110,17 @@ void TimelineWriter::CycleMarker(int64_t ts_us) {
   Emit(os.str());
 }
 
+void TimelineWriter::CacheCounter(uint64_t hits, uint64_t misses,
+                                  int64_t ts_us) {
+  // Chrome counter track of response-cache hits/misses (the fast path that
+  // skips negotiation, reference controller.cc:171-185).
+  if (!enabled_) return;
+  std::ostringstream os;
+  os << "{\"name\":\"response_cache\",\"ph\":\"C\",\"pid\":0,\"ts\":" << ts_us
+     << ",\"args\":{\"hits\":" << hits << ",\"misses\":" << misses << "}}";
+  Emit(os.str());
+}
+
 void TimelineWriter::Loop() {
   std::unique_lock<std::mutex> l(mu_);
   while (true) {

@@ -29,6 +29,7 @@ class TimelineWriter {
                 int64_t ts_us);
   void OpEnd(const std::string& tensor, int64_t ts_us);
   void CycleMarker(int64_t ts_us);
+  void CacheCounter(uint64_t hits, uint64_t misses, int64_t ts_us);
   void Close();
   bool enabled() const { return enabled_; }
 

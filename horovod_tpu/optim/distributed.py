@@ -81,6 +81,22 @@ def allreduce_gradients(grads, op: int = Average,
     return jax.tree_util.tree_unflatten(treedef, outs)
 
 
+def _densify_or_raise(grads, sparse_as_dense: bool, context: str):
+    """If the pytree has IndexedSlices leaves: densify them when allowed,
+    else raise ``context`` (tree_map over a raw IndexedSlices NamedTuple
+    would corrupt the indices)."""
+    from ..ops import sparse as _sparse
+
+    is_sparse = lambda x: isinstance(x, _sparse.IndexedSlices)  # noqa: E731
+    has_sparse = any(is_sparse(l) for l in jax.tree_util.tree_leaves(
+        grads, is_leaf=is_sparse))
+    if not has_sparse:
+        return grads
+    if not sparse_as_dense:
+        raise NotImplementedError(context)
+    return _sparse.densify_tree(grads)
+
+
 class _GradAccumulation:
     """Shared backward_passes_per_step bookkeeping: accumulate k micro-grads
     locally, communicate on the k-th (`torch/__init__.py:171-189`; the raw
@@ -99,21 +115,10 @@ class _GradAccumulation:
         shaping the zero update."""
         if self._k <= 1:
             return True, grads
-        from ..ops import sparse as _sparse
-
-        has_sparse = any(
-            isinstance(l, _sparse.IndexedSlices)
-            for l in jax.tree_util.tree_leaves(
-                grads,
-                is_leaf=lambda x: isinstance(x, _sparse.IndexedSlices)))
-        if has_sparse:
-            if not self._sparse_as_dense:
-                # accumulating IndexedSlices with tree_map would add the
-                # *indices* arrays — densify or fail loudly
-                raise NotImplementedError(
-                    "backward_passes_per_step > 1 with sparse gradient "
-                    "leaves requires sparse_as_dense=True")
-            grads = _sparse.densify_tree(grads)
+        grads = _densify_or_raise(
+            grads, self._sparse_as_dense,
+            "backward_passes_per_step > 1 with sparse gradient leaves "
+            "requires sparse_as_dense=True")
         if self._acc is None:
             self._acc = grads
         else:
@@ -216,22 +221,13 @@ class DistributedAdasumOptimizer(_GradAccumulation):
         return self._tx.init(params)
 
     def update(self, grads, state, params=None):
-        from ..ops import sparse as _sparse
-
         # Adasum cannot combine IndexedSlices (parity:
         # `tensorflow/__init__.py:77-81`) — densify up front or fail loudly
         # before tree_map could corrupt the indices.
-        has_sparse = any(
-            isinstance(l, _sparse.IndexedSlices)
-            for l in jax.tree_util.tree_leaves(
-                grads,
-                is_leaf=lambda x: isinstance(x, _sparse.IndexedSlices)))
-        if has_sparse:
-            if not self._sparse_as_dense:
-                raise NotImplementedError(
-                    "The Adasum reduction does not support sparse "
-                    "gradients; pass sparse_as_dense=True")
-            grads = _sparse.densify_tree(grads)
+        grads = _densify_or_raise(
+            grads, self._sparse_as_dense,
+            "The Adasum reduction does not support sparse gradients; "
+            "pass sparse_as_dense=True")
         communicate, grads = self._accumulate(grads)
         if not communicate:
             zero = jax.tree_util.tree_map(jnp.zeros_like, grads)

@@ -793,6 +793,9 @@ class CoordController:
     def timeline_cycle(self) -> None:
         self._timeline.cycle_tick()
 
+    def timeline_cache(self, hits: int, misses: int) -> None:
+        self._timeline.cache_counter(hits, misses)
+
     def report_score(self, nbytes: int, seconds: float) -> bool:
         return False  # autotune runs in the in-process native core only
 

@@ -142,6 +142,7 @@ class Engine:
         # shape signatures already executed once: first executions include
         # XLA compile time and must not be scored for autotune
         self._scored_sigs: set = set()
+        self._last_cache_stats = (0, 0)
 
     # ------------------------------------------------------------------ API
     def start(self) -> None:
@@ -241,6 +242,10 @@ class Engine:
                                        "60"), name)
                 if responses:
                     self.controller.timeline_cycle()
+                    hits, misses = self.controller.cache_stats()
+                    if (hits, misses) != self._last_cache_stats:
+                        self._last_cache_stats = (hits, misses)
+                        self.controller.timeline_cache(hits, misses)
                 for resp, pairs in zip(responses, handle_pairs):
                     self._perform(resp, pairs)
                 if join_released:

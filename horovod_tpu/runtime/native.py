@@ -88,6 +88,9 @@ def load_library():
         lib.hvd_core_timeline_op_end.argtypes = [ctypes.c_int64,
                                                  ctypes.c_char_p]
         lib.hvd_core_timeline_cycle.argtypes = [ctypes.c_int64]
+        lib.hvd_core_timeline_cache.argtypes = [ctypes.c_int64,
+                                                ctypes.c_uint64,
+                                                ctypes.c_uint64]
         lib.hvd_core_report_score.restype = ctypes.c_int32
         lib.hvd_core_report_score.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                               ctypes.c_double]
@@ -175,6 +178,9 @@ class NativeController:
 
     def timeline_cycle(self) -> None:
         self._lib.hvd_core_timeline_cycle(self._eng)
+
+    def timeline_cache(self, hits: int, misses: int) -> None:
+        self._lib.hvd_core_timeline_cache(self._eng, hits, misses)
 
     def report_score(self, nbytes: int, seconds: float) -> bool:
         return bool(self._lib.hvd_core_report_score(self._eng, nbytes,

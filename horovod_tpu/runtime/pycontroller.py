@@ -262,6 +262,9 @@ class PyController:
     def timeline_cycle(self) -> None:
         self._timeline.cycle_tick()
 
+    def timeline_cache(self, hits: int, misses: int) -> None:
+        self._timeline.cache_counter(hits, misses)
+
     def report_score(self, nbytes: int, seconds: float) -> bool:
         return False  # autotune is a native-core feature
 

@@ -266,17 +266,23 @@ class DistributedAdasumOptimizer:
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         t = _require_tf()
-        gv = [(g, v) for g, v in grads_and_vars if g is not None]
-        for _, v in gv:
+        # Keep the FULL variable list for communication: submission must not
+        # depend on rank-local gradient presence (a var whose grad is None on
+        # this rank still contributes its — zero — delta), or ranks diverge
+        # on the negotiated name set and deadlock; names index the full list
+        # so differing None patterns can't pair different variables.
+        all_gv = list(grads_and_vars)
+        gv = [(g, v) for g, v in all_gv if g is not None]
+        for _, v in all_gv:
             if v.ref() not in self._starts:
                 self._starts[v.ref()] = t.Variable(v.read_value(),
                                                    trainable=False)
-        result = self._opt.apply_gradients(gv, **kwargs)
+        result = self._opt.apply_gradients(gv, **kwargs) if gv else None
         self._step_count += 1
         if self._step_count % self._k != 0:
             return result
         started = []
-        for i, (_, v) in enumerate(gv):
+        for i, (_, v) in enumerate(all_gv):
             start = self._starts[v.ref()]
             delta = v.read_value() - start.read_value()
             comp, ctx = self._compression.compress(delta)

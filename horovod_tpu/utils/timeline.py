@@ -102,6 +102,14 @@ class Timeline:
             self._emit({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
                         "ts": self._ts(), "s": "g"})
 
+    def cache_counter(self, hits: int, misses: int) -> None:
+        """Chrome counter track of response-cache hits/misses (the fast
+        path that skips negotiation, reference `controller.cc:171-185`)."""
+        if self._enabled:
+            self._emit({"name": "response_cache", "ph": "C", "pid": 0,
+                        "ts": self._ts(),
+                        "args": {"hits": hits, "misses": misses}})
+
     def close(self) -> None:
         if not self._enabled:
             return

@@ -257,6 +257,28 @@ def test_tf_distributed_optimizer_routes_adasum():
     assert all(testing.run_cluster(fn, np=2))
 
 
+def test_tf_adasum_none_grad_no_deadlock():
+    """A variable whose grad is None on only SOME ranks still contributes a
+    (zero) delta everywhere — submission can't depend on rank-local
+    gradient presence or negotiation deadlocks."""
+    tf = pytest.importorskip("tensorflow")
+    import horovod_tpu.tensorflow as hvd_tf
+
+    def fn():
+        r = hvd.rank()
+        v1 = tf.Variable(np.ones(2, np.float32))
+        v2 = tf.Variable(np.ones(2, np.float32))
+        opt = hvd_tf.DistributedAdasumOptimizer(tf.keras.optimizers.SGD(0.1))
+        g1 = tf.constant(np.full(2, float(r + 1), np.float32))
+        g2 = None if r else tf.constant(np.full(2, 3.0, np.float32))
+        opt.apply_gradients([(g1, v1), (g2, v2)])
+        return v1.numpy(), v2.numpy()
+
+    outs = testing.run_cluster(fn, np=2)
+    np.testing.assert_allclose(outs[0][0], outs[1][0])
+    np.testing.assert_allclose(outs[0][1], outs[1][1])
+
+
 def test_tf_adasum_backward_passes_accumulate_delta():
     """Non-comm steps update locally; the comm step reduces the cumulative
     delta since start (the TF reference's slot/cond flow, eagerly)."""
