@@ -1,0 +1,55 @@
+"""On-disk memoization of expensive launcher checks (ssh reachability, NIC
+sets) with a TTL.
+
+Reference parity: `horovod/run/util/cache.py` — a pickled dict under
+``~/.horovod`` keyed by parameters, entries expire after
+``--disable-cache``-able timeout. Here: JSON under ``~/.horovod_tpu`` (no
+pickle needed for plain values), same TTL semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+
+class DiskCache:
+    def __init__(self, path: Optional[str] = None, ttl_s: float = 1200.0,
+                 clock: Callable[[], float] = time.time):
+        self._path = path or os.path.join(
+            os.path.expanduser("~"), ".horovod_tpu", "cache.json")
+        self._ttl = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._data = {}
+        try:
+            with open(self._path) as f:
+                self._data = json.load(f)
+        except (OSError, ValueError):
+            self._data = {}
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                return None
+            ts, value = ent
+            if self._clock() - ts > self._ttl:
+                del self._data[key]
+                return None
+            return value
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = [self._clock(), value]
+            try:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                tmp = self._path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._data, f)
+                os.replace(tmp, self._path)
+            except OSError:
+                pass  # cache is best-effort

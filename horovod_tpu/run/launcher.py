@@ -21,8 +21,12 @@ import os
 import sys
 from typing import Dict, List, Optional
 
+import logging
+
 from . import config_parser, hosts as hosts_mod, rendezvous
 from .exec_utils import RankProcess, wait_all
+
+logger = logging.getLogger("horovod_tpu.run")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -37,6 +41,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hostfile", default=None,
                    help="file with one 'host slots=N' per line")
     p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--no-ssh-check", action="store_true",
+                   help="skip the ssh reachability pre-flight")
+    p.add_argument("--no-nic-discovery", action="store_true",
+                   help="skip driver/task NIC discovery; guess one address")
+    p.add_argument("--nics", default=None,
+                   help="comma-separated interface allowlist (skips "
+                        "discovery), e.g. eth0,eth1")
+    p.add_argument("--disable-cache", action="store_true",
+                   help="do not memoize ssh checks on disk")
     p.add_argument("--output-filename", default=None,
                    help="per-rank output file prefix (rank appended)")
     p.add_argument("--start-timeout", type=float, default=600.0)
@@ -78,12 +91,89 @@ def make_rank_envs(ranks, coordinator_addr: str, kv_addr: str, secret: str,
     return envs
 
 
+def _discover_nics(hostnames: List[str], ssh_port: int, secret: str,
+                   local_host: str):
+    """Driver/task ring NIC discovery (`run/run.py:199-269` redesigned on
+    the authenticated service layer): start a task server on every host via
+    ssh (locally for this host), register, ring-probe, intersect.
+
+    Returns ``(nic, driver_ip, per_host_ip)`` — the chosen common
+    interface, the launcher's address on it, and each host's address on it
+    — or None if discovery failed (caller falls back to the one-NIC guess).
+    """
+    import subprocess
+
+    from . import network as net
+    from .service import DriverService, TaskClient
+
+    driver = DriverService(len(hostnames), secret)
+    procs = []
+    clients = []
+    try:
+        driver_ifaces = net.filter_routed(net.get_local_interfaces())
+        driver_ip_guess = rendezvous.local_ip()
+        module = [sys.executable, "-m", "horovod_tpu.run.task_server"]
+        for i, host in enumerate(hostnames):
+            args = ["--index", str(i),
+                    "--driver", f"{driver_ip_guess}:{driver.port}"]
+            if host == local_host:
+                env = dict(os.environ, HVD_SECRET=secret)
+                local_args = list(args)
+                local_args[3] = f"127.0.0.1:{driver.port}"
+                procs.append(subprocess.Popen(module + local_args, env=env))
+            else:
+                import shlex
+
+                # the secret travels over ssh STDIN — an env assignment in
+                # the remote command would be visible in `ps` on that host
+                remote = (f"cd {shlex.quote(os.getcwd())} && "
+                          + " ".join(shlex.quote(c)
+                                     for c in module + args
+                                     + ["--secret-stdin"]))
+                p = subprocess.Popen(
+                    ["ssh", "-p", str(ssh_port),
+                     "-o", "StrictHostKeyChecking=no", host, remote],
+                    stdin=subprocess.PIPE)
+                p.stdin.write((secret + "\n").encode())
+                p.stdin.flush()
+                procs.append(p)
+        driver.wait_for_registration(timeout=60.0)
+        clients = [TaskClient((hostnames[i], driver.task_addresses(i)
+                               [next(iter(driver.task_addresses(i)))][1]),
+                   secret) for i in range(len(hostnames))]
+        common = driver.ring_probe(clients)
+        nic = common[0]
+        per_host = {h: driver.task_addresses(i).get(nic, (None,))[0]
+                    for i, h in enumerate(hostnames)}
+        driver_ip = driver_ifaces.get(nic, driver_ip_guess)
+        return nic, driver_ip, per_host
+    except Exception as exc:
+        logger.warning("NIC discovery failed (%s); falling back to "
+                       "single-address guess", exc)
+        return None
+    finally:
+        # ask remote task servers to exit — terminating the local ssh
+        # client alone would leave them lingering (no pty, no signal)
+        for c in clients:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+        for p in procs:
+            p.terminate()
+        driver.stop()
+
+
 def launch(np: int, command: List[str], hosts: Optional[str] = None,
            hostfile: Optional[str] = None, ssh_port: int = 22,
            knob_env: Optional[Dict[str, str]] = None,
            output_filename: Optional[str] = None,
            start_timeout: float = 600.0,
-           extra_env: Optional[Dict[str, str]] = None) -> int:
+           extra_env: Optional[Dict[str, str]] = None,
+           check_ssh: Optional[bool] = None,
+           discover_nics: Optional[bool] = None,
+           nics: Optional[List[str]] = None,
+           use_cache: bool = True) -> int:
     """Core fan-out; returns worst exit code."""
     if hostfile:
         hostlist = hosts_mod.parse_hostfile(hostfile)
@@ -95,18 +185,53 @@ def launch(np: int, command: List[str], hosts: Optional[str] = None,
 
     secret = rendezvous.make_secret()
     kv = rendezvous.KVStoreServer(secret).start()
-    multi_host = any(r.hostname not in ("localhost", "127.0.0.1")
-                     for r in ranks)
+    # locality by resolution, not string match: hostfiles commonly name the
+    # driver's own machine by real hostname (`run/run.py` local set)
+    from .network import resolves_local
+
+    local = {h: resolves_local(h)
+             for h in dict.fromkeys(r.hostname for r in ranks)}
+    multi_host = any(not local[r.hostname] for r in ranks)
+
+    remote_hosts = sorted({r.hostname for r in ranks
+                           if not local[r.hostname]})
+    if (check_ssh if check_ssh is not None else multi_host) and remote_hosts:
+        from .cache import DiskCache
+        from .ssh import check_all_hosts_ssh
+
+        check_all_hosts_ssh(remote_hosts, ssh_port,
+                            cache=DiskCache() if use_cache else None)
+
     ip = rendezvous.local_ip() if multi_host else "127.0.0.1"
+    host_ips: Dict[str, str] = {}
+    iface_env: Dict[str, str] = {}
+    if nics:
+        iface_env["HVD_NICS"] = ",".join(nics)
+    elif (discover_nics if discover_nics is not None else multi_host):
+        hostnames = list(dict.fromkeys(r.hostname for r in ranks))
+        local_names = [h for h in hostnames if local[h]]
+        found = _discover_nics(hostnames, ssh_port, secret,
+                               local_names[0] if local_names else "")
+        if found:
+            nic, driver_ip, per_host = found
+            iface_env["HVD_NICS"] = nic
+            ip = driver_ip
+            host_ips = {h: a for h, a in per_host.items() if a}
+
     kv_addr = f"{ip}:{kv.port}"
     coord_port = rendezvous.find_free_port()
-    coord_host = ranks[0].hostname
-    if coord_host in ("localhost",):
+    coord_host = host_ips.get(ranks[0].hostname, ranks[0].hostname)
+    if local.get(coord_host, False) or coord_host in ("localhost",
+                                                      "127.0.0.1"):
+        # a loopback/local coordinator address is unreachable from remote
+        # ranks — advertise the routable launcher address instead
         coord_host = "127.0.0.1" if not multi_host else ip
     coordinator_addr = f"{coord_host}:{coord_port}"
 
+    merged_knobs = dict(knob_env or {})
+    merged_knobs.update(iface_env)
     envs = make_rank_envs(ranks, coordinator_addr, kv_addr, secret,
-                          knob_env or {})
+                          merged_knobs)
     if extra_env:
         for e in envs:
             e.update(extra_env)
@@ -140,7 +265,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     return launch(args.num_proc, cmd, hosts=args.hosts,
                   hostfile=args.hostfile, ssh_port=args.ssh_port,
                   knob_env=knob_env, output_filename=args.output_filename,
-                  start_timeout=args.start_timeout)
+                  start_timeout=args.start_timeout,
+                  check_ssh=False if args.no_ssh_check else None,
+                  discover_nics=False if args.no_nic_discovery else None,
+                  nics=args.nics.split(",") if args.nics else None,
+                  use_cache=not args.disable_cache)
 
 
 def main() -> None:

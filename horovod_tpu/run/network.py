@@ -1,0 +1,88 @@
+"""Network introspection for the launcher: interface enumeration, routed-
+interface probing, and host hashing.
+
+Reference parity: `horovod/run/run.py:199-269` (NIC discovery — every worker
+probes the next worker's interfaces in a ring and the driver intersects the
+routed sets), `horovod/run/common/util/host_hash.py` (host identity for
+colocation), `horovod/run/util/network.py` (interface filtering).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import struct
+from typing import Dict, Iterable, Set, Tuple
+
+
+def get_local_interfaces() -> Dict[str, str]:
+    """Interface name → IPv4 address for every UP interface with an
+    address (Linux ioctl SIOCGIFADDR; the reference uses psutil)."""
+    import fcntl
+
+    out: Dict[str, str] = {}
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        for _, name in socket.if_nameindex():
+            try:
+                packed = fcntl.ioctl(
+                    s.fileno(), 0x8915,  # SIOCGIFADDR
+                    struct.pack("256s", name[:15].encode()))
+                out[name] = socket.inet_ntoa(packed[20:24])
+            except OSError:
+                continue  # interface without an IPv4 address
+    return out
+
+
+def filter_routed(ifaces: Dict[str, str]) -> Dict[str, str]:
+    """Drop loopback — interfaces 'not really connected to any external
+    networks such as lo0 with address 127.0.0.1' (`run/run.py:248-251`)."""
+    return {n: a for n, a in ifaces.items()
+            if not a.startswith("127.") and n != "lo"}
+
+
+def probe_reachable(addresses: Dict[str, Tuple[str, int]],
+                    timeout: float = 2.0) -> Set[str]:
+    """Which of the peer's per-NIC (ip, port) listeners can THIS host reach?
+    The ring-probe step of NIC discovery (`run/run.py:246-253`). Probes run
+    concurrently so unreachable NICs cost one connect-timeout total, not
+    one each."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def try_one(item):
+        nic, (ip, port) = item
+        try:
+            with socket.create_connection((ip, port), timeout=timeout):
+                return nic
+        except OSError:
+            return None
+
+    if not addresses:
+        return set()
+    with ThreadPoolExecutor(max_workers=min(16, len(addresses))) as ex:
+        return {nic for nic in ex.map(try_one, addresses.items())
+                if nic is not None}
+
+
+def host_hash(salt: str = "") -> str:
+    """Stable identity of THIS host, for colocating ranks launched through
+    indirection (Spark task hosts, containers) where hostname strings may
+    not match (`host_hash.py`). ``HOROVOD_HOSTNAME`` overrides."""
+    hostname = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+    # containers of the same job on one machine share no hostname; CONTAINER
+    # ids make them distinct hosts, as in the reference
+    container = os.environ.get("CONTAINER_ID", "")
+    return hashlib.sha1(
+        f"{hostname}-{container}-{salt}".encode()).hexdigest()[:16]
+
+
+def resolves_local(hostname: str) -> bool:
+    """Does this name refer to the local machine? (`run/run.py` local set)"""
+    if hostname in ("localhost", "127.0.0.1", socket.gethostname()):
+        return True
+    try:
+        addrs = {ai[4][0] for ai in socket.getaddrinfo(hostname, None)}
+    except OSError:
+        return False
+    local = set(get_local_interfaces().values()) | {"127.0.0.1", "::1"}
+    return bool(addrs & local)

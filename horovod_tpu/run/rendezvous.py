@@ -144,8 +144,20 @@ def find_free_port() -> int:
 
 
 def local_ip() -> str:
-    """Best-effort routable local address (reference NIC discovery is a full
-    driver/task probe, `run/run.py:199-269`; single-NIC hosts need only this)."""
+    """Local address to advertise. ``HVD_NICS`` (set by ``hvdrun --nics`` or
+    NIC discovery) pins it to a named interface; otherwise a best-effort
+    route-based guess (reference NIC discovery is the full driver/task
+    probe, `run/run.py:199-269`; single-NIC hosts need only the guess)."""
+    import os
+
+    nics = os.environ.get("HVD_NICS")
+    if nics:
+        from .network import get_local_interfaces
+
+        ifaces = get_local_interfaces()
+        for nic in nics.split(","):
+            if nic in ifaces:
+                return ifaces[nic]
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             s.connect(("10.255.255.255", 1))

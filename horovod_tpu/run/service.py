@@ -1,0 +1,307 @@
+"""Secret-authenticated driver/task TCP services for launch-time
+coordination: task registration, ring NIC probing, remote command execution
+and termination.
+
+Reference parity: `horovod/run/common/service/driver_service.py` (driver
+collects task registrations + per-task routed interfaces, intersects),
+`task_service.py` (remote command exec + wait), `common/network.py` (secret-
+authenticated pickled-message TCP services). Wire format here:
+``len(4B big-endian) | hmac_sha256(32B) | pickle`` — the HMAC over the
+pickle bytes is verified BEFORE unpickling, so unauthenticated peers cannot
+reach the deserializer (same property as the reference's `secret.py`
+wrapping).
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from . import network as net
+
+_LEN = struct.Struct(">I")
+_DIGEST = 32
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock: socket.socket, secret: str, msg: Any) -> None:
+    payload = pickle.dumps(msg)
+    digest = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
+    sock.sendall(_LEN.pack(len(payload) + _DIGEST) + digest + payload)
+
+
+def _recv_msg(sock: socket.socket, secret: str) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    frame = _recv_exact(sock, n)
+    digest, payload = frame[:_DIGEST], frame[_DIGEST:]
+    want = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(digest, want):
+        raise PermissionError("message failed HMAC authentication")
+    return pickle.loads(payload)
+
+
+class _Service:
+    """Threaded request/response TCP server; one message per connection."""
+
+    def __init__(self, secret: str, handler: Callable[[dict], Any],
+                 port: int = 0):
+        self._secret = secret
+        self._handler = handler
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                conn.settimeout(30.0)
+                msg = _recv_msg(conn, self._secret)
+                reply = self._handler(msg)
+                _send_msg(conn, self._secret, reply)
+            except PermissionError:
+                return  # unauthenticated: drop silently
+            except Exception as exc:
+                try:
+                    _send_msg(conn, self._secret,
+                              {"error": f"{type(exc).__name__}: {exc}"})
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def call(addr: Tuple[str, int], secret: str, msg: dict,
+         timeout: float = 30.0) -> Any:
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        _send_msg(sock, secret, msg)
+        reply = _recv_msg(sock, secret)
+    if isinstance(reply, dict) and "error" in reply:
+        raise RuntimeError(reply["error"])
+    return reply
+
+
+# -------------------------------------------------------------- task service
+class TaskService:
+    """Per-host service started before the job: answers interface probes and
+    executes/terminates commands (`task_service.py` parity)."""
+
+    def __init__(self, index: int, secret: str, include_lo: bool = False):
+        self.index = index
+        self._secret = secret
+        self._include_lo = include_lo
+        self._proc: Optional[subprocess.Popen] = None
+        self._shutdown = threading.Event()
+        self._svc = _Service(secret, self._handle)
+        self.port = self._svc.port
+
+    def shutdown_requested(self) -> bool:
+        return self._shutdown.is_set()
+
+    def addresses(self) -> Dict[str, Tuple[str, int]]:
+        """nic → (ip, port) for every (routed) local interface; the single
+        listener binds 0.0.0.0 so each address reaches it."""
+        ifaces = net.get_local_interfaces()
+        if not self._include_lo:
+            ifaces = net.filter_routed(ifaces) or ifaces
+        return {nic: (ip, self.port) for nic, ip in ifaces.items()}
+
+    def _handle(self, msg: dict) -> Any:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "index": self.index}
+        if op == "addresses":
+            return self.addresses()
+        if op == "probe":
+            return {"reachable":
+                    sorted(net.probe_reachable(msg["addresses"]))}
+        if op == "run":
+            if self._proc is not None and self._proc.poll() is None:
+                raise RuntimeError("a command is already running")
+            env = dict(os.environ)
+            env.update(msg.get("env") or {})
+            self._proc = subprocess.Popen(
+                msg["cmd"], env=env, start_new_session=True)
+            return {"pid": self._proc.pid}
+        if op == "wait":
+            if self._proc is None:
+                raise RuntimeError("no command started")
+            return {"rc": self._proc.wait(msg.get("timeout"))}
+        if op == "terminate":
+            if self._proc is not None and self._proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+                except OSError:
+                    self._proc.terminate()
+            return {"ok": True}
+        if op == "shutdown":
+            # stop lingering: the driver is done with this task server
+            # (killing the local ssh client alone would NOT stop the
+            # remote process — no pty, no signal)
+            self._shutdown.set()
+            return {"ok": True}
+        raise ValueError(f"unknown op: {op}")
+
+    def stop(self) -> None:
+        self._handle({"op": "terminate"})
+        self._svc.stop()
+
+
+class TaskClient:
+    def __init__(self, addr: Tuple[str, int], secret: str):
+        self._addr = addr
+        self._secret = secret
+
+    def _call(self, msg: dict, timeout: float = 30.0) -> Any:
+        return call(self._addr, self._secret, msg, timeout=timeout)
+
+    def ping(self):
+        return self._call({"op": "ping"})
+
+    def addresses(self) -> Dict[str, Tuple[str, int]]:
+        return self._call({"op": "addresses"})
+
+    def probe(self, addresses: Dict[str, Tuple[str, int]]) -> List[str]:
+        return self._call({"op": "probe", "addresses": addresses})["reachable"]
+
+    def run_command(self, cmd: List[str],
+                    env: Optional[Dict[str, str]] = None) -> int:
+        return self._call({"op": "run", "cmd": cmd, "env": env})["pid"]
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        # timeout=None means wait forever — the socket must block forever
+        # too, not cap at the default call() timeout
+        return self._call({"op": "wait", "timeout": timeout},
+                          timeout=None if timeout is None
+                          else timeout + 5.0)["rc"]
+
+    def terminate(self) -> None:
+        self._call({"op": "terminate"})
+
+    def shutdown(self) -> None:
+        self._call({"op": "shutdown"})
+
+
+# ------------------------------------------------------------ driver service
+class DriverService:
+    """Launcher-side registry: tasks register their per-NIC addresses +
+    host hash; after the ring probe the driver knows the common routed
+    interface set (`driver_service.py` + `run.py:199-269`)."""
+
+    def __init__(self, num_hosts: int, secret: str):
+        self.num_hosts = num_hosts
+        self._secret = secret
+        self._cv = threading.Condition()
+        self._registered: Dict[int, Dict[str, Tuple[str, int]]] = {}
+        self._host_hashes: Dict[int, str] = {}
+        self._routed: Dict[int, Set[str]] = {}
+        self._svc = _Service(secret, self._handle)
+        self.port = self._svc.port
+
+    def _handle(self, msg: dict) -> Any:
+        op = msg.get("op")
+        if op == "register":
+            with self._cv:
+                self._registered[msg["index"]] = msg["addresses"]
+                self._host_hashes[msg["index"]] = msg.get("host_hash", "")
+                self._cv.notify_all()
+            return {"ok": True}
+        raise ValueError(f"unknown op: {op}")
+
+    def wait_for_registration(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while len(self._registered) < self.num_hosts:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                    missing = sorted(set(range(self.num_hosts))
+                                     - set(self._registered))
+                    raise TimeoutError(
+                        f"task services {missing} never registered within "
+                        f"{timeout}s")
+
+    def task_addresses(self, index: int) -> Dict[str, Tuple[str, int]]:
+        with self._cv:
+            return dict(self._registered[index])
+
+    def host_hashes(self) -> Dict[int, str]:
+        with self._cv:
+            return dict(self._host_hashes)
+
+    def ring_probe(self, clients: List[TaskClient]) -> List[str]:
+        """Each task probes the NEXT task's addresses (ring), all hosts in
+        parallel; the common reachable interface set is the intersection
+        (`run.py:246-266`)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def probe_one(i):
+            return set(clients[i].probe(
+                self.task_addresses((i + 1) % self.num_hosts)))
+
+        with ThreadPoolExecutor(max_workers=min(32, self.num_hosts)) as ex:
+            for i, routed in enumerate(ex.map(probe_one,
+                                              range(self.num_hosts))):
+                self._routed[i] = routed
+        common: Optional[Set[str]] = None
+        for i in range(self.num_hosts):
+            common = self._routed[i] if common is None \
+                else (common & self._routed[i])
+        if not common:
+            raise RuntimeError(
+                "Unable to find a set of common task-to-task communication "
+                f"interfaces: {sorted((i, sorted(r)) for i, r in self._routed.items())}")
+        return sorted(common)
+
+    def stop(self) -> None:
+        self._svc.stop()
+
+
+class DriverClient:
+    def __init__(self, addr: Tuple[str, int], secret: str):
+        self._addr = addr
+        self._secret = secret
+
+    def register(self, index: int, addresses: Dict[str, Tuple[str, int]],
+                 host_hash: str = "") -> None:
+        call(self._addr, self._secret,
+             {"op": "register", "index": index, "addresses": addresses,
+              "host_hash": host_hash})

@@ -1,0 +1,71 @@
+"""ssh pre-flight reachability checks.
+
+Reference parity: `horovod/run/run.py:63-115`
+(`_check_all_hosts_ssh_successful`): every remote host gets
+``ssh -o StrictHostKeyChecking=no <host> date``, retried up to 5 times,
+threaded across hosts; any failure prints the output and exits. Results are
+memoized on disk (`run/util/cache.py`) so repeated launches skip the probe.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterable, Optional, Tuple
+
+from .cache import DiskCache
+
+SSH_RETRIES = 5
+
+
+def _default_exec(host: str, ssh_port: int) -> Tuple[int, str]:
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port and ssh_port != 22:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [host, "date"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=30)
+    return r.returncode, r.stdout + r.stderr
+
+
+def check_all_hosts_ssh(hosts: Iterable[str], ssh_port: int = 22,
+                        retries: int = SSH_RETRIES,
+                        cache: Optional[DiskCache] = None,
+                        exec_fn=_default_exec,
+                        exit_on_failure: bool = True) -> Dict[str, bool]:
+    """Probe every host concurrently; returns host → ok. With
+    ``exit_on_failure`` (the CLI path) a failure prints the ssh output for
+    each bad host and raises SystemExit(1), as the reference does."""
+    hosts = list(dict.fromkeys(hosts))
+    cache = cache
+    results: Dict[str, bool] = {}
+    outputs: Dict[str, str] = {}
+
+    def probe(host: str) -> bool:
+        key = f"ssh:{host}:{ssh_port}"
+        if cache is not None and cache.get(key):
+            return True
+        out = ""
+        for _ in range(retries):
+            try:
+                rc, out = exec_fn(host, ssh_port)
+            except Exception as exc:  # timeout, missing binary...
+                rc, out = 255, str(exc)
+            if rc == 0:
+                if cache is not None:
+                    cache.put(key, True)
+                return True
+        outputs[host] = out
+        return False
+
+    with ThreadPoolExecutor(max_workers=min(32, max(1, len(hosts)))) as ex:
+        for host, ok in zip(hosts, ex.map(probe, hosts)):
+            results[host] = ok
+
+    if exit_on_failure and not all(results.values()):
+        for host, ok in results.items():
+            if not ok:
+                print(f"ssh not successful for host {host}:\n"
+                      f"{outputs.get(host, '')}", file=sys.stderr)
+        raise SystemExit(1)
+    return results

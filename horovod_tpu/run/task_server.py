@@ -1,0 +1,63 @@
+"""Task-server bootstrap: run on each job host (via ssh) before launch so
+the driver can discover common NICs and reach the host for command exec.
+
+Reference parity: `horovod/run/run_task.py` + `run/task/task_service.py` —
+the worker registers its per-interface addresses with the driver service,
+then serves probe/exec requests. The shared secret comes from the
+``HVD_SECRET`` environment variable (never the command line, where it would
+be visible in ``ps``).
+
+Usage (what the launcher execs over ssh)::
+
+    HVD_SECRET=... python -m horovod_tpu.run.task_server \
+        --index 1 --driver 10.0.0.1:43211 [--linger 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--driver", required=True, help="driver ip:port")
+    ap.add_argument("--linger", type=float, default=300.0,
+                    help="seconds to keep serving before exiting")
+    ap.add_argument("--include-lo", action="store_true",
+                    help="report loopback too (single-host testing)")
+    ap.add_argument("--secret-stdin", action="store_true",
+                    help="read the secret from stdin (the ssh path: an env "
+                         "assignment in the remote command would appear in "
+                         "ps output)")
+    args = ap.parse_args(argv)
+
+    if args.secret_stdin:
+        secret = sys.stdin.readline().strip()
+    else:
+        secret = os.environ.get("HVD_SECRET")
+    if not secret:
+        print("task_server: no secret provided", file=sys.stderr)
+        return 2
+
+    from .network import host_hash
+    from .service import DriverClient, TaskService
+
+    svc = TaskService(args.index, secret, include_lo=args.include_lo)
+    try:
+        ip, port_s = args.driver.rsplit(":", 1)
+        DriverClient((ip, int(port_s)), secret).register(
+            args.index, svc.addresses(), host_hash())
+        deadline = time.monotonic() + args.linger
+        while time.monotonic() < deadline and not svc.shutdown_requested():
+            time.sleep(0.2)
+    finally:
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

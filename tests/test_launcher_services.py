@@ -1,0 +1,327 @@
+"""Launcher hardening tests: ssh pre-flight, disk cache, network utils,
+driver/task services, NIC ring discovery, remote exec + terminate.
+
+Parity model: `test/test_run.py` (mocked launcher-unit style: injected exec
+functions, no real ssh) plus real localhost TCP for the service layer, as
+the reference's service tests do.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.run import network as net
+from horovod_tpu.run.cache import DiskCache
+from horovod_tpu.run.service import (DriverClient, DriverService, TaskClient,
+                                     TaskService, call)
+from horovod_tpu.run.ssh import check_all_hosts_ssh
+
+
+# ----------------------------------------------------------------- network
+def test_get_local_interfaces_has_loopback():
+    ifaces = net.get_local_interfaces()
+    assert "lo" in ifaces and ifaces["lo"].startswith("127.")
+
+
+def test_filter_routed_drops_loopback():
+    assert net.filter_routed({"lo": "127.0.0.1", "eth0": "10.0.0.5"}) == \
+        {"eth0": "10.0.0.5"}
+
+
+def test_probe_reachable_and_unreachable():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    port = s.getsockname()[1]
+    with socket.socket() as dead:
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+    # dead_port is now closed — nothing listens there
+    got = net.probe_reachable({"good": ("127.0.0.1", port),
+                               "bad": ("127.0.0.1", dead_port)},
+                              timeout=1.0)
+    s.close()
+    assert got == {"good"}
+
+
+def test_host_hash_stable_and_env_sensitive(monkeypatch):
+    a = net.host_hash()
+    assert a == net.host_hash()
+    monkeypatch.setenv("HOROVOD_HOSTNAME", "other-host")
+    assert net.host_hash() != a
+
+
+def test_resolves_local():
+    assert net.resolves_local("localhost")
+    assert net.resolves_local("127.0.0.1")
+    assert not net.resolves_local("host-that-does-not-exist.invalid")
+
+
+# ------------------------------------------------------------------- cache
+def test_disk_cache_ttl(tmp_path):
+    now = [1000.0]
+    c = DiskCache(str(tmp_path / "c.json"), ttl_s=10.0, clock=lambda: now[0])
+    assert c.get("k") is None
+    c.put("k", True)
+    assert c.get("k") is True
+    now[0] += 11
+    assert c.get("k") is None
+    # persisted across instances
+    c.put("k2", [1, 2])
+    c2 = DiskCache(str(tmp_path / "c.json"), ttl_s=10.0,
+                   clock=lambda: now[0])
+    assert c2.get("k2") == [1, 2]
+
+
+# --------------------------------------------------------------------- ssh
+def test_ssh_check_all_ok_and_command_shape():
+    calls = []
+
+    def fake_exec(host, port):
+        calls.append((host, port))
+        return 0, "ok"
+
+    got = check_all_hosts_ssh(["h1", "h2"], ssh_port=2222, exec_fn=fake_exec)
+    assert got == {"h1": True, "h2": True}
+    assert ("h1", 2222) in calls and ("h2", 2222) in calls
+
+
+def test_ssh_check_retries_then_fails_with_exit():
+    attempts = {"h1": 0}
+
+    def flaky(host, port):
+        attempts[host] += 1
+        return 255, "Connection refused"
+
+    with pytest.raises(SystemExit):
+        check_all_hosts_ssh(["h1"], retries=3, exec_fn=flaky)
+    assert attempts["h1"] == 3
+
+
+def test_ssh_check_uses_cache(tmp_path):
+    now = [0.0]
+    cache = DiskCache(str(tmp_path / "c.json"), ttl_s=100,
+                      clock=lambda: now[0])
+    calls = []
+
+    def fake_exec(host, port):
+        calls.append(host)
+        return 0, ""
+
+    check_all_hosts_ssh(["h1"], exec_fn=fake_exec, cache=cache)
+    check_all_hosts_ssh(["h1"], exec_fn=fake_exec, cache=cache)
+    assert calls == ["h1"]  # second run memoized
+
+
+def test_ssh_check_flaky_then_ok():
+    n = {"h1": 0}
+
+    def flaky(host, port):
+        n[host] += 1
+        return (0, "") if n[host] >= 3 else (255, "nope")
+
+    assert check_all_hosts_ssh(["h1"], retries=5, exec_fn=flaky) == \
+        {"h1": True}
+
+
+# ---------------------------------------------------------------- services
+def test_task_service_auth_required():
+    svc = TaskService(0, "right-secret", include_lo=True)
+    try:
+        with pytest.raises((ConnectionError, OSError, TimeoutError)):
+            call(("127.0.0.1", svc.port), "wrong-secret", {"op": "ping"},
+                 timeout=2.0)
+        # right secret still works after the rejected attempt
+        got = call(("127.0.0.1", svc.port), "right-secret", {"op": "ping"})
+        assert got == {"ok": True, "index": 0}
+    finally:
+        svc.stop()
+
+
+def test_task_service_run_wait_terminate(tmp_path):
+    secret = "s"
+    svc = TaskService(3, secret, include_lo=True)
+    client = TaskClient(("127.0.0.1", svc.port), secret)
+    try:
+        marker = tmp_path / "ran"
+        client.run_command([sys.executable, "-c",
+                            f"open({str(marker)!r}, 'w').write('x')"])
+        assert client.wait(timeout=20.0) == 0
+        assert marker.exists()
+        # long-running command terminated remotely
+        client.run_command([sys.executable, "-c",
+                            "import time; time.sleep(600)"])
+        client.terminate()
+        deadline = time.monotonic() + 10
+        while svc._proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc._proc.poll() is not None
+    finally:
+        svc.stop()
+
+
+def test_driver_registration_ring_probe_and_host_hash():
+    secret = "s2"
+    driver = DriverService(2, secret)
+    tasks = [TaskService(i, secret, include_lo=True) for i in range(2)]
+    try:
+        dc = DriverClient(("127.0.0.1", driver.port), secret)
+        for i, t in enumerate(tasks):
+            dc.register(i, t.addresses(), net.host_hash(salt=str(i)))
+        driver.wait_for_registration(timeout=10.0)
+        assert set(driver.host_hashes()) == {0, 1}
+        clients = [TaskClient(("127.0.0.1", t.port), secret) for t in tasks]
+        common = driver.ring_probe(clients)
+        assert common, "no common interfaces found on localhost"
+        # single machine: loopback must be in the common set
+        assert "lo" in common
+    finally:
+        for t in tasks:
+            t.stop()
+        driver.stop()
+
+
+def test_driver_registration_timeout_names_missing():
+    driver = DriverService(2, "s3")
+    try:
+        DriverClient(("127.0.0.1", driver.port), "s3").register(0, {})
+        with pytest.raises(TimeoutError, match=r"\[1\]"):
+            driver.wait_for_registration(timeout=0.3)
+    finally:
+        driver.stop()
+
+
+def test_task_server_module_end_to_end():
+    """The ssh-launched bootstrap: spawn task_server as a real subprocess,
+    it registers with the driver and serves probes until terminated."""
+    secret = "s4"
+    driver = DriverService(1, secret)
+    env = dict(os.environ, HVD_SECRET=secret,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.task_server",
+         "--index", "0", "--driver", f"127.0.0.1:{driver.port}",
+         "--include-lo", "--linger", "60"], env=env)
+    try:
+        driver.wait_for_registration(timeout=30.0)
+        addrs = driver.task_addresses(0)
+        assert addrs
+        nic, (ip, port) = next(iter(addrs.items()))
+        client = TaskClient(("127.0.0.1", port), secret)
+        reachable = client.probe({"self": ("127.0.0.1", port)})
+        assert reachable == ["self"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        driver.stop()
+
+
+def test_task_client_wait_none_blocks_past_default_timeout():
+    """wait(timeout=None) must block until the command exits, not cap at
+    the default socket timeout."""
+    secret = "s5"
+    svc = TaskService(0, secret, include_lo=True)
+    client = TaskClient(("127.0.0.1", svc.port), secret)
+    try:
+        client.run_command([sys.executable, "-c",
+                            "import time; time.sleep(2)"])
+        t0 = time.monotonic()
+        assert client.wait(timeout=None) == 0
+        assert time.monotonic() - t0 >= 1.5
+    finally:
+        svc.stop()
+
+
+def test_task_service_shutdown_op():
+    secret = "s6"
+    svc = TaskService(0, secret, include_lo=True)
+    client = TaskClient(("127.0.0.1", svc.port), secret)
+    try:
+        assert not svc.shutdown_requested()
+        client.shutdown()
+        assert svc.shutdown_requested()
+    finally:
+        svc.stop()
+
+
+def test_task_server_secret_via_stdin():
+    """The ssh path: secret travels over stdin, never argv or remote env
+    assignments (visible in ps)."""
+    secret = "stdin-secret"
+    driver = DriverService(1, secret)
+    env = {k: v for k, v in os.environ.items() if k != "HVD_SECRET"}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.task_server",
+         "--index", "0", "--driver", f"127.0.0.1:{driver.port}",
+         "--include-lo", "--secret-stdin", "--linger", "60"],
+        env=env, stdin=subprocess.PIPE)
+    proc.stdin.write((secret + "\n").encode())
+    proc.stdin.flush()
+    try:
+        driver.wait_for_registration(timeout=30.0)
+        _, (ip, port) = next(iter(driver.task_addresses(0).items()))
+        # driver tells it to shut down; process exits before linger
+        TaskClient(("127.0.0.1", port), secret).shutdown()
+        assert proc.wait(timeout=15) == 0
+    finally:
+        proc.terminate()
+        driver.stop()
+
+
+def test_local_ip_honors_hvd_nics(monkeypatch):
+    from horovod_tpu.run import rendezvous
+
+    monkeypatch.setenv("HVD_NICS", "lo")
+    assert rendezvous.local_ip() == "127.0.0.1"
+    monkeypatch.setenv("HVD_NICS", "no-such-nic")
+    assert rendezvous.local_ip() != ""  # falls back to the route guess
+
+
+# ------------------------------------------------------- launcher wiring
+def test_launch_local_skips_ssh_and_discovery(monkeypatch, tmp_path):
+    """Single-host launches must not ssh or probe anything."""
+    from horovod_tpu.run import launcher
+
+    def boom(*a, **k):
+        raise AssertionError("ssh check must not run for localhost")
+
+    from horovod_tpu.run import ssh as sshmod
+
+    monkeypatch.setattr(sshmod, "check_all_hosts_ssh", boom)
+    monkeypatch.setattr(launcher, "_discover_nics", boom)
+    marker = tmp_path / "ok"
+    rc = launcher.launch(
+        1, [sys.executable, "-c",
+            f"open({str(marker)!r}, 'w').write('y')"])
+    assert rc == 0 and marker.exists()
+
+
+def test_launch_multihost_runs_ssh_check(monkeypatch):
+    """Multi-host: the pre-flight runs and a failure aborts the launch
+    before any rank process starts (mocked ssh, reference test_run style)."""
+    from horovod_tpu.run import launcher
+
+    seen = {}
+
+    def fake_check(hosts, ssh_port, cache=None, **kw):
+        seen["hosts"] = list(hosts)
+        seen["port"] = ssh_port
+        raise SystemExit(1)
+
+    started = []
+    monkeypatch.setattr(launcher, "RankProcess",
+                        lambda *a, **k: started.append(a))
+    from horovod_tpu.run import ssh as sshmod
+
+    monkeypatch.setattr(sshmod, "check_all_hosts_ssh", fake_check)
+    with pytest.raises(SystemExit):
+        launcher.launch(2, ["true"], hosts="hostA:1,hostB:1", ssh_port=2200)
+    assert seen == {"hosts": ["hostA", "hostB"], "port": 2200}
+    assert not started
