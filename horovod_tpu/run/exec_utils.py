@@ -19,14 +19,25 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 
+# Env vars whose values must never appear on a remote command line (argv is
+# world-readable via `ps` on the remote host) — they travel over ssh stdin.
+SENSITIVE_ENV = ("HVD_SECRET",)
+
+
 class RankProcess:
     def __init__(self, rank: int, cmd: Sequence[str], env: Dict[str, str],
                  hostname: Optional[str] = None, ssh_port: int = 22,
-                 output_file: Optional[str] = None):
+                 output_file: Optional[str] = None,
+                 is_local: Optional[bool] = None):
         self.rank = rank
         self.returncode: Optional[int] = None
         self._output_file = output_file
-        if hostname in (None, "localhost", "127.0.0.1"):
+        if is_local is None:
+            # fallback when the caller didn't already classify the host
+            # (launch() passes its resolves_local verdict so both layers
+            # agree on what counts as local)
+            is_local = hostname in (None, "localhost", "127.0.0.1")
+        if is_local:
             full_env = dict(os.environ)
             full_env.update(env)
             self._proc = subprocess.Popen(
@@ -34,15 +45,25 @@ class RankProcess:
                 stderr=subprocess.STDOUT, start_new_session=True)
         else:
             # ssh fan-out: env inlined into the remote command
-            # (gloo_run.py:207-237)
-            envstr = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-            remote = f"cd {shlex.quote(os.getcwd())} && env {envstr} " + \
-                " ".join(shlex.quote(c) for c in cmd)
+            # (gloo_run.py:207-237) — except secrets, which are read from
+            # stdin so they never show up in `ps` output
+            secret_vars = [k for k in SENSITIVE_ENV if k in env]
+            envstr = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items()
+                              if k not in secret_vars)
+            prefix = "".join(f"IFS= read -r {k} && export {k} && "
+                             for k in secret_vars)
+            remote = f"{prefix}cd {shlex.quote(os.getcwd())} && " \
+                f"env {envstr} " + " ".join(shlex.quote(c) for c in cmd)
             self._proc = subprocess.Popen(
                 ["ssh", "-p", str(ssh_port),
                  "-o", "StrictHostKeyChecking=no", hostname, remote],
+                stdin=subprocess.PIPE if secret_vars else None,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 start_new_session=True)
+            if secret_vars:
+                for k in secret_vars:
+                    self._proc.stdin.write((env[k] + "\n").encode())
+                self._proc.stdin.flush()
         self._pump = threading.Thread(target=self._pump_output, daemon=True)
         self._pump.start()
 

@@ -112,10 +112,17 @@ def _discover_nics(hostnames: List[str], ssh_port: int, secret: str,
     try:
         driver_ifaces = net.filter_routed(net.get_local_interfaces())
         driver_ip_guess = rendezvous.local_ip()
+        # give tasks EVERY driver interface address to try — bootstrapping
+        # registration through the same single route guess that discovery
+        # exists to replace would be circular (the reference ships all
+        # driver addresses too, `run.py:222-228`)
+        driver_addrs = list(dict.fromkeys(
+            [f"{a}:{driver.port}" for a in driver_ifaces.values()]
+            + [f"{driver_ip_guess}:{driver.port}"]))
         module = [sys.executable, "-m", "horovod_tpu.run.task_server"]
         for i, host in enumerate(hostnames):
             args = ["--index", str(i),
-                    "--driver", f"{driver_ip_guess}:{driver.port}"]
+                    "--driver", ",".join(driver_addrs)]
             if host == local_host:
                 env = dict(os.environ, HVD_SECRET=secret)
                 local_args = list(args)
@@ -207,6 +214,16 @@ def launch(np: int, command: List[str], hosts: Optional[str] = None,
     iface_env: Dict[str, str] = {}
     if nics:
         iface_env["HVD_NICS"] = ",".join(nics)
+        if multi_host:
+            # pin the launcher's own advertised address (kv/coordinator
+            # fallback) to the requested NIC too, not just the ranks'
+            from .network import get_local_interfaces
+
+            ifaces = get_local_interfaces()
+            for n in nics:
+                if n in ifaces:
+                    ip = ifaces[n]
+                    break
     elif (discover_nics if discover_nics is not None else multi_host):
         hostnames = list(dict.fromkeys(r.hostname for r in ranks))
         local_names = [h for h in hostnames if local[h]]
@@ -241,7 +258,8 @@ def launch(np: int, command: List[str], hosts: Optional[str] = None,
             out = (f"{output_filename}.{r.rank}" if output_filename else None)
             procs.append(RankProcess(r.rank, command, env,
                                      hostname=r.hostname, ssh_port=ssh_port,
-                                     output_file=out))
+                                     output_file=out,
+                                     is_local=local[r.hostname]))
         return wait_all(procs, timeout=start_timeout if start_timeout > 0
                         else None)
     finally:

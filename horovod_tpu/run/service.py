@@ -30,16 +30,20 @@ from . import network as net
 
 _LEN = struct.Struct(">I")
 _DIGEST = 32
+# Control messages are small; reject bigger frames BEFORE buffering so an
+# unauthenticated peer cannot exhaust memory (HMAC is only checkable after
+# the full frame arrives).
+_MAX_FRAME = 16 * 1024 * 1024
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
+    buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("peer closed mid-frame")
         buf += chunk
-    return buf
+    return bytes(buf)
 
 
 def _send_msg(sock: socket.socket, secret: str, msg: Any) -> None:
@@ -50,6 +54,8 @@ def _send_msg(sock: socket.socket, secret: str, msg: Any) -> None:
 
 def _recv_msg(sock: socket.socket, secret: str) -> Any:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        raise PermissionError(f"frame of {n} bytes exceeds limit")
     frame = _recv_exact(sock, n)
     digest, payload = frame[:_DIGEST], frame[_DIGEST:]
     want = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
@@ -301,7 +307,7 @@ class DriverClient:
         self._secret = secret
 
     def register(self, index: int, addresses: Dict[str, Tuple[str, int]],
-                 host_hash: str = "") -> None:
+                 host_hash: str = "", timeout: float = 10.0) -> None:
         call(self._addr, self._secret,
              {"op": "register", "index": index, "addresses": addresses,
-              "host_hash": host_hash})
+              "host_hash": host_hash}, timeout=timeout)

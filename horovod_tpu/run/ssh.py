@@ -37,7 +37,6 @@ def check_all_hosts_ssh(hosts: Iterable[str], ssh_port: int = 22,
     ``exit_on_failure`` (the CLI path) a failure prints the ssh output for
     each bad host and raises SystemExit(1), as the reference does."""
     hosts = list(dict.fromkeys(hosts))
-    cache = cache
     results: Dict[str, bool] = {}
     outputs: Dict[str, str] = {}
 

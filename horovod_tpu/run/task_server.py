@@ -24,7 +24,9 @@ import time
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--index", type=int, required=True)
-    ap.add_argument("--driver", required=True, help="driver ip:port")
+    ap.add_argument("--driver", required=True,
+                    help="driver addresses, comma-separated ip:port — each "
+                         "is tried in turn (multi-homed drivers)")
     ap.add_argument("--linger", type=float, default=300.0,
                     help="seconds to keep serving before exiting")
     ap.add_argument("--include-lo", action="store_true",
@@ -48,9 +50,19 @@ def main(argv=None) -> int:
 
     svc = TaskService(args.index, secret, include_lo=args.include_lo)
     try:
-        ip, port_s = args.driver.rsplit(":", 1)
-        DriverClient((ip, int(port_s)), secret).register(
-            args.index, svc.addresses(), host_hash())
+        last_err = None
+        for addr in args.driver.split(","):
+            ip, port_s = addr.rsplit(":", 1)
+            try:
+                DriverClient((ip, int(port_s)), secret).register(
+                    args.index, svc.addresses(), host_hash())
+                break
+            except OSError as exc:
+                last_err = exc
+        else:
+            print(f"task_server: could not reach the driver at any of "
+                  f"{args.driver}: {last_err}", file=sys.stderr)
+            return 1
         deadline = time.monotonic() + args.linger
         while time.monotonic() < deadline and not svc.shutdown_requested():
             time.sleep(0.2)

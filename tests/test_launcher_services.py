@@ -275,6 +275,91 @@ def test_task_server_secret_via_stdin():
         driver.stop()
 
 
+def test_oversized_frame_rejected_before_buffering():
+    """An unauthenticated peer claiming a huge frame is dropped, not
+    buffered (HMAC can only be checked after the full frame — so the
+    length itself must be bounded)."""
+    import struct
+
+    svc = TaskService(0, "s7", include_lo=True)
+    try:
+        with socket.create_connection(("127.0.0.1", svc.port),
+                                      timeout=5.0) as sock:
+            sock.sendall(struct.pack(">I", 0xFFFFFFFF) + b"x" * 64)
+            sock.settimeout(5.0)
+            # server drops the connection without a reply (EOF or RST —
+            # both mean rejected, never a buffered/accepted frame)
+            try:
+                assert sock.recv(4) == b""
+            except ConnectionResetError:
+                pass
+        # service still healthy for authenticated callers
+        got = call(("127.0.0.1", svc.port), "s7", {"op": "ping"})
+        assert got["ok"]
+    finally:
+        svc.stop()
+
+
+def test_task_server_tries_multiple_driver_addrs():
+    """Registration tries each driver address in turn (multi-homed
+    drivers: the route guess may be wrong; discovery must still boot)."""
+    secret = "s8"
+    driver = DriverService(1, secret)
+    with socket.socket() as dead:
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+    env = dict(os.environ, HVD_SECRET=secret,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.task_server",
+         "--index", "0",
+         "--driver", f"127.0.0.1:{dead_port},127.0.0.1:{driver.port}",
+         "--include-lo", "--linger", "60"], env=env)
+    try:
+        driver.wait_for_registration(timeout=30.0)
+        _, (ip, port) = next(iter(driver.task_addresses(0).items()))
+        TaskClient(("127.0.0.1", port), secret).shutdown()
+        assert proc.wait(timeout=15) == 0
+    finally:
+        proc.terminate()
+        driver.stop()
+
+
+def test_rank_process_remote_secret_not_on_command_line(monkeypatch):
+    """HVD_SECRET must travel over ssh stdin, never inside the remote
+    command string (visible in ps on the worker)."""
+    from horovod_tpu.run import exec_utils
+
+    captured = {}
+
+    class FakePopen:
+        def __init__(self, argv, **kw):
+            captured["argv"] = argv
+            captured["kw"] = kw
+            self.stdin = self
+            self.stdout = iter(())
+            self.written = b""
+            captured["proc"] = self
+
+        def write(self, data):
+            self.written += data
+
+        def flush(self):
+            pass
+
+    monkeypatch.setattr(exec_utils.subprocess, "Popen", FakePopen)
+    exec_utils.RankProcess(
+        0, ["python", "train.py"],
+        {"HVD_SECRET": "topsecret", "HVD_PROCESS_ID": "0"},
+        hostname="remotehost", is_local=False)
+    remote_cmd = captured["argv"][-1]
+    assert "topsecret" not in " ".join(captured["argv"])
+    assert "HVD_PROCESS_ID=0" in remote_cmd
+    assert "read -r HVD_SECRET" in remote_cmd
+    assert captured["proc"].written == b"topsecret\n"
+
+
 def test_local_ip_honors_hvd_nics(monkeypatch):
     from horovod_tpu.run import rendezvous
 
