@@ -185,6 +185,23 @@ def init(
         _state = st
 
 
+_shutdown_hooks = []
+
+
+def register_shutdown_hook(fn) -> None:
+    """Framework surfaces register per-module cleanup (e.g. the torch
+    handle-side maps) to run whenever the engine is torn down. Dedup by
+    qualified name: module reimports (tests pop sys.modules) must replace
+    their old hook, not accumulate copies that pin stale module objects."""
+    key = (getattr(fn, "__module__", None), getattr(fn, "__qualname__", None))
+    for i, existing in enumerate(_shutdown_hooks):
+        if (getattr(existing, "__module__", None),
+                getattr(existing, "__qualname__", None)) == key:
+            _shutdown_hooks[i] = fn
+            return
+    _shutdown_hooks.append(fn)
+
+
 def shutdown() -> None:
     """Stop the background engine and reset state (`operations.cc:636-640`)."""
     global _state
@@ -194,6 +211,11 @@ def shutdown() -> None:
         if _state.engine is not None:
             _state.engine.shutdown()
         _state = _GlobalState()
+    for fn in _shutdown_hooks:
+        try:
+            fn()
+        except Exception:
+            pass
 
 
 def is_initialized() -> bool:

@@ -45,7 +45,8 @@ def _commit(tensor, rank: int):
 
 
 def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
-             average=False, prescale=1.0, postscale=1.0) -> int:
+             average=False, prescale=1.0, postscale=1.0,
+             callback=None) -> int:
     eng = basics._engine()
     r = basics.rank()
     entry = TensorTableEntry(
@@ -57,6 +58,7 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
         average=average,
         prescale_factor=prescale,
         postscale_factor=postscale,
+        callback=callback,
     )
     return eng.enqueue(entry)
 
@@ -64,18 +66,22 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
 # ----------------------------------------------------------------- allreduce
 def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> int:
-    """Asynchronous allreduce; returns a handle (`torch/mpi_ops.py:207-229`)."""
+                    postscale_factor: float = 1.0, callback=None) -> int:
+    """Asynchronous allreduce; returns a handle (`torch/mpi_ops.py:207-229`).
+    ``callback(ok, result_or_error)`` fires on the engine thread at
+    completion, before ``synchronize`` unblocks (the reference's done-
+    callback contract, `mpi_ops_v2.cc:53-79`)."""
     name = _auto_name("allreduce", name)
     if op == Adasum:
         if prescale_factor != 1.0 or postscale_factor != 1.0:
             raise ValueError(
                 "prescale_factor/postscale_factor are not supported with "
                 "op=Adasum (the combine rule is scale-invariant).")
-        return _enqueue(RequestType.ADASUM, tensor, name)
+        return _enqueue(RequestType.ADASUM, tensor, name, callback=callback)
     return _enqueue(RequestType.ALLREDUCE, tensor, name,
                     average=(op == Average),
-                    prescale=prescale_factor, postscale=postscale_factor)
+                    prescale=prescale_factor, postscale=postscale_factor,
+                    callback=callback)
 
 
 def allreduce(tensor, name: Optional[str] = None, op: int = Average,
@@ -108,9 +114,11 @@ def allgather(tensor, name: Optional[str] = None):
 
 
 # ----------------------------------------------------------------- broadcast
-def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    callback=None) -> int:
     name = _auto_name("broadcast", name)
-    return _enqueue(RequestType.BROADCAST, tensor, name, root_rank=root_rank)
+    return _enqueue(RequestType.BROADCAST, tensor, name, root_rank=root_rank,
+                    callback=callback)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):

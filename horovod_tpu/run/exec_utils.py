@@ -64,6 +64,9 @@ class RankProcess:
                 for k in secret_vars:
                     self._proc.stdin.write((env[k] + "\n").encode())
                 self._proc.stdin.flush()
+                # deliver EOF: commands that drain stdin must not block on
+                # the launcher holding the pipe open
+                self._proc.stdin.close()
         self._pump = threading.Thread(target=self._pump_output, daemon=True)
         self._pump.start()
 

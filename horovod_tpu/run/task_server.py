@@ -51,17 +51,26 @@ def main(argv=None) -> int:
     svc = TaskService(args.index, secret, include_lo=args.include_lo)
     try:
         last_err = None
+        registered = False
         for addr in args.driver.split(","):
             ip, port_s = addr.rsplit(":", 1)
             try:
+                # short per-address timeout: blackholed interfaces must not
+                # eat the driver's registration window one 10s apiece
                 DriverClient((ip, int(port_s)), secret).register(
-                    args.index, svc.addresses(), host_hash())
+                    args.index, svc.addresses(), host_hash(), timeout=5.0)
+                registered = True
                 break
             except OSError as exc:
                 last_err = exc
-        else:
+        if not registered:
+            # NOTE: a secret mismatch looks identical to unreachability
+            # from here (the driver drops unauthenticated connections
+            # without replying), hence the hint
             print(f"task_server: could not reach the driver at any of "
-                  f"{args.driver}: {last_err}", file=sys.stderr)
+                  f"{args.driver}: {last_err} (check network routes AND "
+                  "that HVD_SECRET matches the launcher's)",
+                  file=sys.stderr)
             return 1
         deadline = time.monotonic() + args.linger
         while time.monotonic() < deadline and not svc.shutdown_requested():

@@ -286,11 +286,10 @@ class Engine:
         """
         self.controller.shutdown()
         for entry in self._pending.values():
+            self._fire_callback(entry, False, "shutdown")
             self.handles.mark_done(entry.handle, False,
                                    error="Horovod has been shut down.",
                                    error_cls=ShutdownError)
-            if entry.callback:
-                entry.callback(False, "shutdown")
         self._pending.clear()
         for users in self._join_waiters.values():
             for user in users:
@@ -298,6 +297,15 @@ class Engine:
                                        error="Horovod has been shut down.",
                                        error_cls=ShutdownError)
         self._join_waiters.clear()
+
+    @staticmethod
+    def _fire_callback(entry, ok: bool, payload) -> None:
+        if entry.callback:
+            try:
+                entry.callback(ok, payload)
+            except Exception as exc:
+                logger.error("completion callback for %r failed: %s",
+                             entry.tensor_name, exc)
 
     # -------------------------------------------------------------- perform
     def _perform(self, resp: Response, pairs) -> None:
@@ -315,10 +323,9 @@ class Engine:
         if resp.response_type == ResponseType.ERROR:
             for es in ebr.values():
                 for e in es:
+                    self._fire_callback(e, False, resp.error_message)
                     self.handles.mark_done(e.handle, False,
                                            error=resp.error_message)
-                    if e.callback:
-                        e.callback(False, resp.error_message)
             return
 
         for n in resp.tensor_names:
@@ -331,16 +338,17 @@ class Engine:
             for r, es in ebr.items():
                 outs = results[r]
                 for e, out in zip(es, outs):
+                    # callback BEFORE mark_done: completion callbacks (e.g.
+                    # the torch in-place copy-back) must be observable by
+                    # the time synchronize() unblocks
+                    self._fire_callback(e, True, out)
                     self.handles.mark_done(e.handle, True, result=out)
-                    if e.callback:
-                        e.callback(True, out)
         except Exception as exc:  # surface execution errors on every handle
             msg = f"{type(exc).__name__}: {exc}"
             for es in ebr.values():
                 for e in es:
+                    self._fire_callback(e, False, msg)
                     self.handles.mark_done(e.handle, False, error=msg)
-                    if e.callback:
-                        e.callback(False, msg)
         finally:
             for n in resp.tensor_names:
                 self.controller.timeline_op_end(n)

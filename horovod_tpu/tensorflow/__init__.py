@@ -121,14 +121,21 @@ def join() -> int:
     return _ops.join()
 
 
+def _var_name(v, i: int) -> str:
+    """Rank-consistent UNIQUE name for a variable's collectives: eager
+    ``tf.Variable.name`` is "Variable:0" for every unnamed variable, so the
+    position qualifies it (two unnamed variables must not collide on the
+    engine's duplicate-name check)."""
+    return f"{i}.{getattr(v, 'name', None) or 'var'}"
+
+
 def broadcast_variables(variables: List[Any], root_rank: int = 0) -> None:
     """Assign every tf.Variable its root-rank value
     (`tensorflow/__init__.py:139-171`)."""
     _require_tf()
     for i, v in enumerate(variables):
-        name = getattr(v, "name", None) or f"var.{i}"
         v.assign(broadcast(v.value() if hasattr(v, "value") else v,
-                           root_rank, name=f"bv.{name}"))
+                           root_rank, name=f"bv.{_var_name(v, i)}"))
 
 
 def _start_grad(g, name, compression, op, sparse_as_dense):
@@ -234,9 +241,9 @@ class DistributedOptimizer:
             if g is None:
                 started.append((None, v))
                 continue
-            name = getattr(v, "name", None) or f"opt.{i}"
-            started.append((_start_grad(g, f"grad.{name}", self._compression,
-                                        self._op, self._sparse_as_dense), v))
+            started.append((_start_grad(g, f"grad.{_var_name(v, i)}",
+                                        self._compression, self._op,
+                                        self._sparse_as_dense), v))
         reduced = [(None if s is None else
                     _finish_grad(*s, self._compression, self._op), v)
                    for s, v in started]
@@ -286,9 +293,9 @@ class DistributedAdasumOptimizer:
             start = self._starts[v.ref()]
             delta = v.read_value() - start.read_value()
             comp, ctx = self._compression.compress(delta)
-            name = getattr(v, "name", None) or f"var.{i}"
             started.append((v, start, ctx, comp, _ops.allreduce_async(
-                _to_numpy(comp), name=f"adasum.{name}", op=Adasum)))
+                _to_numpy(comp), name=f"adasum.{_var_name(v, i)}",
+                op=Adasum)))
         for v, start, ctx, comp, h in started:
             combined = self._compression.decompress(
                 _from_result(_ops.synchronize(h), comp), ctx)

@@ -100,10 +100,15 @@ def allreduce(tensor, average: Optional[bool] = None,
 def allreduce_async_(tensor, average: Optional[bool] = None,
                      name: Optional[str] = None,
                      op: Optional[int] = None) -> int:
-    """In-place async allreduce: result is copied back into ``tensor`` at
-    synchronize time (`torch/mpi_ops.py:170-205` inplace semantics)."""
-    h = allreduce_async(tensor, average=average, name=name, op=op)
-    _INPLACE_TARGETS[h] = tensor
+    """In-place async allreduce: the completion callback copies the result
+    into ``tensor`` before the handle unblocks (`torch/mpi_ops.py:170-205`
+    in-place semantics; copy-at-completion like `mpi_ops_v2.cc:53-79`, so
+    temporary wrappers over shared storage — ``p.data``, views — work)."""
+    op_ = _resolve_op(average, op)
+    h = _ops.allreduce_async(_to_numpy(tensor), name=name, op=op_,
+                             callback=_make_inplace_callback(tensor))
+    _HANDLE_DTYPES[h] = tensor.dtype
+    _remember_inplace(h, tensor)
     return h
 
 
@@ -135,8 +140,10 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
 
 def broadcast_async_(tensor, root_rank: int,
                      name: Optional[str] = None) -> int:
-    h = broadcast_async(tensor, root_rank, name=name)
-    _INPLACE_TARGETS[h] = tensor
+    h = _ops.broadcast_async(_to_numpy(tensor), root_rank, name=name,
+                             callback=_make_inplace_callback(tensor))
+    _HANDLE_DTYPES[h] = tensor.dtype
+    _remember_inplace(h, tensor)
     return h
 
 
@@ -150,8 +157,47 @@ def alltoall(tensor, name: Optional[str] = None):
         tensor)
 
 
+# Per-handle metadata. The in-place copy-back happens in the engine's
+# completion callback (which holds the tensor only until the collective
+# finishes, like the reference's done-callback in `mpi_ops_v2.cc:53-79`) —
+# these maps only shape synchronize()'s RETURN value, so the target entry is
+# a weak reference: a caller that drops both the handle and the tensor
+# without synchronizing must not pin the tensor forever (round-1 review:
+# these maps grew without bound). All entries clear on engine shutdown.
 _INPLACE_TARGETS: Dict[int, Any] = {}
 _HANDLE_DTYPES: Dict[int, Any] = {}
+
+
+def _reset_handle_maps() -> None:
+    _INPLACE_TARGETS.clear()
+    _HANDLE_DTYPES.clear()
+
+
+basics.register_shutdown_hook(_reset_handle_maps)
+
+
+def _remember_inplace(handle: int, tensor) -> None:
+    import weakref
+
+    try:
+        _INPLACE_TARGETS[handle] = weakref.ref(tensor)
+    except TypeError:  # tensor subclass without weakref support
+        _INPLACE_TARGETS[handle] = lambda t=tensor: t
+
+
+def _make_inplace_callback(tensor):
+    """Completion callback writing the collective result into ``tensor``.
+    The closure's strong reference lives only until the op completes, so
+    temporary wrappers over shared storage (``p.data``, views) are updated
+    correctly without pinning anything past the collective."""
+    torch = _require_torch()
+
+    def cb(ok, result):
+        if ok:
+            with torch.no_grad():
+                tensor.copy_(_result_to_torch(result, tensor.dtype))
+
+    return cb
 
 
 def poll(handle: int) -> bool:
@@ -160,15 +206,17 @@ def poll(handle: int) -> bool:
 
 def synchronize(handle: int):
     """Blocks and returns a torch tensor in the submitted tensor's dtype
-    (`torch/mpi_ops.py:476-492`); for in-place ops copies the result back into
-    the original tensor and returns it."""
-    torch = _require_torch()
-    result = _ops.synchronize(handle)
-    dtype = _HANDLE_DTYPES.pop(handle, None)
-    target = _INPLACE_TARGETS.pop(handle, None)
+    (`torch/mpi_ops.py:476-492`); for in-place ops the copy-back has already
+    happened in the completion callback — the original tensor is returned
+    (or a fresh tensor if the caller's wrapper was dropped)."""
+    try:
+        result = _ops.synchronize(handle)
+    finally:
+        # pop even when the op failed, or failed handles leak map entries
+        dtype = _HANDLE_DTYPES.pop(handle, None)
+        target_ref = _INPLACE_TARGETS.pop(handle, None)
+    target = target_ref() if target_ref is not None else None
     if target is not None:
-        with torch.no_grad():
-            target.copy_(_result_to_torch(result, target.dtype))
         return target
     return _result_to_torch(result, dtype)
 

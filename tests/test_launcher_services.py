@@ -348,6 +348,9 @@ def test_rank_process_remote_secret_not_on_command_line(monkeypatch):
         def flush(self):
             pass
 
+        def close(self):
+            captured["stdin_closed"] = True
+
     monkeypatch.setattr(exec_utils.subprocess, "Popen", FakePopen)
     exec_utils.RankProcess(
         0, ["python", "train.py"],
@@ -358,6 +361,7 @@ def test_rank_process_remote_secret_not_on_command_line(monkeypatch):
     assert "HVD_PROCESS_ID=0" in remote_cmd
     assert "read -r HVD_SECRET" in remote_cmd
     assert captured["proc"].written == b"topsecret\n"
+    assert captured.get("stdin_closed"), "stdin must be closed (EOF)"
 
 
 def test_local_ip_honors_hvd_nics(monkeypatch):

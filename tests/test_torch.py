@@ -258,3 +258,58 @@ def test_torch_broadcast_optimizer_state_preserves_params():
         return all(torch.equal(before[k], after[k]) for k in before)
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_handle_maps_do_not_pin_dropped_tensors():
+    """Round-1 review: dropping a handle without synchronize must not pin
+    the in-place target forever; shutdown clears all handle metadata."""
+    torch = pytest.importorskip("torch")
+    import gc
+    import weakref
+
+    import horovod_tpu.torch as hvd_t
+
+    def fn():
+        import time
+
+        t = torch.ones(4)
+        wr = weakref.ref(t)
+        h = hvd_t.allreduce_async_(t, name="leak_probe")
+        # the completion callback pins the tensor only until the op
+        # finishes — wait for completion (without synchronize) first
+        deadline = time.monotonic() + 30
+        while not hvd_t.poll(h) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hvd_t.poll(h)
+        del t
+        # the engine thread's _perform frame may hold the last reference
+        # for a moment after completion — retry briefly
+        while wr() is not None and time.monotonic() < deadline:
+            gc.collect()
+            time.sleep(0.01)
+        assert wr() is None, "in-place target pinned by the handle map"
+        assert h in hvd_t._INPLACE_TARGETS
+        return True
+
+    assert all(testing.run_cluster(fn, np=1))
+    hvd.shutdown()
+    assert not hvd_t._INPLACE_TARGETS and not hvd_t._HANDLE_DTYPES
+
+
+def test_inplace_through_temporary_data_wrapper():
+    """allreduce_async_(p.data): the wrapper dies immediately but the
+    shared storage must still receive the result (copy-at-completion)."""
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvd_t
+
+    def fn():
+        r = hvd.rank()
+        p = torch.nn.Parameter(torch.full((3,), float(r + 1)))
+        h = hvd_t.allreduce_async_(p.data, name="via_data")
+        out = hvd_t.synchronize(h)
+        # p itself (the surviving owner of the storage) got the result
+        assert torch.allclose(p.detach(), torch.full((3,), 1.5)), p
+        assert torch.allclose(out, torch.full((3,), 1.5))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
