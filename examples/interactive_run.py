@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Interactive `run()` demo — launch a function across ranks from a script,
+notebook, or REPL; get per-rank results back.
+
+Reference parity: `test/test_interactiverun.py` + `horovod/run/run.py`'s
+func API: the function is cloudpickled, shipped through the launcher's KV
+store, executed on every rank (each calls `hvd.init()`), and results come
+back in rank order.
+
+    python examples/interactive_run.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def train_shard(base_seed):
+    """Runs on every rank: average a rank-local estimate across the job."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    rng = np.random.RandomState(base_seed + hvd.rank())
+    # monte-carlo pi, one shard per rank
+    pts = rng.rand(200_000, 2)
+    local_pi = 4.0 * float(np.mean((pts ** 2).sum(axis=1) < 1.0))
+    global_pi = float(np.asarray(hvd.allreduce(np.float64(local_pi),
+                                               name="pi")))
+    return {"rank": hvd.rank(), "local": round(local_pi, 5),
+            "global": round(global_pi, 5)}
+
+
+def main():
+    import horovod_tpu
+
+    results = horovod_tpu.run(train_shard, args=(1234,), np=2)
+    for r in results:
+        print(f"rank {r['rank']}: local pi={r['local']}  "
+              f"global pi={r['global']}")
+    assert results[0]["global"] == results[1]["global"]
+    print("all ranks agree on the averaged estimate")
+
+
+if __name__ == "__main__":
+    main()

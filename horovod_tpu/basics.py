@@ -20,6 +20,7 @@ CROSS = one rank per host (collectives ride DCN).
 from __future__ import annotations
 
 import contextvars
+import logging
 import os
 import threading
 from dataclasses import dataclass, field
@@ -28,6 +29,8 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from .exceptions import NotInitializedError
+
+logger = logging.getLogger("horovod_tpu")
 
 # Reduce-op constants: parity with horovod/common/basics.py (Average/Sum/Adasum
 # exported from horovod.torch / horovod.tensorflow).
@@ -215,7 +218,7 @@ def shutdown() -> None:
         try:
             fn()
         except Exception:
-            pass
+            logger.exception("shutdown hook %r failed", fn)
 
 
 def is_initialized() -> bool:

@@ -171,28 +171,29 @@ class Engine:
         (DUPLICATE_NAME_ERROR `common.h:160`)."""
         user = self.handles.allocate()
         entry.handle = user
+        fail = None
         with self._lock:
             if self._shutdown:
-                self.handles.mark_done(
-                    user, False, error="Horovod has been shut down.",
-                    error_cls=ShutdownError)
-                return user
-            ch = self.controller.submit(entry)
-            if ch == self.controller.SUBMIT_DUPLICATE:
-                self.handles.mark_done(
-                    user, False,
-                    error=f"Duplicate tensor name {entry.tensor_name!r}: a "
-                          f"collective with this name from rank {entry.rank} "
-                          "is already pending.",
-                    error_cls=DuplicateNameError)
-                return user
-            if ch == self.controller.SUBMIT_SHUTDOWN:
-                self.handles.mark_done(
-                    user, False, error="Horovod has been shut down.",
-                    error_cls=ShutdownError)
-                return user
-            self._pending[ch] = entry
-            self._wake.notify_all()
+                fail = (ShutdownError, "Horovod has been shut down.")
+            else:
+                ch = self.controller.submit(entry)
+                if ch == self.controller.SUBMIT_DUPLICATE:
+                    fail = (DuplicateNameError,
+                            f"Duplicate tensor name {entry.tensor_name!r}: "
+                            f"a collective with this name from rank "
+                            f"{entry.rank} is already pending.")
+                elif ch == self.controller.SUBMIT_SHUTDOWN:
+                    fail = (ShutdownError, "Horovod has been shut down.")
+                else:
+                    self._pending[ch] = entry
+                    self._wake.notify_all()
+        if fail is not None:
+            # the completion contract covers submit-time failures too, and
+            # callbacks must never run under the engine lock (they may call
+            # back into the engine)
+            cls, msg = fail
+            self._fire_callback(entry, False, msg)
+            self.handles.mark_done(user, False, error=msg, error_cls=cls)
         return user
 
     def join(self, rank: int) -> int:
@@ -223,9 +224,11 @@ class Engine:
                     if (not self._shutdown and not self._pending
                             and not self._join_waiters):
                         self._wake.wait(timeout=self.cycle_time_s)
-                    if self._shutdown:
-                        self._drain()
-                        return
+                    drained = (self._drain_locked() if self._shutdown
+                               else None)
+                if drained is not None:
+                    self._finish_drain(*drained)
+                    return
                 tick = self.controller.tick()
                 if tick is None:
                     time.sleep(self.cycle_time_s / 5)
@@ -266,37 +269,42 @@ class Engine:
                 logger.info("engine: %s", exc)
                 with self._lock:
                     self._shutdown = True
-                    self._drain()
+                    drained = self._drain_locked()
+                self._finish_drain(*drained)
                 return
             except Exception as exc:
                 logger.error("engine thread aborting: %s", exc)
                 with self._lock:
                     self._shutdown = True
-                    self._drain()
+                    drained = self._drain_locked()
+                self._finish_drain(*drained)
                 return
 
-    def _drain(self) -> None:
-        """Fail everything outstanding with shutdown error
-        (`operations.cc:511-517`).
-
-        Drains the controller's orphans AND anything still in the local
-        pending/join maps — entries a tick already returned but that were
-        never performed (e.g. the tick after the one that raised) are not in
-        the controller's table anymore, yet their handles must not hang.
-        """
+    def _drain_locked(self):
+        """Under the engine lock: stop the controller, snapshot and clear
+        everything outstanding. Returns (entries, join_users) for
+        `_finish_drain`, which must run with the lock RELEASED — user
+        completion callbacks may call back into engine APIs."""
         self.controller.shutdown()
-        for entry in self._pending.values():
+        entries = list(self._pending.values())
+        self._pending.clear()
+        users = [u for us in self._join_waiters.values() for u in us]
+        self._join_waiters.clear()
+        return entries, users
+
+    def _finish_drain(self, entries, users) -> None:
+        """Fail everything outstanding with shutdown error
+        (`operations.cc:511-517`): entries a tick already returned but that
+        were never performed must not hang."""
+        for entry in entries:
             self._fire_callback(entry, False, "shutdown")
             self.handles.mark_done(entry.handle, False,
                                    error="Horovod has been shut down.",
                                    error_cls=ShutdownError)
-        self._pending.clear()
-        for users in self._join_waiters.values():
-            for user in users:
-                self.handles.mark_done(user, False,
-                                       error="Horovod has been shut down.",
-                                       error_cls=ShutdownError)
-        self._join_waiters.clear()
+        for user in users:
+            self.handles.mark_done(user, False,
+                                   error="Horovod has been shut down.",
+                                   error_cls=ShutdownError)
 
     @staticmethod
     def _fire_callback(entry, ok: bool, payload) -> None:
