@@ -1,0 +1,23 @@
+# Test/CI image — the reference ships Dockerfile.cpu/.gpu plus a
+# docker-compose version matrix; TPU runtimes are provisioned by the cloud
+# host, so one CPU image covers build + the virtual-device test strategy.
+#
+#   docker build -t horovod-tpu-test .
+#   docker run --rm horovod-tpu-test ci/run_tests.sh quick
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make openssh-client && \
+    rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+        "jax[cpu]" flax optax chex einops ml_dtypes numpy pytest \
+        cloudpickle tensorflow-cpu && \
+    pip install --no-cache-dir torch \
+        --index-url https://download.pytorch.org/whl/cpu
+
+WORKDIR /workspace
+COPY . .
+RUN python setup.py build_native
+
+CMD ["ci/run_tests.sh", "quick"]

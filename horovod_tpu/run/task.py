@@ -19,9 +19,12 @@ def main() -> int:
 
     client = KVStoreClient(addr, secret)
     blob = client.wait("runfunc", "fn", timeout=60.0)
-    fn, args, kwargs = pickle.loads(blob)
 
     try:
+        # unpickle inside the guard: a function that can't deserialize
+        # (e.g. __main__-defined without cloudpickle) must report its
+        # traceback, not silently "produce no result"
+        fn, args, kwargs = pickle.loads(blob)
         import horovod_tpu as hvd
 
         hvd.init()
