@@ -2,9 +2,12 @@
 (`examples/tensorflow2_synthetic_benchmark.py:35-40`, Keras/torchvision
 ResNets) plus the long-context transformer flagship."""
 
+from .inception import InceptionV3
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
                      ResNet152)
 from .transformer import TransformerLM
+from .vgg import VGG, VGG16, VGG19
 
-__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
-           "ResNet152", "TransformerLM"]
+__all__ = ["InceptionV3", "ResNet", "ResNet18", "ResNet34", "ResNet50",
+           "ResNet101", "ResNet152", "TransformerLM", "VGG", "VGG16",
+           "VGG19"]

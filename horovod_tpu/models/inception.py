@@ -1,0 +1,162 @@
+"""Inception V3 in Flax — the reference's headline scaling model.
+
+The reference's 90%-at-512-GPUs claim is measured on Inception V3
+(`README.rst:74-79`, `docs/benchmarks.rst:13-14`, via
+`tf.keras.applications` in the benchmark scripts). TPU-first like the
+ResNets: NHWC, bf16 compute / fp32 params, BatchNorm stats in fp32.
+Block structure follows the canonical tower layout (stem → 3×InceptionA →
+ReductionA → 4×InceptionB → ReductionB → 2×InceptionC), each conv a
+conv+BN+ReLU unit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b5 = conv(48, (1, 1))(x, train)
+        b5 = conv(64, (5, 5))(b5, train)
+        b3 = conv(64, (1, 1))(x, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        bp = conv(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b3 = conv(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        bd = conv(64, (1, 1))(x, train)
+        bd = conv(96, (3, 3))(bd, train)
+        bd = conv(96, (3, 3), (2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b7 = conv(c, (1, 1))(x, train)
+        b7 = conv(c, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        b77 = conv(c, (1, 1))(x, train)
+        b77 = conv(c, (7, 1))(b77, train)
+        b77 = conv(c, (1, 7))(b77, train)
+        b77 = conv(c, (7, 1))(b77, train)
+        b77 = conv(192, (1, 7))(b77, train)
+        bp = conv(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b7, b77, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b3 = conv(192, (1, 1))(x, train)
+        b3 = conv(320, (3, 3), (2, 2), padding="VALID")(b3, train)
+        b7 = conv(192, (1, 1))(x, train)
+        b7 = conv(192, (1, 7))(b7, train)
+        b7 = conv(192, (7, 1))(b7, train)
+        b7 = conv(192, (3, 3), (2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b3 = conv(384, (1, 1))(x, train)
+        b3a = conv(384, (1, 3))(b3, train)
+        b3b = conv(384, (3, 1))(b3, train)
+        bd = conv(448, (1, 1))(x, train)
+        bd = conv(384, (3, 3))(bd, train)
+        bda = conv(384, (1, 3))(bd, train)
+        bdb = conv(384, (3, 1))(bd, train)
+        bp = conv(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b3a, b3b, bda, bdb, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # towers
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = ReductionA(self.dtype)(x, train)
+        x = InceptionB(128, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(192, self.dtype)(x, train)
+        x = ReductionB(self.dtype)(x, train)
+        x = InceptionC(self.dtype)(x, train)
+        x = InceptionC(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

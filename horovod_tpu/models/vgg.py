@@ -1,0 +1,54 @@
+"""VGG in Flax — the reference's hard-scaling benchmark model.
+
+VGG-16 is the third model in the reference's scaling table (68% at 512
+GPUs, `README.rst:79` — hard because its 138M params make the gradient
+allreduce enormous relative to compute). TPU-first: NHWC, bf16 compute /
+fp32 params. Configuration "D" (VGG-16) and "E" (VGG-19) layer lists per
+the paper; classifier fc widths follow the canonical 4096-4096-classes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG = {
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), use_bias=True, dtype=self.dtype,
+                            param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for width in (4096, 4096):
+            x = nn.Dense(width, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, cfg=_CFG[16])
+VGG19 = partial(VGG, cfg=_CFG[19])
