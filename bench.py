@@ -1,19 +1,20 @@
 #!/usr/bin/env python
-"""ResNet-50 synthetic benchmark — the reference's headline measurement.
+"""Synthetic training benchmark — the reference's headline measurement.
 
-Parity: `examples/tensorflow2_synthetic_benchmark.py` (ResNet-50, synthetic
+Parity: `examples/tensorflow2_synthetic_benchmark.py` (synthetic
 ImageNet-sized data, 10 warmup iters, 10 rounds x 10 timed iters, reports
 img/sec ± 1.96σ) rebuilt on the SPMD fast path: the whole train step (forward,
 backward, gradient averaging over the replica mesh, SGD update) is one XLA
 program; batch sharded over replicas, params replicated.
 
-Prints ONE JSON line:
-  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+``BENCH_MODEL`` selects the model family (default ResNet50; the reference's
+scaling table also covers InceptionV3 and VGG16). Prints ONE JSON line:
+  {"metric": "<model>_images_per_sec_per_chip", "value": N,
    "unit": "img/s/chip", "vs_baseline": N / 103.55}
 
-Baseline denominator: the reference's published illustrative throughput
-1656.82 img/s on 16 Pascal GPUs = 103.55 img/s/GPU (`docs/benchmarks.rst:43`,
-BASELINE.md).
+``vs_baseline`` is non-null only for ResNet50, whose published denominator
+exists: 1656.82 img/s on 16 Pascal GPUs = 103.55 img/s/GPU
+(`docs/benchmarks.rst:43`, BASELINE.md).
 """
 
 import json

@@ -1,6 +1,7 @@
-"""Model zoo: the families the reference benchmarks/examples exercise
-(`examples/tensorflow2_synthetic_benchmark.py:35-40`, Keras/torchvision
-ResNets) plus the long-context transformer flagship."""
+"""Model zoo: every family the reference's benchmarks/scaling table
+exercises — ResNets (`examples/tensorflow2_synthetic_benchmark.py:35-40`),
+Inception V3 and VGG-16/19 (the 90%/90%/68% scaling-efficiency trio,
+`README.rst:74-79`) — plus the long-context transformer flagship."""
 
 from .inception import InceptionV3
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,

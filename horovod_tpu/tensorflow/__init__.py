@@ -131,11 +131,14 @@ def _var_name(v, i: int) -> str:
 
 def broadcast_variables(variables: List[Any], root_rank: int = 0) -> None:
     """Assign every tf.Variable its root-rank value
-    (`tensorflow/__init__.py:139-171`)."""
+    (`tensorflow/__init__.py:139-171`). Handles both tf.Variable
+    (``value`` is a method) and Keras 3 variables (``value`` is a
+    property)."""
     _require_tf()
     for i, v in enumerate(variables):
-        v.assign(broadcast(v.value() if hasattr(v, "value") else v,
-                           root_rank, name=f"bv.{_var_name(v, i)}"))
+        raw = getattr(v, "value", None)
+        val = raw() if callable(raw) else (v if raw is None else raw)
+        v.assign(broadcast(val, root_rank, name=f"bv.{_var_name(v, i)}"))
 
 
 def _start_grad(g, name, compression, op, sparse_as_dense):
