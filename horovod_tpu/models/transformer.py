@@ -53,10 +53,15 @@ class Block(nn.Module):
 
         h = ln(name="ln_attn")(x)
         qkv = dense(3 * d_model, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        b, t = q.shape[:2]
-        shp = (b, t, self.num_heads, head_dim)
-        out = self.attn_fn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        b, t = qkv.shape[:2]
+        # head-major column layout [h][3][hd]: a contiguous shard of the
+        # fused kernel's output dim is then WHOLE heads, so tensor
+        # parallelism (parallel/tensor.py P(None,"tp") on this kernel)
+        # yields head-parallel q/k/v with no resharding — a qkv-major
+        # split(3) would cut each tp shard across q/k/v boundaries
+        qkv = qkv.reshape(b, t, self.num_heads, 3, head_dim)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        out = self.attn_fn(q, k, v)
         out = dense(d_model, name="proj")(
             out.astype(self.dtype).reshape(b, t, d_model))
         x = x + out

@@ -25,3 +25,11 @@ from .sequence import (  # noqa: F401
     seq_to_heads,
     ulysses_attention,
 )
+from .tensor import (  # noqa: F401
+    make_dp_tp_mesh,
+    make_tp_train_step,
+    plain_attention,
+    shard_batch_dp,
+    shard_params_tp,
+    tp_param_shardings,
+)
