@@ -33,3 +33,9 @@ from .tensor import (  # noqa: F401
     shard_params_tp,
     tp_param_shardings,
 )
+from .expert import (  # noqa: F401
+    MoEMLP,
+    make_dp_ep_mesh,
+    make_ep_train_step,
+    shard_params_ep,
+)

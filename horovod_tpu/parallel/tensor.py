@@ -16,10 +16,9 @@ Pallas flash kernel is a custom call GSPMD cannot repartition.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Callable
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -29,11 +28,37 @@ from .ring_attention import reference_attention
 plain_attention = functools.partial(reference_attention, causal=True)
 
 
-def make_dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
+def make_2d_mesh(axes: tuple, sizes: tuple, devices=None) -> Mesh:
+    """(dp, X) mesh factory shared by the tp/ep variants."""
     devices = list(devices) if devices is not None else list(jax.devices())
-    if dp * tp > len(devices):
-        raise ValueError(f"dp*tp={dp * tp} exceeds {len(devices)} devices")
-    return Mesh(np.asarray(devices[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
+    total = sizes[0] * sizes[1]
+    if total > len(devices):
+        raise ValueError(
+            f"{axes[0]}*{axes[1]}={total} exceeds {len(devices)} devices")
+    return Mesh(np.asarray(devices[:total]).reshape(sizes), axes)
+
+
+def make_dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    return make_2d_mesh(("dp", "tp"), (dp, tp), devices)
+
+
+def make_sharded_train_step(loss_fn: Callable, tx, mesh: Mesh,
+                            batch_axis: str = "dp") -> Callable:
+    """Jitted train step for mesh-sharded params (tp/ep/...): params and
+    optimizer state inherit their input shardings (initialize
+    ``opt_state = tx.init(sharded_params)``); the batch is pinned to
+    ``batch_axis`` so unsharded callers are resharded rather than silently
+    running data-serial. ``loss_fn(params, batch) -> scalar``."""
+    import optax
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, in_shardings=(
+        None, None, NamedSharding(mesh, P(batch_axis))))
 
 
 def tp_param_spec(path_keys, leaf, tp_axis: str = "tp") -> P:
@@ -81,28 +106,9 @@ def shard_params_tp(params, mesh: Mesh, tp_axis: str = "tp"):
 
 def make_tp_train_step(loss_fn: Callable, tx, mesh: Mesh,
                        dp_axis: str = "dp", tp_axis: str = "tp") -> Callable:
-    """Jitted train step: params TP-sharded, batch sharded over ``dp``.
-    GSPMD inserts the row-parallel psums and the cross-dp gradient
-    reduction; output shardings propagate from the inputs, so initialize
-    ``opt_state = tx.init(sharded_params)`` — momentum then inherits the
-    parameter layout.
-
-    ``loss_fn(params, batch) -> scalar``. Returns
-    ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
-    """
-    import optax
-
-    def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    # params/opt inherit their (TP) input shardings; the batch is pinned to
-    # the dp axis so an unsharded caller is resharded rather than silently
-    # running data-serial
-    return jax.jit(step, in_shardings=(
-        None, None, NamedSharding(mesh, P(dp_axis))))
+    """TP train step: GSPMD inserts the row-parallel psums and the cross-dp
+    gradient reduction (see :func:`make_sharded_train_step`)."""
+    return make_sharded_train_step(loss_fn, tx, mesh, batch_axis=dp_axis)
 
 
 def shard_batch_dp(batch, mesh: Mesh, dp_axis: str = "dp"):
