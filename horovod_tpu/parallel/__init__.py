@@ -39,3 +39,10 @@ from .expert import (  # noqa: F401
     make_ep_train_step,
     shard_params_ep,
 )
+from .pipeline import (  # noqa: F401
+    make_pipeline_fn,
+    make_pp_mesh,
+    make_pp_train_step,
+    shard_stage_params,
+    stack_stage_params,
+)
