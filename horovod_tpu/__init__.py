@@ -82,6 +82,7 @@ from .optim.distributed import (  # noqa: F401
     grad,
 )
 from . import callbacks  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import parallel  # noqa: F401
 from . import spmd  # noqa: F401
 from .run.api import run  # noqa: F401

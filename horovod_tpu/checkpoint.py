@@ -1,0 +1,76 @@
+"""Checkpoint save/restore with the reference's rank-0 + broadcast pattern.
+
+SURVEY §5: the reference has no native checkpoint format — the supported
+pattern is "rank 0 saves via the framework; on start, state is broadcast"
+(`tensorflow/__init__.py:139-227`, `torch/__init__.py:437-585`, the
+examples' restore-then-broadcast). This module is the JAX-native version:
+flax msgpack serialization, atomic writes, rank-0-only saving, and
+restore that reads on the root and broadcasts bytes so worker hosts
+without the file (or with stale copies) still start consistent.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Optional
+
+import jax
+from flax import serialization
+
+from . import basics
+from .optim.broadcast import broadcast_from_root
+
+
+def save(path: str, state: Any, overwrite: bool = True) -> bool:
+    """Write ``state`` (any pytree) at ``path``; only rank 0 writes (the
+    reference convention — every rank holds identical state under data
+    parallelism). Returns True on the writing rank, False elsewhere.
+
+    The write is atomic (temp file + rename): a crash mid-save leaves the
+    previous checkpoint intact.
+    """
+    # overwrite guard BEFORE the rank gate: every rank must take the same
+    # raise/return path or the survivors hang in the next collective
+    if not overwrite and os.path.exists(path):
+        raise FileExistsError(f"checkpoint exists: {path}")
+    if basics.is_initialized() and basics.rank() != 0:
+        return False
+    data = serialization.to_bytes(jax.device_get(state))
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return True
+
+
+def restore(path: str, template: Any) -> Any:
+    """Load a checkpoint into the structure of ``template`` (local read —
+    use :func:`restore_and_broadcast` in multi-rank jobs)."""
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+def restore_and_broadcast(path: str, template: Any,
+                          root_rank: int = 0,
+                          name: Optional[str] = None) -> Any:
+    """Rank ``root_rank`` reads ``path``; every rank receives the state.
+
+    The restore-then-broadcast idiom of the reference examples
+    (`examples/tensorflow2_synthetic_benchmark.py:88-95`): worker hosts
+    need no filesystem access to the checkpoint, and ranks can never start
+    from different files. Root-side read errors surface on every rank.
+    """
+    payload = broadcast_from_root(
+        lambda: open(path, "rb").read(), root_rank,
+        name=name or f"ckpt.{os.path.basename(path)}")
+    return serialization.from_bytes(template, bytes(payload))

@@ -1,0 +1,62 @@
+"""Checkpoint save/restore tests — the rank-0 + broadcast pattern of the
+reference (SURVEY §5 checkpoint/resume)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, testing
+
+
+def _state(seed):
+    rng = np.random.RandomState(seed)
+    return {"params": {"w": rng.randn(3, 4).astype(np.float32),
+                       "b": rng.randn(4).astype(np.float32)},
+            "step": np.int64(7 * seed)}
+
+
+def test_roundtrip_single_process(tmp_path):
+    path = str(tmp_path / "ckpt.msgpack")
+    state = _state(1)
+    assert checkpoint.save(path, state)
+    got = checkpoint.restore(path, _state(0))
+    np.testing.assert_array_equal(got["params"]["w"], state["params"]["w"])
+    assert got["step"] == state["step"]
+
+
+def test_save_is_atomic_and_overwrite_guard(tmp_path):
+    path = str(tmp_path / "ckpt.msgpack")
+    checkpoint.save(path, _state(1))
+    with pytest.raises(FileExistsError):
+        checkpoint.save(path, _state(2), overwrite=False)
+    # no temp litter
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith(".ckpt_tmp_")] == []
+
+
+def test_only_rank0_writes_and_all_ranks_restore(tmp_path):
+    path = str(tmp_path / "ckpt.msgpack")
+    truth = _state(3)
+
+    def fn():
+        wrote = checkpoint.save(path, truth if hvd.rank() == 0
+                                else _state(99))
+        assert wrote == (hvd.rank() == 0)
+        got = checkpoint.restore_and_broadcast(path, _state(0))
+        return np.asarray(got["params"]["w"])
+
+    for w in testing.run_cluster(fn, np=2):
+        np.testing.assert_array_equal(w, truth["params"]["w"])
+
+
+def test_restore_and_broadcast_missing_file_fails_everywhere(tmp_path):
+    path = str(tmp_path / "nope.msgpack")
+
+    def fn():
+        with pytest.raises(Exception, match="nope|No such file"):
+            checkpoint.restore_and_broadcast(path, _state(0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
