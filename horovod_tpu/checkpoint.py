@@ -30,10 +30,21 @@ def save(path: str, state: Any, overwrite: bool = True) -> bool:
     The write is atomic (temp file + rename): a crash mid-save leaves the
     previous checkpoint intact.
     """
-    # overwrite guard BEFORE the rank gate: every rank must take the same
-    # raise/return path or the survivors hang in the next collective
-    if not overwrite and os.path.exists(path):
-        raise FileExistsError(f"checkpoint exists: {path}")
+    # Overwrite guard: every rank must take the same raise/return path or
+    # the survivors hang in the next collective. The file may exist only on
+    # rank 0's host (only rank 0 writes), so the verdict is rank 0's,
+    # broadcast to everyone; broadcast_from_root re-raises root-side errors
+    # symmetrically.
+    if not overwrite:
+        if basics.is_initialized() and basics.size() > 1:
+            def _guard():
+                if os.path.exists(path):
+                    raise FileExistsError(f"checkpoint exists: {path}")
+                return True
+
+            broadcast_from_root(_guard, 0, name=f"ckpt.guard.{path}")
+        elif os.path.exists(path):
+            raise FileExistsError(f"checkpoint exists: {path}")
     if basics.is_initialized() and basics.rank() != 0:
         return False
     data = serialization.to_bytes(jax.device_get(state))

@@ -24,7 +24,6 @@ maps the same surface onto that world:
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
 from .. import basics
@@ -67,20 +66,14 @@ def save_model(path: str, params, opt_state=None, extra: Optional[dict] = None):
 
     The reference pattern is rank-0 saves, everyone restores-then-broadcasts
     (SURVEY §5 checkpoint/resume); this helper is the save half. Only rank 0
-    writes; other ranks no-op.
+    writes (atomic, via :mod:`horovod_tpu.checkpoint`); other ranks no-op.
     """
-    if basics.is_initialized() and basics.rank() != 0:
-        return
-    from flax import serialization
+    from .. import checkpoint
 
-    payload = {"params": params,
-               "opt_state": opt_state if opt_state is not None else {},
-               "extra": extra or {}}
-    data = serialization.to_bytes(payload)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    checkpoint.save(path, {"params": params,
+                           "opt_state": opt_state if opt_state is not None
+                           else {},
+                           "extra": extra or {}})
 
 
 def load_model(path: str, template: Dict[str, Any], tx=None,
@@ -94,7 +87,7 @@ def load_model(path: str, template: Dict[str, Any], tx=None,
     deserialization target. Returns ``(state_dict, wrapped_tx)`` where
     ``wrapped_tx`` is ``DistributedOptimizer(tx)`` (or None if no ``tx``).
     """
-    from flax import serialization
+    from .. import checkpoint
 
     tmpl_opt = template.get("opt_state")
     # {} is the "absent" marker save_model writes; a present-but-falsy optax
@@ -104,24 +97,14 @@ def load_model(path: str, template: Dict[str, Any], tx=None,
     target = {"params": template["params"],
               "opt_state": tmpl_opt if has_opt else {},
               "extra": template.get("extra") or {}}
-    multi = broadcast and basics.is_initialized() and basics.size() > 1
-    if multi:
+    if broadcast and basics.is_initialized() and basics.size() > 1:
         # only rank 0 is guaranteed to see the file (save_model writes on
         # rank 0 only; on a multi-host pod the path may be host-local) —
-        # root reads, the bytes ride the broadcast wire; a rank-0 read
-        # failure re-raises symmetrically on EVERY rank (peers must not hang
-        # waiting for a broadcast that never comes)
-        from ..optim.broadcast import broadcast_from_root
-
-        def _read():
-            with open(path, "rb") as f:
-                return f.read()
-
-        data = broadcast_from_root(_read, 0, name="load_model.bytes")
+        # root reads, the bytes ride the broadcast wire
+        state = checkpoint.restore_and_broadcast(path, target,
+                                                 name="load_model.bytes")
     else:
-        with open(path, "rb") as f:
-            data = f.read()
-    state = serialization.from_bytes(target, data)
+        state = checkpoint.restore(path, target)
     wrapped = DistributedOptimizer(tx, compression=compression) \
         if tx is not None else None
     return state, wrapped
