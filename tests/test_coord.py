@@ -340,3 +340,32 @@ def test_mp_fused_allreduce_with_cache_hits():
         for outs in rounds:
             assert outs == [2 * i + 1.0 for i in range(4)]
         assert hits > 0, "steady-state should hit the response cache"
+
+
+class TestCacheCapacity:
+    def test_saturated_cache_stays_correct(self):
+        """Reference test technique: loop more names than cache capacity
+        (`test/test_tensorflow.py` cache stress). Saturation must disable
+        caching for the overflow names, never corrupt negotiation."""
+        st = make_state(cache_capacity=2)
+        cids_by_name = {}
+        for round_ in range(2):
+            for i in range(5):
+                name = f"t{i}"
+                _, _, resps, cids, _ = negotiate(
+                    st, {0: (0, [], [meta(name)]),
+                         1: (0, [], [meta(name)])})
+                assert resps[0].tensor_names == [name]
+                cids_by_name.setdefault(name, []).append(cids[0])
+        # first two names got cache ids; overflow names get the -1
+        # "not cacheable" sentinel (clients only adopt ids >= 0)
+        assert cids_by_name["t0"] == [[0], [0]]
+        assert cids_by_name["t1"] == [[1], [1]]
+        for n in ("t2", "t3", "t4"):
+            assert cids_by_name[n] == [[-1], [-1]], (n, cids_by_name[n])
+        # cached names still serve the fast path after saturation
+        _, _, resps, cids, _ = negotiate(st, {0: (0, [0], []),
+                                              1: (0, [0], [])})
+        assert resps[0].tensor_names == ["t0"]
+        hits, misses = st.cache_stats()
+        assert hits == 2 and misses == 20

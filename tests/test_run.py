@@ -9,6 +9,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
@@ -171,3 +172,39 @@ def test_hvdrun_cli_smoke(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "OUT 2.0" in r.stdout
     assert "[0]<stdout>" in r.stdout and "[1]<stdout>" in r.stdout
+
+
+@pytest.mark.integration
+def test_rank_death_kills_job_not_hangs(tmp_path):
+    """A rank dying mid-stream must terminate the whole job with a nonzero
+    exit (first-failure kill, `gloo_run.py:253-259`) — the survivor, stuck
+    in negotiation with a dead peer, must NOT hang past the kill."""
+    script = tmp_path / "dying.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        sys.path.insert(0, %r)
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.allreduce(np.ones(2), name="ok")      # both ranks complete one
+        if hvd.rank() == 1:
+            os._exit(3)                           # die mid-job, no goodbye
+        hvd.allreduce(np.ones(2), name="never")   # peer is dead: would hang
+        print("SURVIVOR FINISHED")                # must not be reached
+    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "hvdrun"), "-np", "2",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert r.returncode != 0
+    assert "SURVIVOR FINISHED" not in r.stdout
+    assert time.monotonic() - t0 < 150  # killed, not timed out
