@@ -369,3 +369,41 @@ class TestCacheCapacity:
         assert resps[0].tensor_names == ["t0"]
         hits, misses = st.cache_stats()
         assert hits == 2 and misses == 20
+
+
+def _worker_op_matrix():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    r = hvd.rank()
+    out = {}
+    b = hvd.broadcast(np.full((3,), float(r * 5 + 2), np.float32), 1,
+                      name="mp_bc")
+    out["bcast"] = [float(v) for v in np.asarray(b)]
+    # alltoall: rank r sends [r*10+0, r*10+1]; receives column r
+    a = hvd.alltoall(np.asarray([r * 10.0, r * 10.0 + 1.0], np.float32),
+                     name="mp_a2a")
+    out["alltoall"] = [float(v) for v in np.asarray(a)]
+    ad = hvd.allreduce(np.full((4,), 1.0 + r, np.float32), name="mp_adasum",
+                       op=hvd.Adasum)
+    out["adasum"] = [float(v) for v in np.asarray(ad)]
+    return (r, out)
+
+
+@pytest.mark.integration
+def test_mp_alltoall_broadcast_adasum():
+    """The remaining op matrix as a REAL 2-process job: broadcast from a
+    non-zero root, alltoall exchange, and the Adasum combine — all through
+    the cross-process control plane."""
+    from tests_adasum_ref import numpy_adasum
+
+    results = dict(_run2(_worker_op_matrix))
+    for r in (0, 1):
+        got = results[r]
+        np.testing.assert_allclose(got["bcast"], [7.0] * 3)  # root 1's value
+        np.testing.assert_allclose(got["alltoall"], [r, 10.0 + r])
+    want = numpy_adasum([np.full((4,), 1.0, np.float32),
+                         np.full((4,), 2.0, np.float32)])
+    for r in (0, 1):
+        np.testing.assert_allclose(results[r]["adasum"], want, rtol=1e-5)

@@ -142,33 +142,43 @@ def test_run_func_two_processes():
     assert results[1][2] == [3.0, 3.0, 3.0, 3.0]
 
 
-@pytest.mark.integration
-def test_hvdrun_cli_smoke(tmp_path):
-    """hvdrun CLI end-to-end on 2 local ranks."""
+_WORKER_PREAMBLE = """
+    import os, sys
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %r)
+    import horovod_tpu as hvd
+    hvd.init()
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_hvdrun(tmp_path, body, np_ranks=2):
+    """Launch a 2-rank hvdrun job whose per-rank script is the shared CPU
+    preamble + ``body``; returns the CompletedProcess."""
     script = tmp_path / "job.py"
-    script.write_text(textwrap.dedent("""
-        import os
-        os.environ["PALLAS_AXON_POOL_IPS"] = ""
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        import numpy as np
-        import sys
-        sys.path.insert(0, %r)
-        import horovod_tpu as hvd
-        hvd.init()
-        out = hvd.allreduce(np.ones((2,), np.float32), name="cli",
-                            op=hvd.Sum)
-        print("RANK", hvd.rank(), "OUT", float(np.asarray(out)[0]))
-    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    script.write_text(textwrap.dedent(_WORKER_PREAMBLE)
+                      + textwrap.dedent(body))
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = repo
-    r = subprocess.run(
-        [sys.executable, os.path.join(repo, "bin", "hvdrun"), "-np", "2",
-         "--", sys.executable, str(script)],
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "hvdrun"),
+         "-np", str(np_ranks), "--", sys.executable, str(script)],
         capture_output=True, text=True, timeout=180, env=env)
+
+
+@pytest.mark.integration
+def test_hvdrun_cli_smoke(tmp_path):
+    """hvdrun CLI end-to-end on 2 local ranks."""
+    r = _run_hvdrun(tmp_path, """
+        out = hvd.allreduce(np.ones((2,), np.float32), name="cli",
+                            op=hvd.Sum)
+        print("RANK", hvd.rank(), "OUT", float(np.asarray(out)[0]))
+    """)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "OUT 2.0" in r.stdout
     assert "[0]<stdout>" in r.stdout and "[1]<stdout>" in r.stdout
@@ -179,32 +189,14 @@ def test_rank_death_kills_job_not_hangs(tmp_path):
     """A rank dying mid-stream must terminate the whole job with a nonzero
     exit (first-failure kill, `gloo_run.py:253-259`) — the survivor, stuck
     in negotiation with a dead peer, must NOT hang past the kill."""
-    script = tmp_path / "dying.py"
-    script.write_text(textwrap.dedent("""
-        import os, sys
-        os.environ["PALLAS_AXON_POOL_IPS"] = ""
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        import numpy as np
-        sys.path.insert(0, %r)
-        import horovod_tpu as hvd
-        hvd.init()
+    t0 = time.monotonic()
+    r = _run_hvdrun(tmp_path, """
         hvd.allreduce(np.ones(2), name="ok")      # both ranks complete one
         if hvd.rank() == 1:
             os._exit(3)                           # die mid-job, no goodbye
         hvd.allreduce(np.ones(2), name="never")   # peer is dead: would hang
         print("SURVIVOR FINISHED")                # must not be reached
-    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = repo
-    t0 = time.monotonic()
-    r = subprocess.run(
-        [sys.executable, os.path.join(repo, "bin", "hvdrun"), "-np", "2",
-         "--", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=180, env=env)
+    """)
     assert r.returncode != 0
     assert "SURVIVOR FINISHED" not in r.stdout
     assert time.monotonic() - t0 < 150  # killed, not timed out
