@@ -13,7 +13,7 @@ import hashlib
 import os
 import socket
 import struct
-from typing import Dict, Iterable, Set, Tuple
+from typing import Dict, Set, Tuple
 
 
 def get_local_interfaces() -> Dict[str, str]:
