@@ -175,6 +175,22 @@ def _finish_grad(kind, handles, meta, compression, op):
     return compression.decompress(out, ctx)
 
 
+def _reduce_grads_and_vars(grads_and_vars, compression, op,
+                           sparse_as_dense):
+    """Allreduce every gradient in a (grad, var) list — all collectives in
+    flight before any drain (the hook-overlap pattern). Shared by the
+    plain wrapper and the keras-subclass optimizer."""
+    started = []
+    for i, (g, v) in enumerate(grads_and_vars):
+        if g is None:
+            started.append((None, v))
+            continue
+        started.append((_start_grad(g, f"grad.{_var_name(v, i)}",
+                                    compression, op, sparse_as_dense), v))
+    return [(None if s is None else _finish_grad(*s, compression, op), v)
+            for s, v in started]
+
+
 class DistributedGradientTape:
     """Wraps ``tf.GradientTape`` so ``gradient()`` returns rank-averaged
     gradients (`tensorflow/__init__.py:473-530`); IndexedSlices gradients
@@ -238,18 +254,9 @@ class DistributedOptimizer:
         self._sparse_as_dense = sparse_as_dense
 
     def apply_gradients(self, grads_and_vars, **kwargs):
-        grads_and_vars = list(grads_and_vars)
-        started = []
-        for i, (g, v) in enumerate(grads_and_vars):
-            if g is None:
-                started.append((None, v))
-                continue
-            started.append((_start_grad(g, f"grad.{_var_name(v, i)}",
-                                        self._compression, self._op,
-                                        self._sparse_as_dense), v))
-        reduced = [(None if s is None else
-                    _finish_grad(*s, self._compression, self._op), v)
-                   for s, v in started]
+        reduced = _reduce_grads_and_vars(
+            list(grads_and_vars), self._compression, self._op,
+            self._sparse_as_dense)
         return self._opt.apply_gradients(reduced, **kwargs)
 
     def __getattr__(self, item):

@@ -1,0 +1,80 @@
+"""``horovod_tpu.tensorflow.keras`` — `horovod/tensorflow/keras` parity.
+
+Re-exports the eager TF surface (DistributedOptimizer, collectives,
+basics) plus the tf.keras ``model.fit`` callbacks, so a reference script's
+
+    import horovod.tensorflow.keras as hvd
+
+port is an import-line change.
+"""
+
+from .. import (  # noqa: F401
+    Adasum,
+    Average,
+    Compression,
+    DistributedAdasumOptimizer,
+    Sum,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_variables,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from .. import _reduce_grads_and_vars
+from . import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         op: int = Average, sparse_as_dense: bool = False):
+    """Keras-compatible distributed optimizer: a dynamic SUBCLASS of the
+    wrapped optimizer's class (the reference's `_keras/__init__.py:20-33`
+    technique), so ``model.compile(optimizer=...)`` accepts it and
+    ``model.fit`` routes every update through the gradient allreduce.
+
+    Gradient reduction happens in ``apply`` (Keras 3's single funnel —
+    ``apply_gradients`` delegates to it), so both direct calls and the
+    fit() train step are covered.
+    """
+    if op == Adasum:
+        raise NotImplementedError(
+            "op=Adasum inside model.compile is not supported; use the "
+            "eager DistributedAdasumOptimizer with a manual train loop")
+    base_cls = optimizer.__class__
+    if not hasattr(base_cls, "apply"):
+        # Keras 2 optimizers have no apply() funnel — the override below
+        # would be dead code and training would silently run unsynchronized
+        raise RuntimeError(
+            "DistributedOptimizer for model.compile requires Keras 3 "
+            "(tf >= 2.16); on older TF use horovod_tpu.tensorflow."
+            "DistributedOptimizer with a manual train loop")
+    hvd_kw = dict(compression=compression, op=op,
+                  sparse_as_dense=sparse_as_dense)
+
+    class _Distributed(base_cls):
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            # cover BOTH call shapes: explicit variables and the stored-
+            # variables form (opt.apply(grads)) — skipping reduction for
+            # the latter would silently diverge the replicas
+            tvars = trainable_variables
+            if tvars is None:
+                tvars = list(getattr(self, "_trainable_variables", None)
+                             or [])
+                if not tvars:
+                    raise RuntimeError(
+                        "optimizer.apply(grads) before build(): no "
+                        "variables to reduce against")
+            reduced = _reduce_grads_and_vars(
+                list(zip(grads, tvars)), **hvd_kw)
+            grads2 = [g for g, _ in reduced]
+            if trainable_variables is None:
+                return super().apply(grads2, **kwargs)
+            return super().apply(grads2, trainable_variables, **kwargs)
+
+    _Distributed.__name__ = "Distributed" + base_cls.__name__
+    return _Distributed.from_config(optimizer.get_config())

@@ -1,0 +1,164 @@
+"""tf.keras callbacks for ``model.fit`` — `horovod/tensorflow/keras/
+callbacks.py` parity on the eager TF surface.
+
+The flax-side training-loop callbacks live in ``horovod_tpu.callbacks``;
+these subclasses adapt the same behaviors to the Keras callback protocol so
+a reference ``model.fit(callbacks=[hvd.callbacks.* ...])`` script ports
+directly.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Optional
+
+import numpy as np
+
+from .. import (Average, _require_tf, allreduce, broadcast_variables, rank,
+                size)
+
+try:
+    import tensorflow as _tf
+
+    _Base = _tf.keras.callbacks.Callback
+except ImportError:  # keep the parent package's import-without-TF promise
+    _Base = object
+
+
+class BroadcastGlobalVariablesCallback(_Base):
+    """Broadcast model + optimizer variables from ``root_rank`` after the
+    first batch (so optimizer slot variables exist,
+    `_keras/callbacks.py:20-43`)."""
+
+    def __init__(self, root_rank: int = 0):
+        _require_tf()
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self._done:
+            return
+        broadcast_variables(self.model.variables, root_rank=self.root_rank)
+        opt_vars = getattr(self.model.optimizer, "variables", None)
+        if opt_vars is not None:
+            opt_vars = opt_vars() if callable(opt_vars) else opt_vars
+            broadcast_variables(list(opt_vars), root_rank=self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(_Base):
+    """Average epoch metrics over ranks before they reach other callbacks
+    (checkpointers, early stopping — `_keras/callbacks.py:46-84`)."""
+
+    def __init__(self):
+        _require_tf()
+        super().__init__()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs and size() > 1:
+            for k, v in list(logs.items()):
+                # numbers.Real covers python floats AND numpy scalars
+                # (np.float32 is not an int/float subclass)
+                if isinstance(v, numbers.Real):
+                    logs[k] = float(allreduce(np.float64(v),
+                                              name=f"metric.{k}",
+                                              op=Average))
+
+
+class LearningRateScheduleCallback(_Base):
+    """Multiply the optimizer LR by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (`_keras/callbacks.py:87-134`). With
+    ``staircase=False`` the multiplier sees fractional epochs computed from
+    Keras ``params['steps']``."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 initial_lr: Optional[float] = None):
+        _require_tf()
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.initial_lr = initial_lr
+        self._mult = multiplier if callable(multiplier) \
+            else (lambda epoch: multiplier)
+        self._current_epoch = 0
+
+    def _in_range(self, epoch):
+        return (epoch >= self.start_epoch
+                and (self.end_epoch is None or epoch < self.end_epoch))
+
+    def _lr_var(self):
+        opt = self.model.optimizer
+        var = getattr(opt, "learning_rate", None)
+        return opt.lr if var is None else var
+
+    def _set_lr(self, value):
+        import tensorflow as tf
+
+        var = self._lr_var()
+        if isinstance(var, tf.Variable):
+            var.assign(value)
+        else:  # plain attribute / Keras 3 property
+            self.model.optimizer.learning_rate = value
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            var = self._lr_var()
+            try:
+                self.initial_lr = float(var)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "the optimizer's learning_rate is a schedule object "
+                    f"({type(var).__name__}); LR schedule callbacks need a "
+                    "scalar learning rate — pass the base value directly "
+                    "to the optimizer") from None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._current_epoch = epoch
+        if self._in_range(epoch):
+            # epoch-granularity set for BOTH modes: when Keras doesn't
+            # report params['steps'] (unknown-cardinality datasets) a
+            # smooth schedule must still move per epoch, not silently
+            # hold the base LR
+            self._set_lr(self.initial_lr * self._mult(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range(self._current_epoch):
+            return
+        steps = (self.params or {}).get("steps")
+        if not steps:
+            return  # epoch granularity (set at epoch begin) until known
+        frac = self._current_epoch + min(1.0, (batch + 1) / float(steps))
+        self._set_lr(self.initial_lr * self._mult(frac))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(self._lr_var())
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr to lr*size over ``warmup_epochs``
+    (`_keras/callbacks.py:137-185`): multiplier ramps 1/size → 1 applied on
+    top of the size-scaled base LR."""
+
+    def __init__(self, warmup_epochs: int = 5, verbose: bool = False,
+                 initial_lr: Optional[float] = None):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            n = size()
+            return 1.0 / n + epoch * (1.0 - 1.0 / n) / max(warmup_epochs, 1)
+
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         initial_lr=initial_lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if (epoch == self.warmup_epochs - 1 and self.verbose
+                and rank() == 0):
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {float(self._lr_var()):.6g}.")

@@ -1,0 +1,73 @@
+"""tf.keras callback tests through real ``model.fit`` runs (parity model:
+`test/test_tensorflow_keras.py` + `_keras/callbacks.py` behaviors)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.tensorflow.keras as hvd_tfk  # noqa: E402
+from horovod_tpu import testing  # noqa: E402
+
+
+def _model(lr=0.1):
+    m = tf.keras.Sequential([tf.keras.layers.Dense(3, input_shape=(4,)),
+                             tf.keras.layers.Dense(1)])
+    opt = hvd_tfk.DistributedOptimizer(tf.keras.optimizers.SGD(lr))
+    m.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    return m
+
+
+def _data(seed, n=32):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, 4).astype(np.float32), \
+        rng.randn(n, 1).astype(np.float32)
+
+
+def test_broadcast_callback_syncs_initial_weights():
+    def fn():
+        r = hvd.rank()
+        tf.keras.utils.set_random_seed(100 + r)  # deliberately diverged
+        m = _model()
+        x, y = _data(0)
+        m.fit(x, y, epochs=1, batch_size=16, verbose=0,
+              callbacks=[hvd_tfk.callbacks.BroadcastGlobalVariablesCallback(0)])
+        return [w.tolist() for w in m.get_weights()]
+
+    outs = testing.run_cluster(fn, np=2)
+    for a, b in zip(outs[0], outs[1]):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_metric_average_callback():
+    def fn():
+        m = _model()
+        x, y = _data(hvd.rank())  # different data -> different local loss
+        hist = m.fit(x, y, epochs=1, batch_size=16, verbose=0,
+                     callbacks=[hvd_tfk.callbacks.MetricAverageCallback()])
+        return float(hist.history["loss"][0])
+
+    outs = testing.run_cluster(fn, np=2)
+    assert abs(outs[0] - outs[1]) < 1e-6  # averaged metric identical
+
+
+def test_warmup_then_schedule_moves_lr():
+    def fn():
+        m = _model(lr=0.08)
+        x, y = _data(1)
+        warm = hvd_tfk.callbacks.LearningRateWarmupCallback(warmup_epochs=2)
+        sched = hvd_tfk.callbacks.LearningRateScheduleCallback(
+            lambda e: 0.1 ** (e // 2), start_epoch=2, staircase=True,
+            initial_lr=0.08)
+        hist = m.fit(x, y, epochs=4, batch_size=16, verbose=0,
+                     callbacks=[warm, sched])
+        return hist.history["lr"]
+
+    for lrs in testing.run_cluster(fn, np=2):
+        # warmup ends at the base LR, then the staircase decays it
+        assert lrs[1] == pytest.approx(0.08, rel=1e-5)
+        assert lrs[2] == pytest.approx(0.08 * 0.1, rel=1e-5)
+        assert lrs[3] == pytest.approx(0.08 * 0.1, rel=1e-5)
+        # warmup epoch 0 starts below the base LR (ramps from lr/size)
+        assert lrs[0] < 0.08
