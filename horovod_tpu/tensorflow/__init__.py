@@ -9,11 +9,13 @@ framework, `__init__.py:117`), ``allgather``, ``broadcast``,
 
 TensorFlow is NOT part of the TPU image — JAX is the native surface
 (`horovod_tpu.spmd` / `horovod_tpu.optim`). This module exists for users
-porting TF2 eager scripts: it requires an environment with tensorflow
-installed and routes TF eager tensors through the shared engine (numpy at
-the boundary, like the reference's `TFTensor` adapter in role,
-`tensorflow/mpi_ops.cc:78-250`). Graph-mode/tf.function custom ops are out
-of scope — XLA-jitted training belongs on the JAX path.
+porting TF2 scripts: it requires an environment with tensorflow installed
+and routes TF tensors through the shared engine (numpy at the boundary,
+like the reference's `TFTensor` adapter in role,
+`tensorflow/mpi_ops.cc:78-250`). Inside ``tf.function`` the same calls
+lower to the graph-mode path (`graph.py`) — py_function engine nodes with
+the reference's registered gradients — so compiled train steps and
+``model.fit`` without ``run_eagerly`` work too.
 """
 
 from __future__ import annotations
@@ -88,6 +90,10 @@ def allreduce(tensor, average: Optional[bool] = None,
     op_ = Average if op is None and average is None else (
         (Average if average else Sum) if average is not None else op)
     t = _require_tf()
+    if not t.executing_eagerly():
+        from . import graph as _graph
+        return _graph.allreduce(tensor, name=name, op=op_,
+                                compression=compression)
     if isinstance(tensor, t.IndexedSlices):
         if op_ == Adasum:
             raise NotImplementedError(
@@ -106,15 +112,36 @@ def allreduce(tensor, average: Optional[bool] = None,
 
 
 def allgather(tensor, name: Optional[str] = None):
+    t = _require_tf()
+    if not t.executing_eagerly():
+        from . import graph as _graph
+        return _graph.allgather(tensor, name=name)
     return _from_result(
         _ops.synchronize(_ops.allgather_async(_to_numpy(tensor), name=name)),
         tensor)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    t = _require_tf()
+    if not t.executing_eagerly():
+        from . import graph as _graph
+        return _graph.broadcast(tensor, root_rank, name=name)
     return _from_result(
         _ops.synchronize(_ops.broadcast_async(_to_numpy(tensor), root_rank,
                                               name=name)), tensor)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    """Equal-split alltoall (engine extension beyond the 0.18.2 op set —
+    the reference gained tf alltoall in 0.20): dim 0 divisible by world
+    size; rank r receives segment r from every rank."""
+    t = _require_tf()
+    if not t.executing_eagerly():
+        from . import graph as _graph
+        return _graph.alltoall(tensor, name=name)
+    return _from_result(
+        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor), name=name)),
+        tensor)
 
 
 def join() -> int:
@@ -146,6 +173,14 @@ def _start_grad(g, name, compression, op, sparse_as_dense):
     meta). IndexedSlices take the two-allgather path unless sparse_as_dense
     (`_keras/__init__.py:50-53` densify; `tensorflow/__init__.py:83-91`)."""
     t = _require_tf()
+    if not t.executing_eagerly():
+        # graph mode: the engine nodes are dataflow ops, so TF schedules all
+        # starts before blocking syncs itself — no two-phase bookkeeping
+        from . import graph as _graph
+        if isinstance(g, t.IndexedSlices) and sparse_as_dense:
+            g = t.convert_to_tensor(g)
+        return "graph", None, _graph.allreduce(g, name=name, op=op,
+                                               compression=compression)
     if isinstance(g, t.IndexedSlices):
         if sparse_as_dense:
             g = t.convert_to_tensor(g)
@@ -162,6 +197,8 @@ def _start_grad(g, name, compression, op, sparse_as_dense):
 
 def _finish_grad(kind, handles, meta, compression, op):
     t = _require_tf()
+    if kind == "graph":
+        return meta
     if kind == "sparse":
         g = meta
         values = _from_result(_ops.synchronize(handles[0]), g.values)
@@ -283,6 +320,12 @@ class DistributedAdasumOptimizer:
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         t = _require_tf()
+        if not t.executing_eagerly():
+            raise NotImplementedError(
+                "DistributedAdasumOptimizer keeps Python-side delta "
+                "snapshots and cannot run inside tf.function; use an eager "
+                "train loop (the reference's delta optimizer is likewise a "
+                "stateful graph construct, tensorflow/__init__.py:313-407)")
         # Keep the FULL variable list for communication: submission must not
         # depend on rank-local gradient presence (a var whose grad is None on
         # this rank still contributes its — zero — delta), or ranks diverge

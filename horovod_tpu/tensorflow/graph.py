@@ -1,0 +1,240 @@
+"""Graph-mode (``tf.function``) collectives on the shared engine.
+
+Reference parity: the graph path of `horovod/tensorflow/mpi_ops.cc` — the
+`HorovodAllreduceOp` / `HorovodAllgatherOp` / `HorovodBroadcastOp`
+AsyncOpKernels (:286-484) — plus the gradient registrations in
+`horovod/tensorflow/mpi_ops.py:107-198`.
+
+Design (TPU-native): instead of custom C++ kernels compiled against TF's ABI,
+each collective lowers to a pair of ``tf.py_function`` nodes driving the
+shared background engine — a *start* node that enqueues the named tensor and
+returns the async handle, and a *sync* node that blocks on the handle and
+yields the negotiated result. This keeps the reference's async overlap (all
+starts can execute before any sync completes; TF dataflow schedules them the
+way the AsyncOpKernel enqueues interleave) and the engine-side semantics:
+negotiation, fusion, response cache, stall detection and timeline spans all
+apply to graph ops exactly as to eager ones.
+
+Cross-rank submission order: start nodes carry a control-dependency chain in
+trace order. Two data-independent py_function nodes may otherwise execute in
+any order, and ranks must submit tensors in a consistent order for
+program-order negotiation (the reference gets this from its single tensor
+queue; the coordinated controller doesn't need it, but the chain makes the
+uncoordinated SPMD mode safe too). Sync nodes are NOT chained — each depends
+only on its own start, so collectives still overlap and fuse.
+
+Gradients (`tensorflow/mpi_ops.py`):
+  allreduce  → allreduce of the upstream gradient (:107-118)
+  allgather  → sum-allreduce, then slice this rank's segment using gathered
+               dim0 sizes (:140-163)
+  broadcast  → sum-allreduce, zeroed on non-root ranks (:183-198)
+  alltoall   → alltoall of the upstream gradient (engine extension; the
+               equal-split exchange is its own adjoint)
+
+Rank binding: the engine rank is resolved at TRACE time and re-bound inside
+each py_function body — bodies run on TF executor threads, not the thread
+that called the function, so the in-process cluster rig's thread-local rank
+would otherwise be lost. One-rank-per-process deployments are unaffected; the
+in-process rig must trace per-rank ``tf.function`` objects (define the
+function inside the per-rank body, as the tests do).
+
+Thread-pool sizing: sync nodes BLOCK a TF inter-op thread until the
+collective completes. Per process this cannot deadlock — by the time any
+sync runs, its start (and, via the chain, every earlier start) has executed,
+so the tensor is already submitted on every rank and will complete. But the
+in-process cluster rig shares ONE TF runtime between ranks: rank A's blocked
+syncs can starve rank B's starts if the inter-op pool is too small (e.g. a
+single-core box defaults to 1 thread). The test conftest sets
+``TF_NUM_INTEROP_THREADS`` accordingly; real deployments (one rank per
+process) need nothing.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import tensorflow as tf
+
+from .. import basics
+from ..basics import Adasum, Average, Sum
+from ..ops import collective_ops as _ops
+from .compression import Compression
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_.]", "_", name)
+
+
+def _graph_name(prefix: str, tensor) -> str:
+    """Engine name for an unnamed graph collective: the symbolic tensor name
+    (deterministic given the same program, like the reference's
+    `tensorflow/mpi_ops.py:102-103`) plus a per-graph trace-order counter —
+    two unnamed collectives on the SAME tensor in one step must not collide
+    on the engine's in-flight duplicate-name check."""
+    g = tf.compat.v1.get_default_graph()
+    n = getattr(g, "_hvd_tpu_name_counter", 0)
+    g._hvd_tpu_name_counter = n + 1
+    try:
+        tn = tensor.name
+    except Exception:
+        tn = None
+    base = f"{prefix}.{_sanitize(tn)}" if tn else f"{prefix}.graph"
+    return f"{base}.{n}"
+
+
+def _start(py_start, tensor):
+    """Engine-start node: ``py_start(np_array) -> handle``. Ordered after the
+    previous start in this graph via a control dependency (trace order =
+    submission order on every rank)."""
+    r = basics.rank()
+
+    def body(x):
+        basics.set_thread_rank(r)
+        return np.int64(py_start(x.numpy()))
+
+    g = tf.compat.v1.get_default_graph()
+    prev = getattr(g, "_hvd_tpu_last_start", None)
+    with tf.control_dependencies([prev] if prev is not None else []):
+        h = tf.py_function(body, [tensor], Tout=tf.int64)
+    g._hvd_tpu_last_start = h
+    return h
+
+
+def _sync(handle, dtype, shape):
+    """Engine-sync node: blocks on the handle, yields the result. Raises
+    HorovodInternalError through the py_function on negotiation/execution
+    failure (surfaced by TF as an op error, like the AsyncOpKernel's
+    non-OK done status)."""
+    r = basics.rank()
+
+    def body(h):
+        basics.set_thread_rank(r)
+        return np.asarray(_ops.synchronize(int(h.numpy())))
+
+    out = tf.py_function(body, [handle], Tout=dtype)
+    out.set_shape(shape)
+    return out
+
+
+def _allreduce_raw(tensor, name, op=Sum, prescale=1.0, postscale=1.0):
+    """Raw engine allreduce node (no in-framework division — Average division
+    happens in the public wrapper, `tensorflow/__init__.py:117`)."""
+
+    @tf.custom_gradient
+    def fwd(x):
+        h = _start(lambda a: _ops.allreduce_async(
+            a, name=name, op=op, prescale_factor=prescale,
+            postscale_factor=postscale), x)
+        y = _sync(h, x.dtype, x.shape)
+
+        def grad(dy):
+            # adjoint of y = post*reduce(pre*x) is the same scaled reduction
+            # of dy (scalars commute into the sum); Adasum keeps the
+            # reference's registered sum-allreduce gradient
+            return _allreduce_raw(dy, f"{name}.grad",
+                                  op=op if op in (Sum, Average) else Sum,
+                                  prescale=prescale, postscale=postscale)
+
+        return y, grad
+
+    return fwd(tensor)
+
+
+def _divide_by_size(t):
+    """Average division matching the engine's eager kernel: floor-division
+    for integer dtypes (`runtime/executor.py` integer Average), true
+    division otherwise — graph and eager must return the same dtype."""
+    div = tf.cast(basics.size(), t.dtype)
+    return t // div if t.dtype.is_integer else t / div
+
+
+def allreduce(tensor, name=None, op=Average, compression=Compression.none,
+              prescale_factor=1.0, postscale_factor=1.0):
+    """Graph-mode allreduce; IndexedSlices take the two-allgather sparse path
+    (`tensorflow/__init__.py:75-91`)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            raise NotImplementedError(
+                "The Adasum reduction does not currently support sparse "
+                "tensors. As a workaround please pass sparse_as_dense=True "
+                "to DistributedOptimizer")
+        name = _graph_name("sparse_allreduce", tensor.values) \
+            if name is None else name
+        values = allgather(tensor.values, name=f"{name}.values")
+        indices = allgather(tensor.indices, name=f"{name}.indices")
+        if op == Average:
+            values = _divide_by_size(values)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    name = _graph_name("allreduce", tensor) if name is None else name
+    comp, ctx = compression.compress(tensor)
+    raw = _allreduce_raw(comp, name, op=Sum if op == Average else op,
+                         prescale=prescale_factor, postscale=postscale_factor)
+    out = compression.decompress(raw, ctx)
+    if op == Average:
+        out = _divide_by_size(out)
+    return out
+
+
+def allgather(tensor, name=None):
+    """Graph-mode allgather (ragged dim0 negotiated by the engine). Gradient
+    per `mpi_ops.py:140-163`: sum-allreduce dy, slice this rank's segment at
+    the offset given by the gathered per-rank dim0 sizes."""
+    name = _graph_name("allgather", tensor) if name is None else name
+
+    @tf.custom_gradient
+    def fwd(x):
+        h = _start(lambda a: _ops.allgather_async(a, name=name), x)
+        y = _sync(h, x.dtype, tf.TensorShape([None]).concatenate(x.shape[1:]))
+
+        def grad(dy):
+            g = _allreduce_raw(dy, f"{name}.grad", op=Sum)
+            d0 = tf.shape(x)[0]
+            sizes = tf.stop_gradient(allgather(
+                tf.reshape(d0, [1]), name=f"{name}.grad_sizes"))
+            offset = tf.reduce_sum(sizes[:basics.rank()])
+            begin = tf.concat(
+                [[offset], tf.zeros([tf.rank(x) - 1], tf.int32)], axis=0)
+            return tf.slice(g, begin, tf.shape(x))
+
+        return y, grad
+
+    return fwd(tensor)
+
+
+def broadcast(tensor, root_rank, name=None):
+    """Graph-mode broadcast. Gradient per `mpi_ops.py:183-198`: sum-allreduce,
+    zeroed on non-root ranks."""
+    name = _graph_name("broadcast", tensor) if name is None else name
+
+    @tf.custom_gradient
+    def fwd(x):
+        h = _start(lambda a: _ops.broadcast_async(a, root_rank, name=name), x)
+        y = _sync(h, x.dtype, x.shape)
+
+        def grad(dy):
+            g = _allreduce_raw(dy, f"{name}.grad", op=Sum)
+            return g if basics.rank() == root_rank else g * 0
+
+        return y, grad
+
+    return fwd(tensor)
+
+
+def alltoall(tensor, name=None):
+    """Graph-mode equal-split alltoall (shape-preserving); its adjoint is
+    itself, so the gradient is an alltoall of dy."""
+    name = _graph_name("alltoall", tensor) if name is None else name
+
+    @tf.custom_gradient
+    def fwd(x):
+        h = _start(lambda a: _ops.alltoall_async(a, name=name), x)
+        y = _sync(h, x.dtype, x.shape)
+
+        def grad(dy):
+            return alltoall(dy, name=f"{name}.grad")
+
+        return y, grad
+
+    return fwd(tensor)

@@ -39,7 +39,13 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
 
     Gradient reduction happens in ``apply`` (Keras 3's single funnel —
     ``apply_gradients`` delegates to it), so both direct calls and the
-    fit() train step are covered.
+    fit() train step are covered — including compiled fit (no
+    ``run_eagerly``), where the reduction lowers to the graph-mode engine
+    path. Pass ``jit_compile=False`` to ``model.compile`` explicitly:
+    engine collectives are host ops and cannot be XLA-compiled (the same
+    constraint the reference's custom C++ ops have), and Keras's default
+    ``jit_compile="auto"`` resolves to True on machines with a non-CPU
+    device.
     """
     if op == Adasum:
         raise NotImplementedError(

@@ -36,6 +36,19 @@ class BroadcastGlobalVariablesCallback(_Base):
         self.root_rank = root_rank
         self._done = False
 
+    def on_train_begin(self, logs=None):
+        # fail early and clearly instead of XLA's "unsupported operation
+        # EagerPyFunc" mid-fit: engine collectives are host ops, so the fit
+        # train step must not be XLA-jitted (same constraint as the
+        # reference's custom C++ ops)
+        if getattr(self.model, "jit_compile", False) is True:
+            raise RuntimeError(
+                "this model's train step is XLA-jitted (jit_compile resolved "
+                "to True — Keras's default 'auto' enables XLA when a non-CPU "
+                "device is visible), which is incompatible with horovod_tpu's "
+                "engine collectives (host ops are not XLA-compilable); pass "
+                "jit_compile=False to model.compile")
+
     def on_batch_end(self, batch, logs=None):
         if self._done:
             return

@@ -13,6 +13,7 @@ over the device mesh.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 from . import basics
@@ -53,12 +54,23 @@ def run_cluster(fn: Callable, np: int = 2, args: Sequence = (),
     threads = [_RankThread(r, fn, args, kwargs) for r in range(np)]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join(timeout=timeout)
-        if t.is_alive():
+    # poll rather than join in rank order: a rank that died on an exception
+    # usually stalls its peers' collectives, and waiting out the full timeout
+    # on a hung peer would mask the root-cause error (first-failure
+    # semantics like gloo_run.py:253-259)
+    deadline = time.monotonic() + timeout
+    while True:
+        alive = [t for t in threads if t.is_alive()]
+        failed = [t for t in threads if not t.is_alive() and t.error]
+        if failed and alive:
+            raise failed[0].error
+        if not alive:
+            break
+        if time.monotonic() > deadline:
             raise TimeoutError(
-                f"rank {t.rank} did not finish within {timeout}s "
-                "(possible stalled negotiation)")
+                f"rank(s) {[t.rank for t in alive]} did not finish within "
+                f"{timeout}s (possible stalled negotiation)")
+        alive[0].join(timeout=0.05)
     for t in threads:
         if t.error is not None:
             raise t.error

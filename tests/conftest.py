@@ -13,6 +13,15 @@ import sys
 # initializes lazily — reconfigure to CPU with 8 virtual devices before any
 # computation runs.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Graph-mode TF collectives block inside py_function sync nodes; the
+# in-process cluster rig runs N ranks against ONE TF runtime, so the
+# inter-op pool must exceed ranks x max-in-flight-collectives-per-rank or
+# another rank's start node starves (single-core CI boxes default to 1).
+# Bound: tests run up to 8 ranks with models of up to ~14 reduced tensors
+# (8*14=112 < 128). One-rank-per-process deployments are immune (see
+# tensorflow/graph.py). Blocked threads are cheap — the pool is not a
+# parallelism knob here.
+os.environ.setdefault("TF_NUM_INTEROP_THREADS", "128")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

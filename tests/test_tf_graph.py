@@ -1,0 +1,344 @@
+"""Graph-mode (tf.function) TF binding tests.
+
+Parity model: the reference's graph-op coverage in `test/test_tensorflow.py`
+(op correctness + gradient correctness through the registered gradients,
+`tensorflow/mpi_ops.py:107-198`) — here exercised through `tf.function`-
+compiled steps instead of TF1 sessions.
+
+Each rank defines its own ``tf.function`` inside the per-rank body: the
+graph path binds the engine rank at trace time (see
+`horovod_tpu/tensorflow/graph.py` docstring), so the in-process cluster rig
+must trace per-rank function objects. One-rank-per-process deployments can
+share module-level functions as usual.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+import horovod_tpu.tensorflow.keras as hvd_keras  # noqa: E402
+from horovod_tpu import testing  # noqa: E402
+
+
+def test_graph_allreduce_average_sum():
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(t):
+            return (hvd.allreduce(t, name="g_ar_avg"),
+                    hvd.allreduce(t, name="g_ar_sum", op=hvd.Sum))
+
+        avg, s = step(tf.fill((2, 3), float(r + 1)))
+        np.testing.assert_allclose(avg.numpy(), np.full((2, 3), 1.5))
+        np.testing.assert_allclose(s.numpy(), np.full((2, 3), 3.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_allreduce_fp16_compression():
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(t):
+            return hvd.allreduce(t, name="g_ar_fp16",
+                                 compression=hvd.Compression.fp16)
+
+        out = step(tf.fill((8,), float(r + 1)))
+        assert out.dtype == tf.float32
+        np.testing.assert_allclose(out.numpy(), np.full((8,), 1.5))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_allgather_ragged_and_broadcast():
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step():
+            g = hvd.allgather(tf.fill((r + 1, 2), float(r)), name="g_ag")
+            b = hvd.broadcast(tf.fill((3,), float(r * 7)), root_rank=1,
+                              name="g_bc")
+            return g, b
+
+        g, b = step()
+        assert g.shape == (3, 2)
+        np.testing.assert_allclose(g.numpy(),
+                                   np.concatenate([np.zeros((1, 2)),
+                                                   np.ones((2, 2))]))
+        np.testing.assert_allclose(b.numpy(), np.full((3,), 7.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_allreduce_gradient():
+    """grad of sum-allreduce = sum-allreduce of dy (`mpi_ops.py:107-118`):
+    with per-rank upstream gradient (r+1), every rank gets sum_r (r+1) = 3."""
+
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                tape.watch(x)
+                y = hvd.allreduce(x, name="g_ar_grad", op=hvd.Sum)
+                loss = tf.reduce_sum(y * float(r + 1))
+            return tape.gradient(loss, x)
+
+        g = step(tf.ones((4,)))
+        np.testing.assert_allclose(g.numpy(), np.full((4,), 3.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_allgather_gradient_ragged():
+    """grad of allgather = this rank's slice of the sum-allreduced dy
+    (`mpi_ops.py:140-163`) — checked with ragged dim0 so the slice offset
+    comes from the gathered sizes."""
+
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                tape.watch(x)
+                y = hvd.allgather(x, name="g_ag_grad")
+                # dy rows = global row index: row i of y gets weight i
+                w = tf.reshape(tf.range(3, dtype=tf.float32), (3, 1))
+                loss = tf.reduce_sum(y * w)
+            return tape.gradient(loss, x)
+
+        # rank 0 owns global row 0; rank 1 owns rows 1,2. dy identical on
+        # both ranks, so sum-allreduce doubles it: grad = 2 * row_index.
+        g = step(tf.ones((r + 1, 2)))
+        expect = (np.array([[0.0, 0.0]]) if r == 0
+                  else np.array([[2.0, 2.0], [4.0, 4.0]]))
+        np.testing.assert_allclose(g.numpy(), expect)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_broadcast_gradient_root_only():
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                tape.watch(x)
+                y = hvd.broadcast(x, root_rank=0, name="g_bc_grad")
+                loss = tf.reduce_sum(y) * float(r + 1)
+            return tape.gradient(loss, x)
+
+        g = step(tf.ones((3,)))
+        # dy = (r+1) ones; sum-allreduce = 3; non-root gets zeros
+        expect = np.full((3,), 3.0) if r == 0 else np.zeros((3,))
+        np.testing.assert_allclose(g.numpy(), expect)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_distributed_gradient_tape_train_step():
+    """DistributedGradientTape inside a compiled train step: gradients are
+    rank-averaged before the update, so replicas stay in lockstep."""
+
+    def fn():
+        r = hvd.rank()
+        w = tf.Variable([2.0, 3.0])
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(w * x)
+            dtape = hvd.DistributedGradientTape(tape)
+            return dtape.gradient(loss, [w])[0]
+
+        g = step(tf.fill((2,), float(r + 1)))
+        np.testing.assert_allclose(g.numpy(), np.full((2,), 1.5))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_sparse_indexed_slices_gradient():
+    """Embedding-style IndexedSlices gradient through the graph sparse path:
+    two allgathers, Average divides values by world size."""
+
+    def fn():
+        r = hvd.rank()
+        emb = tf.Variable(np.ones((4, 2), np.float32))
+
+        @tf.function
+        def step(idx):
+            with tf.GradientTape() as tape:
+                h = tf.gather(emb, idx)
+                loss = tf.reduce_sum(h) * float(r + 1)
+            dtape = hvd.DistributedGradientTape(tape)
+            return dtape.gradient(loss, [emb])[0]
+
+        g = step(tf.constant([r, 3]))
+        assert isinstance(g, tf.IndexedSlices)
+        vals, idxs = g.values.numpy(), g.indices.numpy()
+        # gathered rows: rank0 [0,3], rank1 [1,3]; values (r+1)/size
+        got = {}
+        for v, i in zip(vals, idxs):
+            got[int(i)] = got.get(int(i), 0.0) + float(v[0])
+        assert got == {0: 0.5, 1: 1.0, 3: 1.5}
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_two_unnamed_collectives_same_tensor():
+    """Two unnamed allreduces of the SAME tensor in one step must get
+    distinct engine names (the in-flight duplicate-name check would kill
+    the second otherwise)."""
+
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(t):
+            return hvd.allreduce(t, op=hvd.Sum) + hvd.allreduce(t,
+                                                                op=hvd.Sum)
+
+        out = step(tf.fill((3,), float(r + 1)))
+        np.testing.assert_allclose(out.numpy(), np.full((3,), 6.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_int_average_matches_eager_dtype():
+    """Integer Average floor-divides and stays integer, like the eager
+    engine kernel."""
+
+    def fn():
+        r = hvd.rank()
+
+        @tf.function
+        def step(t):
+            return hvd.allreduce(t, name="g_int_avg")
+
+        out = step(tf.constant([4 + r, 6 + r], tf.int32))
+        eager = hvd.allreduce(tf.constant([4 + r, 6 + r], tf.int32),
+                              name="e_int_avg")
+        assert out.dtype == tf.int32 and eager.dtype == tf.int32
+        np.testing.assert_array_equal(out.numpy(), eager.numpy())
+        np.testing.assert_array_equal(out.numpy(), np.array([4, 6]))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_prescale_postscale_gradient():
+    """grad of y = post*sum(pre*x) carries the same pre*post factor."""
+    from horovod_tpu.tensorflow import graph as hvd_graph
+
+    def fn():
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                tape.watch(x)
+                y = hvd_graph.allreduce(x, name="g_scaled", op=hvd.Sum,
+                                        prescale_factor=0.5,
+                                        postscale_factor=4.0)
+                loss = tf.reduce_sum(y)
+            return y, tape.gradient(loss, x)
+
+        y, g = step(tf.ones((3,)))
+        # forward: 4.0 * sum_r(0.5 * 1) = 4.0; grad: 0.5*4.0*sum_r(1) = 4.0
+        np.testing.assert_allclose(y.numpy(), np.full((3,), 4.0))
+        np.testing.assert_allclose(g.numpy(), np.full((3,), 4.0))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_eager_and_graph_with_gradient():
+    """alltoall in both modes; the equal-split exchange is its own adjoint,
+    so the gradient routes each segment back to its source rank."""
+
+    def fn():
+        r = hvd.rank()
+        # rank r sends [2r, 2r+1]; segment s of rank r's input goes to rank s
+        inp = np.array([2.0 * r, 2.0 * r + 1.0], np.float32)
+        eager = hvd.alltoall(tf.constant(inp), name="e_a2a")
+        # rank r receives element r of every rank's input: [r, r+2]
+        expect = np.array([float(r), float(r + 2)])
+        np.testing.assert_allclose(eager.numpy(), expect)
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                tape.watch(x)
+                y = hvd.alltoall(x, name="g_a2a")
+                loss = tf.reduce_sum(y) * float(r + 1)
+            return y, tape.gradient(loss, x)
+
+        y, g = step(tf.constant(inp))
+        np.testing.assert_allclose(y.numpy(), expect)
+        # dy on rank s = (s+1); grad element i of rank r = dy from rank i
+        np.testing.assert_allclose(g.numpy(), np.array([1.0, 2.0]))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_keras_jit_compile_true_fails_fast():
+    """jit_compile=True cannot work (host engine ops are not XLA ops); the
+    broadcast callback turns the cryptic XLA failure into an early error."""
+
+    def fn():
+        model = tf.keras.Sequential(
+            [tf.keras.Input((4,)), tf.keras.layers.Dense(1)])
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.1))
+        model.compile(optimizer=opt, loss="mse", jit_compile=True)
+        x = np.zeros((4, 4), np.float32)
+        y = np.zeros((4, 1), np.float32)
+        with pytest.raises(RuntimeError, match="jit_compile"):
+            model.fit(x, y, batch_size=4, epochs=1, verbose=0, callbacks=[
+                hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)])
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_graph_keras_fit_compiled():
+    """model.fit WITHOUT run_eagerly: the keras DistributedOptimizer's
+    reduction runs inside the fit tf.function through the graph path, and
+    replicas end a step with identical weights. jit_compile must be False —
+    engine nodes are host ops, not XLA-compilable (same constraint as the
+    reference's custom C++ ops)."""
+
+    def fn():
+        r = hvd.rank()
+        rng = np.random.RandomState(r)
+        x = rng.randn(8, 4).astype(np.float32)
+        y = rng.randn(8, 1).astype(np.float32)
+        model = tf.keras.Sequential(
+            [tf.keras.Input((4,)),
+             tf.keras.layers.Dense(1, kernel_initializer="ones")])
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.1))
+        model.compile(optimizer=opt, loss="mse", jit_compile=False)
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+        return [w.copy() for w in model.get_weights()]
+
+    weights = testing.run_cluster(fn, np=2)
+    for w0, w1 in zip(*weights):
+        np.testing.assert_allclose(w0, w1, rtol=1e-5)
+        assert not np.allclose(w0, np.ones_like(w0))  # training happened
