@@ -52,7 +52,9 @@ def main():
         [args.batch_size, args.image_size, args.image_size, 3], seed=1)
     target = tf.random.uniform([args.batch_size, 1], minval=0, maxval=999,
                                dtype=tf.int64, seed=2)
-    loss_obj = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+    # keras.applications heads end in softmax, so probabilities pair with
+    # the default from_logits=False (`tensorflow2_synthetic_benchmark.py:79`)
+    loss_obj = tf.losses.SparseCategoricalCrossentropy()
 
     def benchmark_step():
         with hvd.DistributedGradientTape(

@@ -33,7 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu distributed job.")
-    p.add_argument("-np", "--num-proc", type=int, required=True,
+    # not required=True: --check-build/--version must work without it
+    # (validated in run_commandline)
+    p.add_argument("-np", "--num-proc", type=int, default=None,
                    help="number of ranks")
     p.add_argument("-H", "--hosts", default=None,
                    help='host:slots list, e.g. "h1:4,h2:4" (default: '
@@ -66,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-shutdown-time", type=float, default=None)
     p.add_argument("--log-level", default=None)
     p.add_argument("--config-file", default=None, help="YAML config file")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print available frameworks/controllers/ops and exit")
+    from .. import __version__
+
+    p.add_argument("-v", "--version", action="version", version=__version__,
+                   help="show the horovod_tpu version")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program and args to launch per rank")
     return p
@@ -268,13 +276,67 @@ def launch(np: int, command: List[str], hosts: Optional[str] = None,
         kv.stop()
 
 
+def check_build() -> str:
+    """``--check-build`` report (`run/run.py:289-332` parity): which
+    frameworks, controllers and tensor-op paths this install can use."""
+    import importlib
+
+    from .. import __version__
+
+    def probe(mod: str) -> bool:
+        try:
+            importlib.import_module(mod)
+            return True
+        except Exception:
+            return False
+
+    try:
+        from ..runtime.native import load_library
+
+        load_library()
+        have_native = True
+    except Exception:
+        have_native = False
+
+    def x(v: bool) -> str:
+        return "X" if v else " "
+
+    return (
+        f"horovod_tpu v{__version__}:\n"
+        f"\n"
+        f"Available Frameworks:\n"
+        f"    [{x(probe('jax'))}] JAX / flax (native surface)\n"
+        f"    [{x(probe('tensorflow'))}] TensorFlow (eager + tf.function)\n"
+        f"    [{x(probe('torch'))}] PyTorch\n"
+        f"    [{x(probe('mxnet'))}] MXNet\n"
+        f"\n"
+        f"Available Controllers:\n"
+        f"    [{x(have_native)}] native C++ core\n"
+        f"    [X] python fallback\n"
+        f"    [X] coordinated (cross-process)\n"
+        f"\n"
+        f"Available Tensor Operations:\n"
+        f"    [{x(probe('jax'))}] XLA collectives (SPMD + eager engine)\n"
+        f"    [{x(probe('jax.experimental.pallas'))}] Pallas kernels\n"
+        f"    [X] Adasum\n")
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    # argparse stops flag-parsing at the command remainder, so a
+    # --check-build belonging to the USER program is never consumed here
+    # (reference handles this with a custom action, `run/run.py:327-332`)
+    if args.check_build:
+        print(check_build())
+        return 0
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     if not cmd:
         print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    if args.num_proc is None or args.num_proc < 1:
+        print("hvdrun: -np/--num-proc is required", file=sys.stderr)
         return 2
     knob_env = config_parser.env_from_config(args.config_file, args)
     if args.verbose:

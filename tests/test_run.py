@@ -171,6 +171,23 @@ def _run_hvdrun(tmp_path, body, np_ranks=2):
         capture_output=True, text=True, timeout=180, env=env)
 
 
+def test_check_build_report():
+    """--check-build prints the capability report without needing -np
+    (`run/run.py:289-332` parity)."""
+    from horovod_tpu.run import launcher
+
+    out = launcher.check_build()
+    assert "Available Frameworks" in out
+    assert "[X] JAX / flax" in out
+    assert "Available Controllers" in out
+    assert "Available Tensor Operations" in out
+    assert launcher.run_commandline(["--check-build"]) == 0
+    # flags in the USER command must not be hijacked (the report flag only
+    # applies before the command remainder)
+    assert launcher.run_commandline(
+        ["-np", "0", "--", "python", "x.py", "--check-build"]) == 2
+
+
 @pytest.mark.integration
 def test_hvdrun_cli_smoke(tmp_path):
     """hvdrun CLI end-to-end on 2 local ranks."""

@@ -77,6 +77,85 @@ def test_torch_distributed_optimizer_training():
     np.testing.assert_array_equal(res[0], res[1])
 
 
+def test_torch_dynamic_requires_grad():
+    """GAN-style alternating freeze (`test/test_torch.py:1306-1354`): hooks
+    on frozen params simply never fire; the trained net's gradients still
+    average across ranks and replicas stay identical."""
+
+    def fn():
+        r = hvd.rank()
+        torch.manual_seed(0)
+        gen = torch.nn.Linear(3, 4)
+        disc = torch.nn.Linear(4, 1)
+        hvd.broadcast_parameters(gen.state_dict(), root_rank=0)
+        hvd.broadcast_parameters(disc.state_dict(), root_rank=0)
+        gen_opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(gen.parameters(), lr=0.1),
+            named_parameters=gen.named_parameters())
+        disc_opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(disc.parameters(), lr=0.1),
+            named_parameters=disc.named_parameters())
+        rng = np.random.RandomState(100 + r)
+
+        def train_step(train_generator, train_discriminator):
+            for p in gen.parameters():
+                p.requires_grad_(train_generator)
+            for p in disc.parameters():
+                p.requires_grad_(train_discriminator)
+            gen_opt.zero_grad(set_to_none=False)
+            disc_opt.zero_grad(set_to_none=False)
+            x = torch.from_numpy(rng.randn(2, 3).astype(np.float32))
+            loss = disc(gen(x)).sum()
+            loss.backward()
+            for p in gen.parameters():
+                assert train_generator == (p.grad is not None
+                                           and bool(p.grad.abs().max() > 0))
+            for p in disc.parameters():
+                assert train_discriminator == (p.grad is not None and
+                                               bool(p.grad.abs().max() > 0))
+            if train_generator:
+                gen_opt.step()
+            if train_discriminator:
+                disc_opt.step()
+
+        for _ in range(4):
+            train_step(True, False)
+            train_step(False, True)
+        return (gen.weight.detach().numpy().copy(),
+                disc.weight.detach().numpy().copy())
+
+    res = testing.run_cluster(fn, np=2)
+    np.testing.assert_array_equal(res[0][0], res[1][0])
+    np.testing.assert_array_equal(res[0][1], res[1][1])
+
+
+def test_torch_backward_passes_per_step():
+    """k=2 local accumulation through the hook optimizer
+    (`test/test_torch.py:1137` test_force_allreduce): the wire carries the
+    accumulated SUM every second backward; step() between communication
+    steps applies the local (unreduced) gradient state."""
+
+    def fn():
+        r = hvd.rank()
+        w = torch.nn.Parameter(torch.zeros(2))
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD([w], lr=1.0),
+            named_parameters=[("w", w)], backward_passes_per_step=2)
+        # micro-grads: rank r contributes (r+1) per backward
+        for micro in range(2):
+            loss = (w * float(r + 1)).sum()
+            loss.backward()
+        # after 2 backwards the hook fired once with the accumulated grad
+        # 2*(r+1); average over ranks = (2*1 + 2*2)/2 = 3
+        opt.step()
+        g = w.grad.detach().numpy().copy()
+        return g
+
+    res = testing.run_cluster(fn, np=2)
+    for g in res:
+        np.testing.assert_allclose(g, np.full((2,), 3.0))
+
+
 def test_torch_optimizer_state_broadcast():
     def fn():
         r = hvd.rank()
