@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Transformer-LM training benchmark: tokens/sec and model FLOP utilization.
+
+Complements `bench.py` (ResNet-50, HBM-bandwidth-bound — see
+docs/benchmarks.md): a GPT-2-class LM is matmul-dominated, so this bench
+shows what fraction of the MXU the SPMD train step actually sustains. Same
+protocol as the reference's synthetic harness
+(`examples/tensorflow2_synthetic_benchmark.py:106-133`): warmup, timed
+rounds, one JSON line.
+
+MFU = achieved FLOP/s ÷ peak FLOP/s, with the standard 6·P·T transformer
+training FLOP count (fwd 2·P·T + bwd 4·P·T, P = non-embedding params,
+T = tokens) per Kaplan et al. / PaLM appendix B.
+
+    python benchmarks/lm_bench.py                 # real chip
+    LM_PRESET=tiny python benchmarks/lm_bench.py  # CPU smoke
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# bf16 peak of one v5e chip (TFLOP/s); override for other parts
+PEAK_TFLOPS = float(os.environ.get("LM_PEAK_TFLOPS", "197"))
+
+PRESETS = {
+    # ~GPT-2 medium: d=1024, 24 layers, 16 heads
+    "medium": dict(num_layers=24, d_model=1024, num_heads=16,
+                   batch=8, seq=1024, warmup=5, rounds=5, iters=5),
+    "small": dict(num_layers=12, d_model=768, num_heads=12,
+                  batch=8, seq=1024, warmup=5, rounds=5, iters=5),
+    "tiny": dict(num_layers=2, d_model=64, num_heads=2,
+                 batch=2, seq=64, warmup=1, rounds=2, iters=2),
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.models.transformer import TransformerLM, lm_loss
+
+    hvd.init()
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = dict(PRESETS[os.environ.get("LM_PRESET",
+                                      "medium" if on_tpu else "tiny")])
+    if os.environ.get("LM_BATCH"):
+        cfg["batch"] = int(os.environ["LM_BATCH"])
+    vocab = int(os.environ.get("LM_VOCAB", "32768" if on_tpu else "256"))
+    batch, seq = cfg["batch"] * hvd.num_replicas(), cfg["seq"]
+
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=cfg["num_layers"],
+        num_heads=cfg["num_heads"], d_model=cfg["d_model"],
+        max_seq_len=seq, dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, vocab, (batch, seq + 1)))
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+
+    # non-embedding param count for the 6·P·T FLOP model; fail loudly if
+    # the model's table names ever change rather than mis-reporting MFU
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    n_emb = params["tok_emb"]["embedding"].size + params["pos_emb"].size
+    n_nonemb = n_params - n_emb
+
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+    mesh = hvd.mesh()
+    params = spmd.replicate(params, mesh)
+    opt_state = spmd.replicate(opt_state, mesh)
+    tokens = spmd.shard_batch(tokens, mesh)
+    targets = spmd.shard_batch(targets, mesh)
+
+    def loss_fn(p, x, y):
+        return lm_loss(model.apply({"params": p}, x), y)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(mesh, P())
+
+    def _step(p, opt, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, opt = tx.update(grads, opt, p)
+        return optax.apply_updates(p, updates), opt, loss
+
+    jitted = jax.jit(_step, out_shardings=(repl, repl, repl))
+    step = jitted
+    if on_tpu:
+        try:
+            step = jitted.lower(params, opt_state, tokens, targets).compile(
+                compiler_options={
+                    "xla_tpu_enable_latency_hiding_scheduler": "true"})
+        except Exception:
+            step = jitted
+
+    for _ in range(cfg["warmup"]):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(cfg["rounds"] * cfg["iters"]):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)
+    total = time.perf_counter() - t0
+
+    steps = cfg["rounds"] * cfg["iters"]
+    n_dev = hvd.num_replicas()
+    tok_per_s = batch * seq * steps / total
+    # 6·P·T with non-embedding P only — conservative: excludes the logit
+    # matmul (weight-tied head) and attention-score FLOPs
+    flops_per_s = 6.0 * n_nonemb * tok_per_s
+    mfu = flops_per_s / (n_dev * PEAK_TFLOPS * 1e12)
+    print(f"# backend={jax.default_backend()} devices={n_dev} "
+          f"params={n_params/1e6:.1f}M (non-emb {n_nonemb/1e6:.1f}M) "
+          f"batch={batch} seq={seq} loss={float(loss):.3f}", file=sys.stderr)
+    print(f"# tokens/sec: {tok_per_s:,.0f}; model TFLOP/s: "
+          f"{flops_per_s/1e12:.1f}; MFU/chip: {100*mfu:.1f}%",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "transformer_lm_tokens_per_sec",
+        "value": round(tok_per_s, 1),
+        "unit": "tok/s",
+        "mfu_pct": round(100 * mfu, 2) if on_tpu else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

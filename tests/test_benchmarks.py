@@ -33,6 +33,20 @@ def test_scaling_bench_reports_efficiency(capsys):
     assert summary["config"]["shared_core_virtual_devices"] is True
 
 
+def test_lm_bench_smoke(capsys, monkeypatch):
+    """LM bench (tokens/sec + MFU) runs end-to-end on the tiny preset and
+    emits the one-line JSON contract."""
+    monkeypatch.setenv("LM_PRESET", "tiny")
+    import lm_bench
+
+    lm_bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["metric"] == "transformer_lm_tokens_per_sec"
+    assert rec["value"] > 0
+    assert rec["unit"] == "tok/s"
+
+
 def test_allreduce_bench_spmd_and_eager(capsys):
     import allreduce_bench
 

@@ -180,6 +180,28 @@ def test_flash_attention_grad_matches_reference():
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fullattn_bwd_multiblock(causal):
+    """The Pallas FlashAttention-2 backward (dq + dkv kernels) across
+    multiple q/k blocks: grads == autodiff of plain jnp attention. Weighted
+    loss makes the incoming cotangent row-dependent, exercising the D/LSE
+    recompute."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 2, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(8), q.shape, q.dtype)
+
+    def loss_pk(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=causal) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) * w)
+
+    g_pk = jax.grad(loss_pk, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_ring_attention_grad_with_pallas_step():
     from jax.sharding import Mesh
 
