@@ -285,12 +285,13 @@ def flash_step_vjp(causal: bool, scale: float):
 
 
 # ------------------------------------------------- flash attention backward
-def _flash_bwd_dq_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
-                         dq_ref, *, causal, scale, block_k):
+def _flash_bwd_dq_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+                         do_ref, dq_ref, *, causal, scale, block_k):
     """dq for one q tile against the whole resident k/v (FlashAttention-2
     backward, dq pass): recompute p = exp(scale*qk^T - LSE) blockwise, then
     ds = p*(do v^T - D)*scale, dq += ds k.  LSE = m + log l (row logsumexp),
-    D = rowsum(do * out) — both precomputed outside."""
+    D = rowsum(do * out) — both precomputed outside. offs (scalar prefetch):
+    [q_off, k_off] global sequence origins (ring hop offsets)."""
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
     tk = k_ref.shape[1]
@@ -300,7 +301,8 @@ def _flash_bwd_dq_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
     do = do_ref[0]                                    # [BQ, D]
     lse = lse_ref[0]                                  # [BQ, 1] f32
     dd = dd_ref[0]                                    # [BQ, 1] f32
-    q_off = iq * bq
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1]
 
     def body(j, acc):
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
@@ -309,7 +311,7 @@ def _flash_bwd_dq_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
                                     preferred_element_type=jnp.float32)
         if causal:
             qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = (j * block_k
+            kpos = (k_off + j * block_k
                     + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.exp(s - lse)                          # exp(-inf) == 0
@@ -319,14 +321,14 @@ def _flash_bwd_dq_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
         return acc + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
 
-    hi = jnp.clip((q_off + bq + block_k - 1) // block_k, 0, nk) \
+    hi = jnp.clip((q_off + bq - k_off + block_k - 1) // block_k, 0, nk) \
         if causal else nk
     dq_ref[0] = lax.fori_loop(0, hi, body,
                               jnp.zeros(q.shape, jnp.float32))
 
 
-def _flash_bwd_dkv_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
-                          dk_ref, dv_ref, *, causal, scale, block_q):
+def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+                          do_ref, dk_ref, dv_ref, *, causal, scale, block_q):
     """dk/dv for one k/v tile against the whole resident q/do (dkv pass):
     dv += p^T do; dk += (p*(do v^T - D)*scale)^T q."""
     jk = pl.program_id(1)
@@ -336,7 +338,8 @@ def _flash_bwd_dkv_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
     in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
     k = k_ref[0]                                      # [BK, D]
     v = v_ref[0]
-    k_off = jk * bk
+    q_off = offs_ref[0]
+    k_off = offs_ref[1] + jk * bk
 
     def body(i, carry):
         dk, dv = carry
@@ -347,7 +350,7 @@ def _flash_bwd_dkv_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
         s = scale * lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
         if causal:
-            qpos = (i * block_q
+            qpos = (q_off + i * block_q
                     + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
             kpos = k_off + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
@@ -362,7 +365,7 @@ def _flash_bwd_dkv_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
                                   preferred_element_type=jnp.float32)
         return dk, dv
 
-    lo = jnp.clip(k_off // block_q, 0, nq) if causal else 0
+    lo = jnp.clip((k_off - q_off) // block_q, 0, nq) if causal else 0
     dk, dv = lax.fori_loop(lo, nq, body,
                            (jnp.zeros(k.shape, jnp.float32),
                             jnp.zeros(v.shape, jnp.float32)))
@@ -370,9 +373,10 @@ def _flash_bwd_dkv_kernel(lse_ref, dd_ref, q_ref, k_ref, v_ref, do_ref,
     dv_ref[0] = dv
 
 
-def _flash_bwd(q, k, v, out, lse, dout, *, causal, scale):
+def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
     """Blockwise backward for normalized flash attention, [B, T, H, D]
-    layout.  Returns (dq, dk, dv) in f32."""
+    layout.  ``q_off``/``k_off`` are global sequence origins (traced scalars
+    OK — ring hops).  Returns (dq, dk, dv) in f32."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q = _pick_block(tq)
@@ -388,60 +392,82 @@ def _flash_bwd(q, k, v, out, lse, dout, *, causal, scale):
                  axis=-1)                              # [B, T, H]
     ddt = dd.transpose(0, 2, 1).reshape(bh, tq, 1)
     lset = lse.reshape(bh, tq, 1)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
     interpret = _interpret()
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
                           block_k=block_k),
-        grid=(bh, tq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=_struct((bh, tq, d), jnp.float32, qt, kt),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j, offs: (i, j, 0)),
+        ),
+        out_shape=_struct((bh, tq, d), jnp.float32, qt, kt, offs),
         cost_estimate=pl.CostEstimate(
             flops=6 * bh * tq * tk * d,
             bytes_accessed=4 * bh * (3 * tq * d + 2 * tk * d),
             transcendentals=bh * tq * tk),
         interpret=interpret,
-    )(lset, ddt, qt, kt, vt, dot)
+    )(offs, lset, ddt, qt, kt, vt, dot)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q),
-        grid=(bh, tk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, tk // block_k),
+            in_specs=[
+                pl.BlockSpec((1, tq, 1), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, tq, 1), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, tq, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, tq, d), lambda i, j, offs: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
+            ],
+        ),
         out_shape=[
-            _struct((bh, tk, d), jnp.float32, qt, kt),
-            _struct((bh, tk, d), jnp.float32, qt, kt),
+            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
+            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
         ],
         cost_estimate=pl.CostEstimate(
             flops=8 * bh * tq * tk * d,
             bytes_accessed=4 * bh * (3 * tq * d + 3 * tk * d),
             transcendentals=bh * tq * tk),
         interpret=interpret,
-    )(lset, ddt, qt, kt, vt, dot)
+    )(offs, lset, ddt, qt, kt, vt, dot)
 
     def heads_minor(x, t):
         return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
     return heads_minor(dq, tq), heads_minor(dk, tk), heads_minor(dv, tk)
+
+
+def finalize_attention_stats(m, l, o, out_dtype):
+    """(m, l, o) flash statistics → (normalized out, row-LSE). The
+    fully-masked-row convention (l == 0 → out 0, LSE 0) is what the
+    backward kernels' ``p = exp(s - lse)`` recompute depends on — every
+    score in such a row is -inf, so p recomputes to 0 regardless of the
+    sentinel. Single source of truth for the single-device and ring
+    epilogues."""
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(out_dtype)
+    lse = jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)  # [B, H, T]
+    return out, lse
 
 
 def _fullattn_bwd_supported(q, k) -> bool:
@@ -467,12 +493,7 @@ def _flash_fullattn_vjp(causal: bool, scale: float):
         o0 = jnp.zeros((b, tq, h, d), jnp.float32)
         m, l, o = flash_attention_step(q, k, v, m0, l0, o0, 0, 0,
                                        causal=causal, scale=scale)
-        l_safe = jnp.where(l == 0, 1.0, l)
-        out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
-        # row logsumexp; fully-masked rows get 0 (p recomputes to 0 there
-        # because every score is -inf)
-        lse = jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)  # [B, H, T]
-        return out, lse
+        return finalize_attention_stats(m, l, o, q.dtype)
 
     @jax.custom_vjp
     def fa(q, k, v):

@@ -219,3 +219,28 @@ def test_ring_attention_grad_with_pallas_step():
     for a, b_ in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_fa2_backward_4dev(causal):
+    """The ring-structured FlashAttention-2 backward (second ring pass: dq
+    local, dk/dv rotating home with their blocks) across 4 devices, with a
+    row-dependent cotangent — grads == autodiff of exact attention."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("sp",))
+    b, t, h, d = 1, 4 * 128, 2, 64
+    q, k, v = _rand_qkv(jax.random.PRNGKey(9), b, t, h, d)
+    w = jax.random.normal(jax.random.PRNGKey(10), q.shape, q.dtype)
+    fn = make_ring_attention(mesh, causal=causal)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) * w),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            reference_attention(q, k, v, causal=causal) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4)
