@@ -54,6 +54,8 @@ def main():
                                       "medium" if on_tpu else "tiny")])
     if os.environ.get("LM_BATCH"):
         cfg["batch"] = int(os.environ["LM_BATCH"])
+    if os.environ.get("LM_SEQ"):
+        cfg["seq"] = int(os.environ["LM_SEQ"])
     vocab = int(os.environ.get("LM_VOCAB", "32768" if on_tpu else "256"))
     batch, seq = cfg["batch"] * hvd.num_replicas(), cfg["seq"]
 

@@ -195,6 +195,13 @@ def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
 # Per-operand VMEM budget for the resident k/v block: the pipeline double-
 # buffers input blocks, so worst-case VMEM ≈ 2 (buffering) × 2 (k+v) × this.
 _KV_VMEM_CAP = 3 * 2 ** 20
+# Budget for the backward's whole-resident layout; beyond it _flash_bwd
+# switches to the streaming 3D-grid kernels (any length works there).
+# Tighter than the forward's: the resident dkv pass holds q AND do (plus
+# lse/dd and double-buffered tiles) — measured on v5e, 512 KB/operand
+# (seq 4096 at d=64 bf16) compiles within the 16 MB scoped-VMEM limit and
+# 1 MB (seq 8192) does not.
+_BWD_RESIDENT_CAP = 512 * 2 ** 10
 
 
 def step_supported(q, k) -> bool:
@@ -243,52 +250,18 @@ def flash_attention_step(q, k, v, m, l, o, q_off, k_off, *,
     return m_new, l_new, o_new
 
 
-@functools.lru_cache(maxsize=None)
-def flash_step_vjp(causal: bool, scale: float):
-    """Differentiable flash step: Pallas kernel forward, rematerialized jnp
-    flash-accumulation backward (``pallas_call`` has no AD rule; the jnp step
-    computes the same function, so its VJP is the step's gradient and the
-    residuals are just the step inputs — flash-style O(T) memory). For bf16
-    inputs the kernel's dots round operands to bf16 while the jnp backward
-    differentiates the f32 math — the gradient is exact for the f32 step,
-    within rounding of the executed one (f32 inputs match bitwise).
-
-    Returns ``step(q, k, v, m, l, o, q_off, k_off) -> (m', l', o')``.
-    """
-
-    @jax.custom_vjp
-    def step(q, k, v, m, l, o, q_off, k_off):
-        return flash_attention_step(q, k, v, m, l, o, q_off, k_off,
-                                    causal=causal, scale=scale)
-
-    def fwd(q, k, v, m, l, o, q_off, k_off):
-        out = step(q, k, v, m, l, o, q_off, k_off)
-        return out, (q, k, v, m, l, o, q_off, k_off)
-
-    def bwd(res, g):
-        from ..parallel.ring_attention import _block_attn
-
-        q, k, v, m, l, o, q_off, k_off = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_, m_, l_, o_: _block_attn(
-                q_, k_, v_, m_, l_, o_, q_off, k_off, causal, scale),
-            q, k, v, m, l, o)
-        dq, dk, dv, dm, dl, do = vjp(g)
-
-        def int_zero(x):  # integer offsets take float0 cotangents
-            return np.zeros(np.shape(x), jax.dtypes.float0)
-
-        return dq, dk, dv, dm, dl, do, int_zero(q_off), int_zero(k_off)
-
-    step.defvjp(fwd, bwd)
-    return step
+# (The pre-FA2 "Pallas forward + rematerialized jnp backward" step wrapper
+# lived here; the blockwise backward kernels below cover every supported
+# shape — resident or streaming — so the quadratic-HBM jnp VJP is gone.)
 
 
 # ------------------------------------------------- flash attention backward
-def _flash_bwd_dq_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+def _flash_bwd_dq_kernel_res(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
                          do_ref, dq_ref, *, causal, scale, block_k):
     """dq for one q tile against the whole resident k/v (FlashAttention-2
-    backward, dq pass): recompute p = exp(scale*qk^T - LSE) blockwise, then
+    backward, dq pass — VMEM-RESIDENT variant for shapes whose full k/v
+    fits VMEM; the streaming 3D-grid variant covers longer sequences):
+    recompute p = exp(scale*qk^T - LSE) blockwise, then
     ds = p*(do v^T - D)*scale, dq += ds k.  LSE = m + log l (row logsumexp),
     D = rowsum(do * out) — both precomputed outside. offs (scalar prefetch):
     [q_off, k_off] global sequence origins (ring hop offsets)."""
@@ -327,7 +300,7 @@ def _flash_bwd_dq_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
                               jnp.zeros(q.shape, jnp.float32))
 
 
-def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+def _flash_bwd_dkv_kernel_res(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
                           do_ref, dk_ref, dv_ref, *, causal, scale, block_q):
     """dk/dv for one k/v tile against the whole resident q/do (dkv pass):
     dv += p^T do; dk += (p*(do v^T - D)*scale)^T q."""
@@ -373,32 +346,103 @@ def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
     dv_ref[0] = dv
 
 
-def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
-    """Blockwise backward for normalized flash attention, [B, T, H, D]
-    layout.  ``q_off``/``k_off`` are global sequence origins (traced scalars
-    OK — ring hops).  Returns (dq, dk, dv) in f32."""
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-    block_q = _pick_block(tq)
-    block_k = _pick_block(tk)
-    bh = b * h
+def _flash_bwd_dq_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+                         do_ref, dq_ref, *, causal, scale):
+    """dq accumulation for one (q tile, k tile) grid cell (FlashAttention-2
+    backward, dq pass): recompute p = exp(scale*qk^T - LSE), then
+    ds = p*(do v^T - D)*scale, dq += ds k.  LSE = m + log l (row logsumexp),
+    D = rowsum(do * out) — both precomputed outside. offs (scalar prefetch):
+    [q_off, k_off] global sequence origins (ring hop offsets). The k grid
+    dimension is innermost and revisits the same dq tile, so VMEM holds one
+    tile of each operand regardless of sequence length."""
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+    in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1] + jk * bk
 
-    def heads_major(x):
-        return x.transpose(0, 2, 1, 3).reshape(bh, x.shape[1], d)
+    @pl.when(jk == 0)
+    def _():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    qt, kt, vt, dot = map(heads_major, (q, k, v, dout))
-    # D = rowsum(dout * out) per row — cheap and linear, precomputed in jnp
-    dd = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
-                 axis=-1)                              # [B, T, H]
-    ddt = dd.transpose(0, 2, 1).reshape(bh, tq, 1)
-    lset = lse.reshape(bh, tq, 1)
-    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
-                      jnp.asarray(k_off, jnp.int32)])
-    interpret = _interpret()
+    # causal: a block with every pair masked contributes nothing
+    live = (q_off + bq - 1 >= k_off) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]                                  # [BQ, D]
+        do = do_ref[0]
+        lse = lse_ref[0]                              # [BQ, 1] f32
+        dd = dd_ref[0]
+        k = k_ref[0]                                  # [BK, D]
+        v = v_ref[0]
+        s = scale * lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # exp(-inf) == 0
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - dd) * scale).astype(in_dt)
+        dq_ref[0] += lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+
+def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+                          do_ref, dk_ref, dv_ref, *, causal, scale):
+    """dk/dv accumulation for one (k tile, q tile) grid cell (dkv pass):
+    dv += p^T do; dk += (p*(do v^T - D)*scale)^T q. The q grid dimension is
+    innermost and revisits the same dk/dv tiles."""
+    jk, iq = pl.program_id(1), pl.program_id(2)
+    bk, bq = k_ref.shape[1], q_ref.shape[1]
+    in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1] + jk * bk
+
+    @pl.when(iq == 0)
+    def _():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    live = (q_off + bq - 1 >= k_off) if causal else True
+
+    @pl.when(live)
+    def _():
+        k = k_ref[0]                                  # [BK, D]
+        v = v_ref[0]
+        q = q_ref[0]                                  # [BQ, D]
+        do = do_ref[0]
+        lse = lse_ref[0]                              # [BQ, 1]
+        dd = dd_ref[0]
+        s = scale * lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [BQ, BK] f32
+        dv_ref[0] += lax.dot_general(p.astype(in_dt), do,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - dd) * scale).astype(in_dt)
+        dk_ref[0] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+
+def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, b, h, d, *,
+                        causal, scale, block_q, block_k, interpret):
+    """Whole-resident backward dispatch: dq pass keeps full k/v in VMEM,
+    dkv pass keeps full q/do in VMEM (heads-major [BH, T, D] operands)."""
+    bh, tq = qt.shape[0], qt.shape[1]
+    tk = kt.shape[1]
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_k=block_k),
+        functools.partial(_flash_bwd_dq_kernel_res, causal=causal,
+                          scale=scale, block_k=block_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bh, tq // block_q),
@@ -422,8 +466,8 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
     )(offs, lset, ddt, qt, kt, vt, dot)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q),
+        functools.partial(_flash_bwd_dkv_kernel_res, causal=causal,
+                          scale=scale, block_q=block_q),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bh, tk // block_k),
@@ -457,6 +501,120 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
     return heads_minor(dq, tq), heads_minor(dk, tk), heads_minor(dv, tk)
 
 
+def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
+    """Blockwise backward for normalized flash attention, [B, T, H, D]
+    layout.  ``q_off``/``k_off`` are global sequence origins (traced scalars
+    OK — ring hops).  Returns (dq, dk, dv) in f32."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = _pick_block(tq)
+    block_k = _pick_block(tk)
+    bh = b * h
+
+    def heads_major(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, x.shape[1], d)
+
+    qt, kt, vt, dot = map(heads_major, (q, k, v, dout))
+    # D = rowsum(dout * out) per row — cheap and linear, precomputed in jnp
+    dd = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                 axis=-1)                              # [B, T, H]
+    ddt = dd.transpose(0, 2, 1).reshape(bh, tq, 1)
+    lset = lse.reshape(bh, tq, 1)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    interpret = _interpret()
+
+    # Two kernel layouts: whole-resident (one side of the score matrix
+    # stays in VMEM; ~20% faster at short T — no tile re-fetch) and
+    # streaming 3D-grid (every operand tiled through the grid; the only
+    # option once a full k/v or q/do side exceeds the VMEM budget).
+    if (tk * d * k.dtype.itemsize <= _BWD_RESIDENT_CAP
+            and tq * d * q.dtype.itemsize <= _BWD_RESIDENT_CAP):
+        return _flash_bwd_resident(
+            qt, kt, vt, dot, lset, ddt, offs, b, h, d, causal=causal,
+            scale=scale, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+
+    # Causal DMA elision: a fully-masked grid cell's kernel body is skipped
+    # by pl.when, but its input tiles would still be fetched. Clamping the
+    # dead cell's index map onto the nearest LIVE tile makes consecutive
+    # steps request the same index, which the Mosaic pipeline elides.
+    if causal:
+        def kmap(i, j, n, offs):
+            n_max = jnp.maximum(
+                (offs[0] + (j + 1) * block_q - 1 - offs[1]) // block_k, 0)
+            return (i, jnp.minimum(n, n_max), 0)
+
+        nq = tq // block_q
+
+        def qmap(i, j, n, offs):
+            lo = jnp.clip((offs[1] + j * block_k - offs[0]) // block_q,
+                          0, nq - 1)
+            return (i, jnp.maximum(n, lo), 0)
+    else:
+        kmap = qmap = lambda i, j, n, offs: (i, n, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # k innermost: consecutive grid steps revisit the same dq tile
+            grid=(bh, tq // block_q, tk // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, 1), lambda i, j, n, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, n, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j, n, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), kmap),
+                pl.BlockSpec((1, block_k, d), kmap),
+                pl.BlockSpec((1, block_q, d), lambda i, j, n, offs: (i, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j, n, offs: (i, j, 0)),
+        ),
+        out_shape=_struct((bh, tq, d), jnp.float32, qt, kt, offs),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * tq * tk * d,
+            bytes_accessed=4 * bh * (3 * tq * d + 2 * tk * d),
+            transcendentals=bh * tq * tk),
+        interpret=interpret,
+    )(offs, lset, ddt, qt, kt, vt, dot)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # q innermost: consecutive grid steps revisit the same dk/dv tiles
+            grid=(bh, tk // block_k, tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, 1), qmap),
+                pl.BlockSpec((1, block_q, 1), qmap),
+                pl.BlockSpec((1, block_q, d), qmap),
+                pl.BlockSpec((1, block_k, d), lambda i, j, n, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, n, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_q, d), qmap),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda i, j, n, offs: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, n, offs: (i, j, 0)),
+            ],
+        ),
+        out_shape=[
+            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
+            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=8 * bh * tq * tk * d,
+            bytes_accessed=4 * bh * (3 * tq * d + 3 * tk * d),
+            transcendentals=bh * tq * tk),
+        interpret=interpret,
+    )(offs, lset, ddt, qt, kt, vt, dot)
+
+    def heads_minor(x, t):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return heads_minor(dq, tq), heads_minor(dk, tk), heads_minor(dv, tk)
+
+
 def finalize_attention_stats(m, l, o, out_dtype):
     """(m, l, o) flash statistics → (normalized out, row-LSE). The
     fully-masked-row convention (l == 0 → out 0, LSE 0) is what the
@@ -468,13 +626,6 @@ def finalize_attention_stats(m, l, o, out_dtype):
     out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(out_dtype)
     lse = jnp.where(m == NEG_INF, 0.0, m) + jnp.log(l_safe)  # [B, H, T]
     return out, lse
-
-
-def _fullattn_bwd_supported(q, k) -> bool:
-    """The bwd kernels additionally keep q/do resident per (b,h) — cap tq
-    like step_supported caps tk."""
-    tq, d = q.shape[1], q.shape[3]
-    return tq * d * q.dtype.itemsize <= _KV_VMEM_CAP
 
 
 @functools.lru_cache(maxsize=None)
@@ -522,23 +673,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
     to plain jnp attention when the kernel is gated off or shapes are not
     tile-aligned.
     """
-    b, tq, h, d = q.shape
+    d = q.shape[-1]
     if scale is None:
         scale = d ** -0.5
     if not step_supported(q, k):
         from ..parallel.ring_attention import reference_attention
         return reference_attention(q, k, v, causal=causal, scale=scale)
-    if _fullattn_bwd_supported(q, k):
-        return _flash_fullattn_vjp(causal, float(scale))(q, k, v)
-    # long-q shapes: Pallas forward with the step-level jnp backward
-    m0 = jnp.full((b, h, tq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, tq), jnp.float32)
-    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
-    step = flash_step_vjp(causal, float(scale))
-    m, l, o = step(q, k, v, m0, l0, o0, 0, 0)
-    l_safe = jnp.where(l == 0, 1.0, l)
-    out = o / l_safe.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    return _flash_fullattn_vjp(causal, float(scale))(q, k, v)
 
 
 # ==================================================================== adasum

@@ -176,15 +176,13 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     from ..ops import pallas_kernels
 
     if pallas_kernels.step_supported(q, k):
-        if pallas_kernels._fullattn_bwd_supported(q, k):
-            # Pallas forward AND ring-structured Pallas backward
-            return _ring_fa_vjp(axis_name, causal, float(scale))(q, k, v)
-        # long shards: Pallas forward, per-hop rematerialized-jnp backward
-        step = pallas_kernels.flash_step_vjp(causal, float(scale))
-    else:
-        def step(qq, kk, vv, m, l, o, q_off, k_off):
-            return _block_attn(qq, kk, vv, m, l, o, q_off, k_off, causal,
-                               scale)
+        # Pallas forward AND ring-structured Pallas backward (the blockwise
+        # backward kernels cover any shard length — resident or streaming)
+        return _ring_fa_vjp(axis_name, causal, float(scale))(q, k, v)
+
+    def step(qq, kk, vv, m, l, o, q_off, k_off):
+        return _block_attn(qq, kk, vv, m, l, o, q_off, k_off, causal,
+                           scale)
 
     m, l, o = _ring_fwd_stats(q, k, v, axis_name, step)
     l_safe = jnp.where(l == 0, 1.0, l)

@@ -36,11 +36,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                       attn_fn: Optional[Callable] = None):
     """Attention over sequence-sharded q/k/v ([B, T/sp, H, D] per shard) via
     the Ulysses two-all-to-all pattern. ``attn_fn(q, k, v, causal=...)``
-    computes full attention on [B, T, H/sp, D] (default: exact softmax
-    attention)."""
-    from .ring_attention import reference_attention
+    computes full attention on [B, T, H/sp, D] (default: the Pallas flash
+    kernel with its FlashAttention-2 backward, which itself falls back to
+    exact jnp attention when shapes/gating rule it out)."""
+    from ..ops.pallas_kernels import flash_attention
 
-    attn_fn = attn_fn or reference_attention
+    attn_fn = attn_fn or flash_attention
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)

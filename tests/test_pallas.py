@@ -222,6 +222,26 @@ def test_ring_attention_grad_with_pallas_step():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_streaming_variant(causal, monkeypatch):
+    """Force the 3D-grid streaming backward (long-sequence layout) by
+    shrinking the VMEM budget: grads must match the resident variant's
+    reference."""
+    monkeypatch.setattr(pk, "_BWD_RESIDENT_CAP", 1)  # force streaming
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), 1, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(12), q.shape, q.dtype)
+
+    g_pk = jax.grad(
+        lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_fa2_backward_4dev(causal):
     """The ring-structured FlashAttention-2 backward (second ring pass: dq
     local, dk/dv rotating home with their blocks) across 4 devices, with a

@@ -189,6 +189,38 @@ def test_check_build_report():
 
 
 @pytest.mark.integration
+def test_hvdrun_tf_graph_mode(tmp_path):
+    """Graph-mode (tf.function) collectives across REAL processes: a
+    compiled train step with DistributedGradientTape under the coordinated
+    control plane — the deployment shape the in-process rig can't fully
+    represent (one rank per process, own TF runtime each)."""
+    pytest.importorskip("tensorflow")
+    r = _run_hvdrun(tmp_path, """
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd_tf
+
+        w = tf.Variable([1.0, 2.0])
+
+        @tf.function
+        def step(x):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(w * x)
+            dtape = hvd_tf.DistributedGradientTape(tape)
+            g = dtape.gradient(loss, [w])[0]
+            w.assign_sub(0.1 * g)
+            return g
+
+        g = step(tf.fill((2,), float(hvd.rank() + 1)))
+        # average of per-rank dy (=rank+1) over 2 ranks = 1.5
+        print("GRAD", [round(float(v), 3) for v in g.numpy()])
+        print("W", [round(float(v), 3) for v in w.numpy()])
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("GRAD [1.5, 1.5]") == 2, r.stdout
+    assert r.stdout.count("W [0.85, 1.85]") == 2, r.stdout
+
+
+@pytest.mark.integration
 def test_hvdrun_cli_smoke(tmp_path):
     """hvdrun CLI end-to-end on 2 local ranks."""
     r = _run_hvdrun(tmp_path, """
