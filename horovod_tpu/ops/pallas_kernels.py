@@ -150,11 +150,126 @@ def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
     oo_ref[0] = o
 
 
+def _flash_step_stream_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
+                              o_ref, mo_ref, lo_ref, oo_ref, *, causal,
+                              scale):
+    """Streaming forward: one (q tile, k tile) grid cell of flash
+    accumulation. The k grid dimension is innermost and revisits the same
+    (m, l, o) output tiles, so VMEM holds single tiles regardless of
+    sequence length; the carried-in statistics seed the outputs on the
+    first k step (ring hops carry (m, l, o) across calls)."""
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+    in_dt = q_ref.dtype
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1] + jk * bk
+
+    @pl.when(jk == 0)
+    def _():
+        mo_ref[0] = m_ref[0]
+        lo_ref[0] = l_ref[0]
+        oo_ref[0] = o_ref[0].astype(jnp.float32)
+
+    live = (q_off + bq - 1 >= k_off) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]                                  # [BQ, D]
+        k = k_ref[0]                                  # [BK, D]
+        v = v_ref[0]
+        m = mo_ref[0, :, 0]                           # f32 [BQ]
+        l = lo_ref[0, :, 0]
+        o = oo_ref[0]                                 # f32 [BQ, D]
+        s = scale * lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])              # exp(-inf) == 0
+        alpha = jnp.exp(m - m_safe)                   # m=-inf -> 0
+        pv = lax.dot_general(p.astype(in_dt), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        mo_ref[0, :, 0] = m_new
+        lo_ref[0, :, 0] = l * alpha + jnp.sum(p, axis=-1)
+        oo_ref[0] = o * alpha[:, None] + pv
+
+
+def _causal_maps(causal, block_q, block_k, nq):
+    """Index maps for streaming grids with causal DMA elision: a fully-
+    masked cell's kernel body is skipped by pl.when, but its input tiles
+    would still be fetched — clamping the dead cell's map onto the nearest
+    LIVE tile makes consecutive steps request the same index, which the
+    Mosaic pipeline elides. Returns (kmap, qmap): the k/v-side map for
+    (bh, iq, jk-innermost) grids and the q/do-side map for
+    (bh, jk, iq-innermost) grids."""
+    if not causal:
+        passthrough = lambda i, j, n, offs: (i, n, 0)
+        return passthrough, passthrough
+
+    def kmap(i, j, n, offs):
+        n_max = jnp.maximum(
+            (offs[0] + (j + 1) * block_q - 1 - offs[1]) // block_k, 0)
+        return (i, jnp.minimum(n, n_max), 0)
+
+    def qmap(i, j, n, offs):
+        lo = jnp.clip((offs[1] + j * block_k - offs[0]) // block_q,
+                      0, nq - 1)
+        return (i, jnp.maximum(n, lo), 0)
+
+    return kmap, qmap
+
+
+def _flash_step_call_streaming(qt, kt, vt, mt, lt, ot, offs, *, causal,
+                               scale, block_q, block_k, interpret):
+    """Streaming-layout dispatch of the forward step (k/v too long to keep
+    resident)."""
+    bh, tq, d = qt.shape
+    tk = kt.shape[1]
+
+    kmap, _ = _causal_maps(causal, block_q, block_k, tq // block_q)
+    qtile = pl.BlockSpec((1, block_q, d), lambda i, j, n, offs: (i, j, 0))
+    stat = pl.BlockSpec((1, block_q, 1), lambda i, j, n, offs: (i, j, 0))
+
+    return pl.pallas_call(
+        functools.partial(_flash_step_stream_kernel, causal=causal,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, tq // block_q, tk // block_k),
+            in_specs=[
+                qtile,
+                pl.BlockSpec((1, block_k, d), kmap),
+                pl.BlockSpec((1, block_k, d), kmap),
+                stat, stat, qtile,
+            ],
+            out_specs=[stat, stat, qtile],
+        ),
+        out_shape=[
+            _struct((bh, tq, 1), jnp.float32, qt, kt, mt, offs),
+            _struct((bh, tq, 1), jnp.float32, qt, kt, mt, offs),
+            _struct((bh, tq, d), jnp.float32, qt, kt, mt, offs),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * tq * tk * d,
+            bytes_accessed=4 * (2 * bh * tq * d + 2 * bh * tk * d),
+            transcendentals=bh * tq * tk),
+        interpret=interpret,
+    )(offs, qt, kt, vt, mt, lt, ot)
+
+
 def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
                      block_q, block_k, interpret):
     """qt/ot: [BH, T, D]; kt/vt: [BH, TK, D]; mt/lt: [BH, T, 1] f32."""
     bh, tq, d = qt.shape
     tk = kt.shape[1]
+    if tk * d * kt.dtype.itemsize > _KV_VMEM_CAP:
+        return _flash_step_call_streaming(
+            qt, kt, vt, mt, lt, ot, offs, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret)
     grid = (bh, tq // block_q)
     kernel = functools.partial(_flash_step_kernel, causal=causal, scale=scale,
                                block_k=block_k)
@@ -193,8 +308,11 @@ def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
 
 
 # Per-operand VMEM budget for the resident k/v block: the pipeline double-
-# buffers input blocks, so worst-case VMEM ≈ 2 (buffering) × 2 (k+v) × this.
-_KV_VMEM_CAP = 3 * 2 ** 20
+# buffers input blocks, so worst-case VMEM ≈ 2 (buffering) × 2 (k+v) × this
+# plus the q/o tiles. Measured on v5e: 1 MB/operand (seq 8192 at d=64 bf16)
+# compiles within the 16 MB scoped-VMEM limit, 2 MB (seq 16384) does not —
+# longer k/v take the streaming forward.
+_KV_VMEM_CAP = 2 ** 20
 # Budget for the backward's whole-resident layout; beyond it _flash_bwd
 # switches to the streaming 3D-grid kernels (any length works there).
 # Tighter than the forward's: the resident dkv pass holds q AND do (plus
@@ -206,15 +324,15 @@ _BWD_RESIDENT_CAP = 512 * 2 ** 10
 
 def step_supported(q, k) -> bool:
     """True if ``flash_attention_step`` can run these shapes as a TPU kernel
-    (tile-aligned seq lens, lane-aligned head dim, k/v block fits VMEM)."""
+    (tile-aligned seq lens, lane-aligned head dim — no length cap: k/v
+    beyond the resident VMEM budget take the streaming layout)."""
     if mode() == "off":
         return False
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if d % 128 != 0 and d not in (64,):  # MXU lane width; 64 still maps
         return False
-    if tk * d * k.dtype.itemsize > _KV_VMEM_CAP:
-        return False  # longer K shards must fall back until k/v is grid-tiled
+    # no length cap: k/v beyond _KV_VMEM_CAP take the streaming forward
     if vma_active(q, k):
         return False
     return (_pick_block(tq) is not None and _pick_block(tk) is not None)
@@ -433,10 +551,11 @@ def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
                                      preferred_element_type=jnp.float32)
 
 
-def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, b, h, d, *,
+def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
                         causal, scale, block_q, block_k, interpret):
     """Whole-resident backward dispatch: dq pass keeps full k/v in VMEM,
-    dkv pass keeps full q/do in VMEM (heads-major [BH, T, D] operands)."""
+    dkv pass keeps full q/do in VMEM (heads-major [BH, T, D] operands in,
+    heads-major f32 gradients out)."""
     bh, tq = qt.shape[0], qt.shape[1]
     tk = kt.shape[1]
 
@@ -495,10 +614,7 @@ def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, b, h, d, *,
         interpret=interpret,
     )(offs, lset, ddt, qt, kt, vt, dot)
 
-    def heads_minor(x, t):
-        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-
-    return heads_minor(dq, tq), heads_minor(dk, tk), heads_minor(dv, tk)
+    return dq, dk, dv
 
 
 def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
@@ -530,29 +646,14 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
     # option once a full k/v or q/do side exceeds the VMEM budget).
     if (tk * d * k.dtype.itemsize <= _BWD_RESIDENT_CAP
             and tq * d * q.dtype.itemsize <= _BWD_RESIDENT_CAP):
-        return _flash_bwd_resident(
-            qt, kt, vt, dot, lset, ddt, offs, b, h, d, causal=causal,
+        dq, dk, dv = _flash_bwd_resident(
+            qt, kt, vt, dot, lset, ddt, offs, d, causal=causal,
             scale=scale, block_q=block_q, block_k=block_k,
             interpret=interpret)
+        return (_heads_minor(dq, b, h, tq, d), _heads_minor(dk, b, h, tk, d),
+                _heads_minor(dv, b, h, tk, d))
 
-    # Causal DMA elision: a fully-masked grid cell's kernel body is skipped
-    # by pl.when, but its input tiles would still be fetched. Clamping the
-    # dead cell's index map onto the nearest LIVE tile makes consecutive
-    # steps request the same index, which the Mosaic pipeline elides.
-    if causal:
-        def kmap(i, j, n, offs):
-            n_max = jnp.maximum(
-                (offs[0] + (j + 1) * block_q - 1 - offs[1]) // block_k, 0)
-            return (i, jnp.minimum(n, n_max), 0)
-
-        nq = tq // block_q
-
-        def qmap(i, j, n, offs):
-            lo = jnp.clip((offs[1] + j * block_k - offs[0]) // block_q,
-                          0, nq - 1)
-            return (i, jnp.maximum(n, lo), 0)
-    else:
-        kmap = qmap = lambda i, j, n, offs: (i, n, 0)
+    kmap, qmap = _causal_maps(causal, block_q, block_k, tq // block_q)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
@@ -609,10 +710,13 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
         interpret=interpret,
     )(offs, lset, ddt, qt, kt, vt, dot)
 
-    def heads_minor(x, t):
-        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return (_heads_minor(dq, b, h, tq, d), _heads_minor(dk, b, h, tk, d),
+            _heads_minor(dv, b, h, tk, d))
 
-    return heads_minor(dq, tq), heads_minor(dk, tk), heads_minor(dv, tk)
+
+def _heads_minor(x, b, h, t, d):
+    """[BH, T, D] → [B, T, H, D] (inverse of the heads-major packing)."""
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 def finalize_attention_stats(m, l, o, out_dtype):

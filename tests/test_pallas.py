@@ -222,6 +222,31 @@ def test_ring_attention_grad_with_pallas_step():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_flash_streaming_forward_variant(causal, monkeypatch):
+    """Force the streaming FORWARD layout (k/v too long to keep resident):
+    output and grads must match exact attention."""
+    monkeypatch.setattr(pk, "_KV_VMEM_CAP", 1)
+    pk._flash_fullattn_vjp.cache_clear()
+    q, k, v = _rand_qkv(jax.random.PRNGKey(13), 1, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(14), q.shape, q.dtype)
+
+    out = pk.flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(reference_attention(q, k, v, causal=causal)),
+        rtol=2e-5, atol=2e-5)
+    g_pk = jax.grad(
+        lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_flash_bwd_streaming_variant(causal, monkeypatch):
     """Force the 3D-grid streaming backward (long-sequence layout) by
     shrinking the VMEM budget: grads must match the resident variant's
