@@ -30,6 +30,68 @@ from .. import _reduce_grads_and_vars
 from . import callbacks  # noqa: F401
 
 
+def _make_distributed_class(base_cls, compression, op, sparse_as_dense):
+    """Dynamic subclass of a Keras optimizer class whose ``apply`` reduces
+    gradients first (the reference's `_keras/__init__.py:20-33` technique).
+    Shared by the wrap factory and ``load_model``'s custom_objects."""
+    hvd_kw = dict(compression=compression, op=op,
+                  sparse_as_dense=sparse_as_dense)
+
+    class _Distributed(base_cls):
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            # cover BOTH call shapes: explicit variables and the stored-
+            # variables form (opt.apply(grads)) — skipping reduction for
+            # the latter would silently diverge the replicas
+            tvars = trainable_variables
+            if tvars is None:
+                tvars = list(getattr(self, "_trainable_variables", None)
+                             or [])
+                if not tvars:
+                    raise RuntimeError(
+                        "optimizer.apply(grads) before build(): no "
+                        "variables to reduce against")
+            reduced = _reduce_grads_and_vars(
+                list(zip(grads, tvars)), **hvd_kw)
+            grads2 = [g for g, _ in reduced]
+            if trainable_variables is None:
+                return super().apply(grads2, **kwargs)
+            return super().apply(grads2, trainable_variables, **kwargs)
+
+    _Distributed.__name__ = "Distributed" + base_cls.__name__
+    return _Distributed
+
+
+def load_model(path, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none, op: int = Average,
+               sparse_as_dense: bool = False):
+    """Load a tf.keras model saved with a DistributedOptimizer, re-wrapping
+    the deserialized optimizer (`keras/__init__.py:111-127` parity): the
+    saved config references the dynamic ``Distributed<Name>`` class, which
+    is re-created here for every standard Keras optimizer — plus any
+    user-defined classes passed via ``custom_optimizers`` (the reference's
+    parameter) — and passed as custom_objects.
+
+    The wrap settings (``compression``/``op``/``sparse_as_dense``) are NOT
+    stored in the saved config (it is the base optimizer's config, as in
+    the reference); a model trained with non-default settings must re-pass
+    them here or training resumes with Average/no-compression."""
+    import tensorflow as tf
+
+    customs = dict(custom_objects or {})
+    bases = [getattr(tf.keras.optimizers, name)
+             for name in dir(tf.keras.optimizers)]
+    bases += list(custom_optimizers or [])
+    for base in bases:
+        if isinstance(base, type) and issubclass(
+                base, tf.keras.optimizers.Optimizer) \
+                and base.__name__[:1].isupper():
+            customs.setdefault(
+                "Distributed" + base.__name__,
+                _make_distributed_class(base, compression, op,
+                                        sparse_as_dense))
+    return tf.keras.models.load_model(path, custom_objects=customs)
+
+
 def DistributedOptimizer(optimizer, compression=Compression.none,
                          op: int = Average, sparse_as_dense: bool = False):
     """Keras-compatible distributed optimizer: a dynamic SUBCLASS of the
@@ -59,28 +121,5 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
             "DistributedOptimizer for model.compile requires Keras 3 "
             "(tf >= 2.16); on older TF use horovod_tpu.tensorflow."
             "DistributedOptimizer with a manual train loop")
-    hvd_kw = dict(compression=compression, op=op,
-                  sparse_as_dense=sparse_as_dense)
-
-    class _Distributed(base_cls):
-        def apply(self, grads, trainable_variables=None, **kwargs):
-            # cover BOTH call shapes: explicit variables and the stored-
-            # variables form (opt.apply(grads)) — skipping reduction for
-            # the latter would silently diverge the replicas
-            tvars = trainable_variables
-            if tvars is None:
-                tvars = list(getattr(self, "_trainable_variables", None)
-                             or [])
-                if not tvars:
-                    raise RuntimeError(
-                        "optimizer.apply(grads) before build(): no "
-                        "variables to reduce against")
-            reduced = _reduce_grads_and_vars(
-                list(zip(grads, tvars)), **hvd_kw)
-            grads2 = [g for g, _ in reduced]
-            if trainable_variables is None:
-                return super().apply(grads2, **kwargs)
-            return super().apply(grads2, trainable_variables, **kwargs)
-
-    _Distributed.__name__ = "Distributed" + base_cls.__name__
-    return _Distributed.from_config(optimizer.get_config())
+    cls = _make_distributed_class(base_cls, compression, op, sparse_as_dense)
+    return cls.from_config(optimizer.get_config())

@@ -317,6 +317,45 @@ def test_keras_jit_compile_true_fails_fast():
     assert all(testing.run_cluster(fn, np=2))
 
 
+def test_keras_save_load_model_rewraps(tmp_path):
+    """model.save with the wrapped optimizer, then hvd.load_model: the
+    deserialized optimizer is re-created as the dynamic Distributed class
+    via custom_objects (`keras/__init__.py:111-127` parity) and fit
+    continues reducing across ranks."""
+    path = str(tmp_path / "m.keras")
+
+    def fn():
+        r = hvd.rank()
+        rng = np.random.RandomState(r)
+        x = rng.randn(8, 4).astype(np.float32)
+        y = rng.randn(8, 1).astype(np.float32)
+        model = tf.keras.Sequential(
+            [tf.keras.Input((4,)), tf.keras.layers.Dense(1)])
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.1))
+        model.compile(optimizer=opt, loss="mse", jit_compile=False)
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+        if r == 0:
+            model.save(path)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+    def fn2():
+        r = hvd.rank()
+        model = hvd_keras.load_model(path)
+        assert type(model.optimizer).__name__ == "DistributedSGD"
+        rng = np.random.RandomState(10 + r)
+        x = rng.randn(8, 4).astype(np.float32)
+        y = rng.randn(8, 1).astype(np.float32)
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+        return [w.copy() for w in model.get_weights()]
+
+    weights = testing.run_cluster(fn2, np=2)
+    for w0, w1 in zip(*weights):
+        np.testing.assert_allclose(w0, w1, rtol=1e-5)
+
+
 def test_graph_keras_fit_compiled():
     """model.fit WITHOUT run_eagerly: the keras DistributedOptimizer's
     reduction runs inside the fit tf.function through the graph path, and
