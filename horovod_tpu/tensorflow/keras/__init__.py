@@ -34,6 +34,14 @@ def _make_distributed_class(base_cls, compression, op, sparse_as_dense):
     """Dynamic subclass of a Keras optimizer class whose ``apply`` reduces
     gradients first (the reference's `_keras/__init__.py:20-33` technique).
     Shared by the wrap factory and ``load_model``'s custom_objects."""
+    if not hasattr(base_cls, "apply"):
+        # Keras 2 optimizers have no apply() funnel — the override below
+        # would be dead code and training would silently run unsynchronized
+        raise RuntimeError(
+            "the distributed tf.keras optimizer requires Keras 3 "
+            "(tf >= 2.16); on older TF use horovod_tpu.tensorflow."
+            "DistributedOptimizer with a manual train loop "
+            f"(got {base_cls.__name__} without an apply() method)")
     hvd_kw = dict(compression=compression, op=op,
                   sparse_as_dense=sparse_as_dense)
 
@@ -78,9 +86,12 @@ def load_model(path, custom_optimizers=None, custom_objects=None,
     import tensorflow as tf
 
     customs = dict(custom_objects or {})
-    bases = [getattr(tf.keras.optimizers, name)
-             for name in dir(tf.keras.optimizers)]
-    bases += list(custom_optimizers or [])
+    # user classes FIRST: setdefault is first-write-wins, and a custom
+    # subclass shadowing a builtin name must take precedence (reference
+    # custom_optimizers semantics)
+    bases = list(custom_optimizers or [])
+    bases += [getattr(tf.keras.optimizers, name)
+              for name in dir(tf.keras.optimizers)]
     for base in bases:
         if isinstance(base, type) and issubclass(
                 base, tf.keras.optimizers.Optimizer) \
@@ -113,13 +124,6 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
         raise NotImplementedError(
             "op=Adasum inside model.compile is not supported; use the "
             "eager DistributedAdasumOptimizer with a manual train loop")
-    base_cls = optimizer.__class__
-    if not hasattr(base_cls, "apply"):
-        # Keras 2 optimizers have no apply() funnel — the override below
-        # would be dead code and training would silently run unsynchronized
-        raise RuntimeError(
-            "DistributedOptimizer for model.compile requires Keras 3 "
-            "(tf >= 2.16); on older TF use horovod_tpu.tensorflow."
-            "DistributedOptimizer with a manual train loop")
-    cls = _make_distributed_class(base_cls, compression, op, sparse_as_dense)
+    cls = _make_distributed_class(optimizer.__class__, compression, op,
+                                  sparse_as_dense)
     return cls.from_config(optimizer.get_config())

@@ -124,6 +124,76 @@ def test_autotune_parameter_manager(monkeypatch):
     assert eng.controller.fusion_threshold() > 0
 
 
+def test_wire_request_response_roundtrip_randomized():
+    """Property-style codec check: random request/response lists — unicode
+    names, empty and high-rank shapes, every request type, extreme scale
+    factors — survive encode→decode bit-exactly (the coordinator protocol's
+    wire contract, `runtime/wire.py` ↔ `message.h` serialization role)."""
+    from horovod_tpu.runtime import wire
+    from horovod_tpu.runtime.messages import Response, ResponseType
+
+    rng = np.random.RandomState(7)
+    names = ["t", "grad.層.0", "a" * 300, "noname.%d", "s p a c e", "", "好"]
+    dtypes = ["float32", "float64", "bfloat16", "int32", "int64", "uint8"]
+    for trial in range(25):
+        flags = int(rng.randint(0, 2))
+        cached = [int(x) for x in rng.randint(0, 2 ** 31, rng.randint(0, 5))]
+        reqs = []
+        for _ in range(rng.randint(0, 6)):
+            shape = tuple(int(x) for x in
+                          rng.randint(0, 2 ** 40, rng.randint(0, 5)))
+            reqs.append(wire.ReqMeta(
+                names[rng.randint(len(names))],
+                int(rng.randint(0, 5)),
+                dtypes[rng.randint(len(dtypes))], shape,
+                root_rank=int(rng.randint(-1, 8)),
+                average=bool(rng.randint(2)),
+                prescale=float(rng.choice([1.0, 1e-30, 1e30, -2.5])),
+                postscale=float(rng.choice([1.0, 0.5]))))
+        buf = wire.encode_request_list(flags, cached, reqs)
+        f2, c2, r2 = wire.decode_request_list(buf)
+        assert (f2, c2) == (flags, cached)
+        assert [m.sig() for m in r2] == [m.sig() for m in reqs]
+
+        resps, cids = [], []
+        for _ in range(rng.randint(0, 4)):
+            n = rng.randint(1, 4)
+            shp = [tuple(int(x) for x in rng.randint(0, 2 ** 40, 2))
+                   for _ in range(n)]
+            resps.append(Response(
+                response_type=ResponseType(int(rng.randint(1, 6))),
+                tensor_names=[names[rng.randint(len(names))]
+                              for _ in range(n)],
+                error_message="boom ✗" if rng.randint(2) else "",
+                tensor_dtype=dtypes[rng.randint(len(dtypes))],
+                average=bool(rng.randint(2)),
+                prescale=float(rng.choice([1.0, 1e-30, -3.5])),
+                postscale=float(rng.choice([1.0, 2.0])),
+                root_rank=int(rng.randint(-1, 8)),
+                tensor_shapes=shp,
+                tensor_sizes=[[int(x) for x in rng.randint(0, 100, 3)]
+                              for _ in range(n)]))
+            cids.append([int(x) for x in rng.randint(-1, 100, n)])
+        warns = [names[rng.randint(len(names))]
+                 for _ in range(rng.randint(0, 3))]
+        reason = "lost peer ✗" if rng.randint(2) else ""
+        buf = wire.encode_response_list(3, -1, resps, cids, warns, reason)
+        f2, last2, r2, c2, w2, reason2 = wire.decode_response_list(buf)
+        assert (f2, reason2, last2, w2) == (3, reason, -1, warns)
+        assert c2 == cids
+        for a, b in zip(r2, resps):
+            assert a.response_type == b.response_type
+            assert a.tensor_names == b.tensor_names
+            assert a.error_message == b.error_message
+            assert a.tensor_dtype == b.tensor_dtype
+            assert a.average == b.average
+            assert (a.prescale, a.postscale) == (b.prescale, b.postscale)
+            assert a.root_rank == b.root_rank
+            assert tuple(map(tuple, a.tensor_shapes)) == \
+                tuple(map(tuple, b.tensor_shapes))
+            assert [list(s) for s in a.tensor_sizes] == b.tensor_sizes
+
+
 def test_wire_roundtrip_python_decoder():
     """Python wire decoder agrees with the C++ encoder (tick payloads)."""
     from horovod_tpu.runtime import wire
