@@ -1,0 +1,5 @@
+"""Launcher package: CLI (`hvdrun`, `launcher.py`) and the programmatic
+func API, re-exported so ``from horovod_tpu.run import run`` mirrors the
+reference's `from horovod.run import run` (`run/run.py:863-947`)."""
+
+from .api import run  # noqa: F401
