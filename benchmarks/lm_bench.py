@@ -46,7 +46,8 @@ def main():
 
     import horovod_tpu as hvd
     from horovod_tpu import spmd
-    from horovod_tpu.models.transformer import TransformerLM, lm_loss
+    from horovod_tpu.models.transformer import (
+        TransformerLM, lm_loss, lm_loss_chunked)
 
     hvd.init()
     on_tpu = jax.default_backend() == "tpu"
@@ -59,10 +60,48 @@ def main():
     vocab = int(os.environ.get("LM_VOCAB", "32768" if on_tpu else "256"))
     batch, seq = cfg["batch"] * hvd.num_replicas(), cfg["seq"]
 
+    # perf levers (each delta measured in docs/benchmarks.md):
+    #   remat=full    — recompute block internals in backward; batch 32 fits
+    #   chunked loss  — never materialize [B,T,vocab] fp32 logits
+    #   mu_dtype=bf16 — halve AdamW first-moment HBM
+    #   donation      — update params/opt state in place (no double buffer)
+    remat = os.environ.get("LM_REMAT", "full" if on_tpu else "none")
+    attn = os.environ.get("LM_ATTN", "pallas")
+    chunked = os.environ.get("LM_CHUNKED_LOSS", "1") == "1"
+    mu_dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[
+        os.environ.get("LM_MU_DTYPE", "bf16")]
+    donate = os.environ.get("LM_DONATE", "1") == "1"
+
+    attn_fn = None
+    if attn == "xla":
+        attn_fn = lambda q, k, v: jax.nn.dot_product_attention(
+            q, k, v, is_causal=True)
+    elif attn == "naive":
+        def attn_fn(q, k, v):
+            d = q.shape[-1]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) / np.sqrt(d)
+            t = q.shape[1]
+            mask = np.tril(np.ones((t, t), bool))
+            p = jax.nn.softmax(jnp.where(mask, s, -1e30), -1).astype(q.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    elif attn == "upstream":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _jf)
+        def attn_fn(q, k, v):
+            d = q.shape[-1]
+            o = _jf(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                    v.transpose(0, 2, 1, 3), causal=True,
+                    sm_scale=1.0 / float(np.sqrt(d)))
+            return o.transpose(0, 2, 1, 3)
+    elif attn != "pallas":
+        raise ValueError(f"LM_ATTN={attn!r}: expected pallas|xla|naive|upstream")
+
     model = TransformerLM(
         vocab_size=vocab, num_layers=cfg["num_layers"],
         num_heads=cfg["num_heads"], d_model=cfg["d_model"],
-        max_seq_len=seq, dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+        max_seq_len=seq, dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        remat=remat, attn_fn=attn_fn)
 
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(0, vocab, (batch, seq + 1)))
@@ -75,7 +114,7 @@ def main():
     n_emb = params["tok_emb"]["embedding"].size + params["pos_emb"].size
     n_nonemb = n_params - n_emb
 
-    tx = optax.adamw(3e-4, weight_decay=0.01)
+    tx = optax.adamw(3e-4, weight_decay=0.01, mu_dtype=mu_dtype)
     opt_state = tx.init(params)
     mesh = hvd.mesh()
     params = spmd.replicate(params, mesh)
@@ -83,8 +122,13 @@ def main():
     tokens = spmd.shard_batch(tokens, mesh)
     targets = spmd.shard_batch(targets, mesh)
 
-    def loss_fn(p, x, y):
-        return lm_loss(model.apply({"params": p}, x), y)
+    if chunked:
+        def loss_fn(p, x, y):
+            hid = model.apply({"params": p}, x, return_hidden=True)
+            return lm_loss_chunked(hid, p["tok_emb"]["embedding"], y)
+    else:
+        def loss_fn(p, x, y):
+            return lm_loss(model.apply({"params": p}, x), y)
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     repl = NamedSharding(mesh, P())
@@ -94,7 +138,8 @@ def main():
         updates, opt = tx.update(grads, opt, p)
         return optax.apply_updates(p, updates), opt, loss
 
-    jitted = jax.jit(_step, out_shardings=(repl, repl, repl))
+    jitted = jax.jit(_step, out_shardings=(repl, repl, repl),
+                     donate_argnums=(0, 1) if donate else ())
     step = jitted
     if on_tpu:
         try:

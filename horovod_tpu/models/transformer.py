@@ -73,6 +73,19 @@ class Block(nn.Module):
         return x + h
 
 
+#: rematerialization policies for ``TransformerLM(remat=...)``, mapping mode
+#: name -> (wrap_in_remat, jax.checkpoint policy). "full" recomputes
+#: everything inside each block during backward (activation memory = one
+#: [B,T,D] residual per layer — the lever that lets batch 32+ fit at seq
+#: 1024 in 16 GB HBM); "dots" saves matmul outputs and recomputes only
+#: elementwise ops (cheaper backward, more memory).
+REMAT_POLICIES = {
+    "none": (False, None),
+    "full": (True, None),
+    "dots": (True, jax.checkpoint_policies.dots_with_no_batch_dims_saveable),
+}
+
+
 class TransformerLM(nn.Module):
     vocab_size: int
     num_layers: int = 12
@@ -81,11 +94,19 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     attn_fn: Optional[AttnFn] = None  # default: causal flash attention
+    remat: str = "none"  # "none" | "full" | "dots" — see REMAT_POLICIES
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):
+    def __call__(self, tokens, pos_offset=0, return_hidden=False):
         """tokens: int [B, T_local]; pos_offset: global position of column 0
-        (nonzero when the sequence axis is sharded across devices)."""
+        (nonzero when the sequence axis is sharded across devices).
+
+        ``return_hidden=True`` skips the weight-tied logit head and returns
+        the final-LN hidden states [B, T, d_model] — pair with
+        ``lm_loss_chunked`` to compute the cross entropy without ever
+        materializing the [B, T, vocab] logits (the logits alone are
+        batch·seq·vocab·4 bytes; at batch 32, seq 1024, vocab 32k that is
+        4.3 GB of HBM the chunked path never allocates)."""
         attn = self.attn_fn if self.attn_fn is not None else default_attention
         emb = nn.Embed(self.vocab_size, self.d_model,
                        embedding_init=nn.initializers.normal(0.02),
@@ -121,10 +142,18 @@ class TransformerLM(nn.Module):
                 f"max_seq_len={self.max_seq_len}")
         pos = pos_offset + jnp.arange(t)
         x = emb(tokens) + jnp.take(pos_table, pos, axis=0).astype(self.dtype)
+        if self.remat not in REMAT_POLICIES:
+            raise ValueError(f"remat={self.remat!r}; expected one of "
+                             f"{sorted(REMAT_POLICIES)}")
+        use_remat, policy = REMAT_POLICIES[self.remat]
+        block_cls = nn.remat(Block, policy=policy) if use_remat else Block
         for i in range(self.num_layers):
-            x = Block(self.num_heads, self.dtype, attn, name=f"block_{i}")(x)
+            x = block_cls(self.num_heads, self.dtype, attn,
+                          name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_f")(x)
+        if return_hidden:
+            return x
         # weight-tied head: logits = x @ tok_emb.T
         logits = emb.attend(x.astype(jnp.float32))
         return logits.astype(jnp.float32)
@@ -136,6 +165,44 @@ def lm_loss(logits, targets):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def lm_loss_chunked(hidden, emb_table, targets, chunk_tokens=2048):
+    """Weight-tied-head cross entropy WITHOUT materializing [B, T, vocab].
+
+    ``hidden``: final hidden states from ``apply(..., return_hidden=True)``;
+    ``emb_table``: the token embedding matrix [vocab, d_model] (fp32 param);
+    ``targets``: int [B, T]. Tokens are processed ``chunk_tokens`` at a time
+    under a rematerialized ``lax.scan``: the forward keeps only the scalar
+    partial sums, and the backward recomputes each chunk's logits on the fly
+    — peak extra HBM is O(chunk_tokens · vocab) instead of O(B·T·vocab).
+    The head matmul runs in bf16 with fp32 accumulation
+    (``preferred_element_type``), which is the MXU-native contraction; the
+    log-softmax itself stays fp32. Equivalent to
+    ``lm_loss(emb.attend(hidden), targets)`` up to bf16 rounding of the
+    pre-softmax logits.
+    """
+    b, t, d = hidden.shape
+    total = b * t
+    # largest chunk <= chunk_tokens that divides the token count, so every
+    # (batch, seq) the full-logit path accepted works here too
+    chunk = min(chunk_tokens, total)
+    while total % chunk:
+        chunk -= 1
+    emb_t = emb_table.astype(jnp.bfloat16).T  # [d, vocab]
+    h = hidden.astype(jnp.bfloat16).reshape(total // chunk, chunk, d)
+    y = targets.reshape(total // chunk, chunk)
+
+    @jax.checkpoint
+    def body(acc, xs):
+        hc, yc = xs
+        logits = jnp.dot(hc, emb_t, preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, yc[:, None], axis=-1)[:, 0]
+        return acc + jnp.sum(ll), None
+
+    total_ll, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, y))
+    return -total_ll / total
 
 
 # compact configs for tests / dry runs / benches

@@ -80,8 +80,15 @@ def vma_active(*arrays) -> bool:
     return any(getattr(jax.typeof(x), "vma", frozenset()) for x in arrays)
 
 
-def _pick_block(t: int, preferred: int = 128) -> Optional[int]:
-    """Largest power-of-2 tile ≤ preferred dividing t (None if none ≥ 8)."""
+def _pick_block(t: int, preferred: int = None) -> Optional[int]:
+    """Largest power-of-2 tile ≤ preferred dividing t (None if none ≥ 8).
+
+    Default tile edge comes from ``HVD_PALLAS_BLOCK`` (256 if unset): bigger
+    tiles mean quadratically fewer grid cells — measured 26.7k → 31.1k tok/s
+    on the lm_bench step going 128 → 256 on a v5e, where per-cell grid
+    overhead, not FLOPs, dominated the attention kernels."""
+    if preferred is None:
+        preferred = int(os.environ.get("HVD_PALLAS_BLOCK", "256"))
     b = preferred
     while b >= 8:
         if t % b == 0:

@@ -172,3 +172,74 @@ def test_sp_over_length_global_sequence_fails_loudly():
 def test_sp_mesh_validation():
     with pytest.raises(ValueError, match="need 16 devices"):
         make_dp_sp_mesh(dp=4, sp=4)
+
+
+# ----------------------------------------------- remat + chunked-loss levers
+def test_remat_matches_no_remat():
+    """jax.checkpoint must change memory, never math: grads bit-compare."""
+    from horovod_tpu.models.transformer import lm_loss
+    rng = np.random.RandomState(3)
+    tokens, targets = _data(rng, 2, 64)
+    base = TransformerLMTiny(vocab_size=VOCAB, dtype=jnp.float32)
+    params = base.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def grads_for(remat):
+        m = TransformerLMTiny(vocab_size=VOCAB, dtype=jnp.float32,
+                              remat=remat)
+        g = jax.grad(lambda p: lm_loss(m.apply({"params": p}, tokens),
+                                       targets))(params)
+        return jax.tree_util.tree_leaves(g)
+
+    ref = grads_for("none")
+    for mode in ("full", "dots"):
+        got = grads_for(mode)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_remat_unknown_mode_raises():
+    m = TransformerLMTiny(vocab_size=VOCAB, dtype=jnp.float32, remat="bogus")
+    rng = np.random.RandomState(0)
+    tokens, _ = _data(rng, 1, 32)
+    with pytest.raises(ValueError, match="remat"):
+        m.init(jax.random.PRNGKey(0), tokens)
+
+
+def test_chunked_loss_matches_full_logits():
+    """return_hidden + lm_loss_chunked == full-logit lm_loss (fp32 model, so
+    the only delta is the chunked path's bf16 head matmul — compare loosely)
+    and their gradients agree."""
+    from horovod_tpu.models.transformer import lm_loss, lm_loss_chunked
+    rng = np.random.RandomState(7)
+    tokens, targets = _data(rng, 2, 64)
+    model = TransformerLMTiny(vocab_size=VOCAB, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def full(p):
+        return lm_loss(model.apply({"params": p}, tokens), targets)
+
+    def chunked(p):
+        hid = model.apply({"params": p}, tokens, return_hidden=True)
+        return lm_loss_chunked(hid, p["tok_emb"]["embedding"], targets,
+                               chunk_tokens=32)
+
+    lf, gf = jax.value_and_grad(full)(params)
+    lc, gc = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(lf), float(lc), rtol=2e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_chunked_loss_indivisible_falls_back():
+    """Any (batch, seq) the full-logit path accepts must work chunked: an
+    indivisible chunk_tokens silently drops to the largest divisor."""
+    from horovod_tpu.models.transformer import lm_loss, lm_loss_chunked
+    rng = np.random.RandomState(11)
+    hid = jnp.asarray(rng.randn(2, 30, 16), jnp.float32)
+    emb = jnp.asarray(rng.randn(11, 16), jnp.float32)
+    tg = jnp.asarray(rng.randint(0, 11, (2, 30)))
+    got = float(lm_loss_chunked(hid, emb, tg, chunk_tokens=7))
+    want = float(lm_loss(hid @ emb.T, tg))
+    np.testing.assert_allclose(got, want, rtol=2e-2)
