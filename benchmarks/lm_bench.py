@@ -77,14 +77,9 @@ def main():
         attn_fn = lambda q, k, v: jax.nn.dot_product_attention(
             q, k, v, is_causal=True)
     elif attn == "naive":
-        def attn_fn(q, k, v):
-            d = q.shape[-1]
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                           preferred_element_type=jnp.float32) / np.sqrt(d)
-            t = q.shape[1]
-            mask = np.tril(np.ones((t, t), bool))
-            p = jax.nn.softmax(jnp.where(mask, s, -1e30), -1).astype(q.dtype)
-            return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        from functools import partial
+        from horovod_tpu.parallel.ring_attention import reference_attention
+        attn_fn = partial(reference_attention, causal=True)
     elif attn == "upstream":
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _jf)

@@ -184,24 +184,33 @@ def lm_loss_chunked(hidden, emb_table, targets, chunk_tokens=2048):
     """
     b, t, d = hidden.shape
     total = b * t
-    # largest chunk <= chunk_tokens that divides the token count, so every
-    # (batch, seq) the full-logit path accepted works here too
     chunk = min(chunk_tokens, total)
-    while total % chunk:
-        chunk -= 1
+    # pad the flattened token stream to a chunk multiple (weight 0 rows), so
+    # every (batch, seq) the full-logit path accepts works at full chunk
+    # width — a divisor-only fallback can degrade to pathologically thin
+    # chunks (e.g. prime token counts)
+    pad = (-total) % chunk
     emb_t = emb_table.astype(jnp.bfloat16).T  # [d, vocab]
-    h = hidden.astype(jnp.bfloat16).reshape(total // chunk, chunk, d)
-    y = targets.reshape(total // chunk, chunk)
+    h = hidden.astype(jnp.bfloat16).reshape(total, d)
+    y = targets.reshape(total)
+    w = jnp.ones((total,), jnp.float32)
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad))
+        w = jnp.pad(w, (0, pad))
+    n = (total + pad) // chunk
+    h, y, w = (h.reshape(n, chunk, d), y.reshape(n, chunk),
+               w.reshape(n, chunk))
 
     @jax.checkpoint
     def body(acc, xs):
-        hc, yc = xs
+        hc, yc, wc = xs
         logits = jnp.dot(hc, emb_t, preferred_element_type=jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, yc[:, None], axis=-1)[:, 0]
-        return acc + jnp.sum(ll), None
+        return acc + jnp.sum(ll * wc), None
 
-    total_ll, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, y))
+    total_ll, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, y, w))
     return -total_ll / total
 
 

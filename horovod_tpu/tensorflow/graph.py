@@ -83,6 +83,21 @@ def _graph_name(prefix: str, tensor) -> str:
     return f"{base}.{n}"
 
 
+def _derived_name(name: str, kind: str) -> str:
+    """Engine name for a collective derived from another node's gradient.
+
+    Appends the same per-graph trace counter `_graph_name` uses: tracing one
+    forward collective's gradient twice (two ``tape.gradient`` calls over a
+    shared forward, or grad-of-grad) must yield distinct engine names, or the
+    in-flight duplicate-name check rejects the second at runtime. All ranks
+    trace the same program, so counter order — and therefore the derived
+    names — stay rank-deterministic."""
+    g = tf.compat.v1.get_default_graph()
+    n = getattr(g, "_hvd_tpu_name_counter", 0)
+    g._hvd_tpu_name_counter = n + 1
+    return f"{name}.{kind}.{n}"
+
+
 def _start(py_start, tensor):
     """Engine-start node: ``py_start(np_array) -> handle``. Ordered after the
     previous start in this graph via a control dependency (trace order =
@@ -132,7 +147,7 @@ def _allreduce_raw(tensor, name, op=Sum, prescale=1.0, postscale=1.0):
             # adjoint of y = post*reduce(pre*x) is the same scaled reduction
             # of dy (scalars commute into the sum); Adasum keeps the
             # reference's registered sum-allreduce gradient
-            return _allreduce_raw(dy, f"{name}.grad",
+            return _allreduce_raw(dy, _derived_name(name, "grad"),
                                   op=op if op in (Sum, Average) else Sum,
                                   prescale=prescale, postscale=postscale)
 
@@ -189,10 +204,10 @@ def allgather(tensor, name=None):
         y = _sync(h, x.dtype, tf.TensorShape([None]).concatenate(x.shape[1:]))
 
         def grad(dy):
-            g = _allreduce_raw(dy, f"{name}.grad", op=Sum)
+            g = _allreduce_raw(dy, _derived_name(name, "grad"), op=Sum)
             d0 = tf.shape(x)[0]
             sizes = tf.stop_gradient(allgather(
-                tf.reshape(d0, [1]), name=f"{name}.grad_sizes"))
+                tf.reshape(d0, [1]), name=_derived_name(name, "grad_sizes")))
             offset = tf.reduce_sum(sizes[:basics.rank()])
             begin = tf.concat(
                 [[offset], tf.zeros([tf.rank(x) - 1], tf.int32)], axis=0)
@@ -214,7 +229,7 @@ def broadcast(tensor, root_rank, name=None):
         y = _sync(h, x.dtype, x.shape)
 
         def grad(dy):
-            g = _allreduce_raw(dy, f"{name}.grad", op=Sum)
+            g = _allreduce_raw(dy, _derived_name(name, "grad"), op=Sum)
             return g if basics.rank() == root_rank else g * 0
 
         return y, grad
@@ -233,7 +248,7 @@ def alltoall(tensor, name=None):
         y = _sync(h, x.dtype, x.shape)
 
         def grad(dy):
-            return alltoall(dy, name=f"{name}.grad")
+            return alltoall(dy, name=_derived_name(name, "grad"))
 
         return y, grad
 

@@ -69,6 +69,18 @@ def _make_distributed_class(base_cls, compression, op, sparse_as_dense):
     return _Distributed
 
 
+def _unconstructible_stub(name, err):
+    """Placeholder for a Distributed<Name> class that could not be built
+    (Keras-2 optimizers without the apply() funnel): deserializing a model
+    that actually references it re-raises the ORIGINAL, actionable error."""
+    def _raise(cls, *a, **k):
+        raise RuntimeError(
+            f"the saved model references Distributed{name}, which cannot be "
+            f"reconstructed here: {err}") from err
+    return type("Distributed" + name, (),
+                {"__init__": _raise, "from_config": classmethod(_raise)})
+
+
 def load_model(path, custom_optimizers=None, custom_objects=None,
                compression=Compression.none, op: int = Average,
                sparse_as_dense: bool = False):
@@ -96,10 +108,22 @@ def load_model(path, custom_optimizers=None, custom_objects=None,
         if isinstance(base, type) and issubclass(
                 base, tf.keras.optimizers.Optimizer) \
                 and base.__name__[:1].isupper():
-            customs.setdefault(
-                "Distributed" + base.__name__,
-                _make_distributed_class(base, compression, op,
-                                        sparse_as_dense))
+            try:
+                dist = _make_distributed_class(base, compression, op,
+                                               sparse_as_dense)
+            except Exception as e:
+                # Only classes the saved model actually references must be
+                # constructible: on Keras 2 some builtin optimizers lack the
+                # apply() funnel and _make_distributed_class refuses them —
+                # that must not break load_model for models that never used
+                # them. An explicitly passed custom class still raises, and
+                # a model that DOES reference the broken class gets the
+                # original error (not Keras's opaque "Unknown optimizer")
+                # via a stub that re-raises on construction.
+                if base in (custom_optimizers or ()):
+                    raise
+                dist = _unconstructible_stub(base.__name__, e)
+            customs.setdefault("Distributed" + base.__name__, dist)
     return tf.keras.models.load_model(path, custom_objects=customs)
 
 

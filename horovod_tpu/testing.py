@@ -58,7 +58,12 @@ def run_cluster(fn: Callable, np: int = 2, args: Sequence = (),
     # usually stalls its peers' collectives, and waiting out the full timeout
     # on a hung peer would mask the root-cause error (first-failure
     # semantics like gloo_run.py:253-259)
-    deadline = time.monotonic() + timeout
+    # one shared deadline for the whole cluster, but scaled with np: every
+    # rank's work is serialized onto the same host under load (full-suite CI
+    # runs), so a fixed budget that is ample at np=2 can spuriously trip at
+    # np=8
+    budget = timeout * max(1.0, np / 2.0)
+    deadline = time.monotonic() + budget
     while True:
         alive = [t for t in threads if t.is_alive()]
         failed = [t for t in threads if not t.is_alive() and t.error]
@@ -69,7 +74,8 @@ def run_cluster(fn: Callable, np: int = 2, args: Sequence = (),
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"rank(s) {[t.rank for t in alive]} did not finish within "
-                f"{timeout}s (possible stalled negotiation)")
+                f"{budget:g}s (timeout={timeout:g}s scaled by np={np}; "
+                f"possible stalled negotiation)")
         alive[0].join(timeout=0.05)
     for t in threads:
         if t.error is not None:

@@ -381,3 +381,29 @@ def test_graph_keras_fit_compiled():
     for w0, w1 in zip(*weights):
         np.testing.assert_allclose(w0, w1, rtol=1e-5)
         assert not np.allclose(w0, np.ones_like(w0))  # training happened
+
+
+def test_graph_gradient_traced_twice_unique_names():
+    """Differentiating one forward collective twice (two tape.gradient calls
+    over a shared forward) must produce DISTINCT derived engine names —
+    previously both gradient nodes submitted '<name>.grad' and the in-flight
+    duplicate-name check rejected the second."""
+    def fn():
+        @tf.function
+        def step(t):
+            with tf.GradientTape(persistent=True) as tape:
+                tape.watch(t)
+                y = hvd.allreduce(t, name="g_twice")
+                l1 = tf.reduce_sum(y)
+                l2 = tf.reduce_sum(y * 2.0)
+            g1 = tape.gradient(l1, t)
+            g2 = tape.gradient(l2, t)
+            return g1, g2
+
+        g1, g2 = step(tf.fill((4,), float(hvd.rank() + 1)))
+        # d(sum(avg(t)))/dt = avg-reduced ones; second loss doubles it
+        np.testing.assert_allclose(g1.numpy(), np.ones(4))
+        np.testing.assert_allclose(g2.numpy(), 2 * np.ones(4))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))

@@ -194,7 +194,10 @@ def test_remat_matches_no_remat():
     for mode in ("full", "dots"):
         got = grads_for(mode)
         for a, b in zip(ref, got):
-            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            # remat re-fuses the backward HLO, so low-order fp32 bits may
+            # legitimately differ; the invariant is numerical equality
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
 
 
 def test_remat_unknown_mode_raises():
