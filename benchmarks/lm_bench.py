@@ -76,10 +76,6 @@ def main():
     if attn == "xla":
         attn_fn = lambda q, k, v: jax.nn.dot_product_attention(
             q, k, v, is_causal=True)
-    elif attn == "naive":
-        from functools import partial
-        from horovod_tpu.parallel.ring_attention import reference_attention
-        attn_fn = partial(reference_attention, causal=True)
     elif attn == "upstream":
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _jf)
@@ -90,7 +86,7 @@ def main():
                     sm_scale=1.0 / float(np.sqrt(d)))
             return o.transpose(0, 2, 1, 3)
     elif attn != "pallas":
-        raise ValueError(f"LM_ATTN={attn!r}: expected pallas|xla|naive|upstream")
+        raise ValueError(f"LM_ATTN={attn!r}: expected pallas|xla|upstream")
 
     model = TransformerLM(
         vocab_size=vocab, num_layers=cfg["num_layers"],

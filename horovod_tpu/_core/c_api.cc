@@ -221,4 +221,51 @@ uint64_t hvd_core_cache_misses(int64_t eng) {
   return c ? c->controller->cache_misses() : 0;
 }
 
+// ---------------------------------------------------------------------------
+// Standalone parameter-manager handles: the cross-process coordinator runs
+// the SAME GP/EI tuner at rank 0 and broadcasts the tuned
+// (fusion_threshold, cycle_time) in its ResponseList — the role the
+// reference's coordinator plays when it re-broadcasts parameter-manager
+// updates to all workers. Kept separate from EngineCore so the Python
+// control plane can own one without instantiating a native controller.
+
+namespace {
+std::unordered_map<int64_t, std::unique_ptr<hvdtpu::ParameterManager>> g_tuners;
+}  // namespace
+
+int64_t hvd_tuner_create(int64_t fusion_threshold_bytes, double cycle_time_ms,
+                         uint64_t seed) {
+  auto t = std::make_unique<hvdtpu::ParameterManager>(
+      fusion_threshold_bytes, cycle_time_ms, seed);
+  t->SetEnabled(true);
+  std::lock_guard<std::mutex> l(g_mu);
+  int64_t h = g_next++;
+  g_tuners[h] = std::move(t);
+  return h;
+}
+
+// returns 1 if (threshold, cycle_time) changed
+int32_t hvd_tuner_update(int64_t h, int64_t bytes, double seconds) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_tuners.find(h);
+  return (it != g_tuners.end() && it->second->Update(bytes, seconds)) ? 1 : 0;
+}
+
+int64_t hvd_tuner_threshold(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_tuners.find(h);
+  return it == g_tuners.end() ? -1 : it->second->fusion_threshold();
+}
+
+double hvd_tuner_cycle_ms(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_tuners.find(h);
+  return it == g_tuners.end() ? -1.0 : it->second->cycle_time_ms();
+}
+
+void hvd_tuner_destroy(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  g_tuners.erase(h);
+}
+
 }  // extern "C"

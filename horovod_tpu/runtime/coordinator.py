@@ -125,10 +125,18 @@ class CoordState:
 
     def __init__(self, world: int, fusion_threshold: int,
                  cache_capacity: int, stall_warning_s: float,
-                 stall_shutdown_s: float):
+                 stall_shutdown_s: float, tuner=None):
         self.world = world
         self.threshold = fusion_threshold
         self.cache_capacity = cache_capacity
+        # GP/EI parameter manager (native NativeTuner); scores arrive in
+        # request frames, tuned params leave in every rank's ResponseList —
+        # the coordinated analogue of the reference controller broadcasting
+        # parameter-manager updates to all workers
+        self.tuner = tuner
+        self.round_bytes = 0
+        self.round_seconds = 0.0
+        self.tuned: Optional[Tuple[int, float]] = None
         self.stall_warning_s = stall_warning_s
         self.stall_shutdown_s = stall_shutdown_s
         self.cv = threading.Condition()
@@ -156,8 +164,12 @@ class CoordState:
         with self.cv:
             if self.bye:
                 return self._shutdown_bytes()
-            self.lists.setdefault(seq, {})[rank] = \
-                wire.decode_request_list(payload)
+            flags_cached_reqs_score = wire.decode_request_list(payload)
+            score = flags_cached_reqs_score[3]
+            if score is not None and self.tuner is not None:
+                self.round_bytes += score[0]
+                self.round_seconds = max(self.round_seconds, score[1])
+            self.lists.setdefault(seq, {})[rank] = flags_cached_reqs_score[:3]
             if len(self.lists[seq]) == self.world:
                 self.resps[seq] = self._negotiate(self.lists.pop(seq))
                 self.cv.notify_all()
@@ -198,8 +210,22 @@ class CoordState:
             return self.cache_meta[cid].get(rank)
         return None
 
+    def _tune(self) -> Optional[Tuple[int, float]]:
+        """Feed the round's aggregated score to the GP/EI and return the
+        (threshold, cycle_ms) to broadcast; must run under self.cv."""
+        if self.tuner is None:
+            return None
+        if self.round_bytes > 0 and self.round_seconds > 0:
+            if self.tuner.update(self.round_bytes, self.round_seconds):
+                self.threshold = int(self.tuner.fusion_threshold())
+            self.round_bytes = 0
+            self.round_seconds = 0.0
+        self.tuned = (self.threshold, float(self.tuner.cycle_time_ms()))
+        return self.tuned
+
     def _negotiate(self, per_rank) -> bytes:
         flags = 0
+        tuned = self._tune()
         for rank, (rflags, cached, reqs) in per_rank.items():
             if rflags & wire.REQ_JOIN:
                 if rank not in self.joined:
@@ -224,7 +250,8 @@ class CoordState:
             last = self.last_joined
             self.joined.clear()
             self.last_joined = -1
-            return wire.encode_response_list(flags, last, [], [], [])
+            return wire.encode_response_list(flags, last, [], [], [],
+                                             tuned=tuned)
 
         ready: List[str] = []
         warnings: List[str] = []
@@ -303,7 +330,7 @@ class CoordState:
             assignments.append(cids)
         return wire.encode_response_list(flags, self.last_joined, responses,
                                          assignments, warnings,
-                                         self.shutdown_reason)
+                                         self.shutdown_reason, tuned=tuned)
 
     def _add(self, rank: int, m: ReqMeta) -> None:
         p = self.table.get(m.name)
@@ -595,6 +622,12 @@ class CoordController:
         self._join_announced = False
         self._bye_sent = False
         self._send_lock = threading.Lock()
+        # autotune: scores buffer locally between ticks and ride the next
+        # request frame; tuned params come back in every ResponseList
+        self._autotune = autotune
+        self._score_bytes = 0
+        self._score_busy = 0.0
+        self._score_epoch: Optional[float] = None
 
         gen = _next_gen(self_rank)
         if self_rank == 0:
@@ -604,9 +637,24 @@ class CoordController:
             from ..run.rendezvous import make_secret
 
             self._secret = os.environ.get("HVD_SECRET") or make_secret()
+            tuner = None
+            if autotune:
+                try:
+                    from .native import NativeTuner
+
+                    tuner = NativeTuner(
+                        fusion_threshold if fusion_enabled else 0,
+                        cycle_time_ms)
+                except Exception as exc:
+                    logger.warning(
+                        "HOROVOD_AUTOTUNE requested but the native GP/EI "
+                        "tuner is unavailable (%s); coordinated autotune "
+                        "disabled", exc)
+                    self._autotune = False
             self._state: Optional[CoordState] = CoordState(
                 world, fusion_threshold if fusion_enabled else 0,
-                cache_capacity, stall_warning_s, stall_shutdown_s)
+                cache_capacity, stall_warning_s, stall_shutdown_s,
+                tuner=tuner)
             advertise = _advertise_host()
             bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
             self._server: Optional[CoordinatorServer] = CoordinatorServer(
@@ -681,13 +729,30 @@ class CoordController:
             fresh = [r.meta for r in outbox if r.cached_id < 0]
             seq = self._seq
             self._seq += 1
-        payload = wire.encode_request_list(flags, cached, fresh)
+            score = None
+            if self._autotune and self._score_bytes > 0:
+                # wall interval since the first buffered op: unlike pure busy
+                # time, it sees negotiation + cycle latency, which is exactly
+                # what the cycle-time knob trades off
+                wall = (time.monotonic() - self._score_epoch
+                        if self._score_epoch is not None else 0.0)
+                score = (self._score_bytes, max(self._score_busy, wall))
+                self._score_bytes = 0
+                self._score_busy = 0.0
+                self._score_epoch = None
+        payload = wire.encode_request_list(flags, cached, fresh, score=score)
         try:
             data = self._exchange(seq, payload)
         except (ConnectionError, OSError):
             raise ShutdownError("control-plane connection lost")
         (rflags, last_joined, responses, assignments, warnings,
-         reason) = wire.decode_response_list(data)
+         reason, tuned) = wire.decode_response_list(data)
+        if tuned is not None:
+            # apply the coordinator's broadcast (threshold, cycle_time):
+            # every rank moves to the same parameters at the same tick; the
+            # engine re-reads cycle_time_ms() after each coordinated tick
+            self._threshold = int(tuned[0])
+            self._cycle_ms = float(tuned[1])
         if rflags & wire.RESP_SHUTDOWN:
             if reason.startswith("stall shutdown"):
                 # abnormal abort: surface loudly (parity with the in-process
@@ -778,6 +843,9 @@ class CoordController:
             # shutdown()+init() cycles don't leak.
             self._server.stop()
         self._timeline.close()
+        if self._state is not None and self._state.tuner is not None:
+            self._state.tuner.close()
+            self._state.tuner = None
         return orphans
 
     # ---- timeline / autotune / stats
@@ -797,7 +865,21 @@ class CoordController:
         self._timeline.cache_counter(hits, misses)
 
     def report_score(self, nbytes: int, seconds: float) -> bool:
-        return False  # autotune runs in the in-process native core only
+        """Buffer a local throughput sample for the next request frame; the
+        GP/EI runs at the coordinator and tuned params return in the
+        ResponseList (reference: the controller broadcasts parameter-manager
+        updates with the response plan). Always returns False — the engine
+        picks up tuned cycle time by re-reading cycle_time_ms() after each
+        coordinated tick, not through this return value."""
+        if not self._autotune:
+            return False
+        with self._lock:
+            if self._score_bytes == 0:
+                # open the wall-clock window at (roughly) this op's start
+                self._score_epoch = time.monotonic() - seconds
+            self._score_bytes += int(nbytes)
+            self._score_busy += float(seconds)
+        return False
 
     def fusion_threshold(self) -> int:
         return self._threshold

@@ -75,7 +75,8 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
                 fusion_enabled=True,
                 timeline_path=(os.environ.get("HOROVOD_TIMELINE")
                                if self_rank == 0 else None),
-                autotune=False,
+                autotune=os.environ.get("HOROVOD_AUTOTUNE", "")
+                in ("1", "true"),
                 cycle_time_ms=cycle_ms,
                 self_rank=self_rank,
             )
@@ -230,6 +231,10 @@ class Engine:
                     self._finish_drain(*drained)
                     return
                 tick = self.controller.tick()
+                if getattr(self.controller, "coordinated", False):
+                    # coordinated autotune delivers tuned cycle time inside
+                    # the tick's ResponseList; pick it up even on idle ticks
+                    self.cycle_time_s = self.controller.cycle_time_ms() / 1e3
                 if tick is None:
                     time.sleep(self.cycle_time_s / 5)
                     continue

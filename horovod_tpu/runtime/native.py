@@ -102,8 +102,51 @@ def load_library():
         lib.hvd_core_cache_hits.argtypes = [ctypes.c_int64]
         lib.hvd_core_cache_misses.restype = ctypes.c_uint64
         lib.hvd_core_cache_misses.argtypes = [ctypes.c_int64]
+        lib.hvd_tuner_create.restype = ctypes.c_int64
+        lib.hvd_tuner_create.argtypes = [ctypes.c_int64, ctypes.c_double,
+                                         ctypes.c_uint64]
+        lib.hvd_tuner_update.restype = ctypes.c_int32
+        lib.hvd_tuner_update.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                         ctypes.c_double]
+        lib.hvd_tuner_threshold.restype = ctypes.c_int64
+        lib.hvd_tuner_threshold.argtypes = [ctypes.c_int64]
+        lib.hvd_tuner_cycle_ms.restype = ctypes.c_double
+        lib.hvd_tuner_cycle_ms.argtypes = [ctypes.c_int64]
+        lib.hvd_tuner_destroy.argtypes = [ctypes.c_int64]
         _lib = lib
         return _lib
+
+
+class NativeTuner:
+    """Standalone GP/EI parameter manager (autotune.cc) for the cross-process
+    coordinator: rank 0 feeds aggregated throughput scores and reads back the
+    tuned (fusion_threshold, cycle_time) to broadcast in its ResponseList —
+    the coordinated analogue of the in-process autotune path. Raises if the
+    native core cannot be loaded (coordinated autotune is native-only; the
+    caller degrades to no-tuning with a warning)."""
+
+    def __init__(self, fusion_threshold: int, cycle_time_ms: float,
+                 seed: int = 0):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._h = lib.hvd_tuner_create(fusion_threshold, cycle_time_ms, seed)
+
+    def update(self, nbytes: int, seconds: float) -> bool:
+        """Record one scored interval; True if tuned params changed."""
+        return bool(self._lib.hvd_tuner_update(self._h, nbytes, seconds))
+
+    def fusion_threshold(self) -> int:
+        return self._lib.hvd_tuner_threshold(self._h)
+
+    def cycle_time_ms(self) -> float:
+        return self._lib.hvd_tuner_cycle_ms(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_tuner_destroy(self._h)
+            self._h = 0
 
 
 class NativeController:

@@ -9,7 +9,7 @@ request/response lists over the cross-process control plane.
 from __future__ import annotations
 
 import struct
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from .messages import Response, ResponseType
 
@@ -78,6 +78,11 @@ class Reader:
         v = struct.unpack_from("<d", self.buf, self.off)[0]
         self.off += 8
         return v
+
+    def remaining(self) -> int:
+        """Bytes left — lets decoders treat trailing blocks added by newer
+        encoders as optional (older frames simply end sooner)."""
+        return len(self.buf) - self.off
 
     def str(self) -> str:
         n = self.u32()
@@ -171,7 +176,12 @@ RESP_JOIN_RELEASE = 2
 
 
 def encode_request_list(flags: int, cached_ids: List[int],
-                        new_reqs: List[ReqMeta]) -> bytes:
+                        new_reqs: List[ReqMeta],
+                        score: Optional[Tuple[int, float]] = None) -> bytes:
+    """``score`` is this rank's accumulated autotune sample since its last
+    frame: (bytes moved, busy seconds). Carried in the request frame the way
+    the reference piggybacks parameter-manager traffic on the coordinator
+    exchange rather than adding a side channel."""
     w = Writer()
     w.u8(flags)
     w.u32(len(cached_ids))
@@ -189,10 +199,15 @@ def encode_request_list(flags: int, cached_ids: List[int],
         w.u8(int(m.average))
         w.f64(m.prescale)
         w.f64(m.postscale)
+    w.u8(0 if score is None else 1)
+    if score is not None:
+        w.i64(int(score[0]))
+        w.f64(float(score[1]))
     return w.getvalue()
 
 
-def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta]]:
+def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta],
+                                             Optional[Tuple[int, float]]]:
     rd = Reader(buf)
     flags = rd.u8()
     cached = [rd.u32() for _ in range(rd.u32())]
@@ -207,18 +222,24 @@ def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta]]:
         pre = rd.f64()
         post = rd.f64()
         reqs.append(ReqMeta(name, rtype, dtype, shape, root, avg, pre, post))
-    return flags, cached, reqs
+    score = None
+    if rd.remaining() and rd.u8():
+        score = (rd.i64(), rd.f64())
+    return flags, cached, reqs, score
 
 
 def encode_response_list(flags: int, last_joined: int,
                          responses: List[Response],
                          cache_assignments: List[List[int]],
                          stall_warnings: List[str],
-                         shutdown_reason: str = "") -> bytes:
+                         shutdown_reason: str = "",
+                         tuned: Optional[Tuple[int, float]] = None) -> bytes:
     """``cache_assignments[i]`` parallels ``responses[i].tensor_names``:
     coordinator-assigned cache id per tensor (-1 = uncached).
     ``shutdown_reason`` distinguishes a normal end-of-job shutdown (empty)
-    from an abnormal abort (stall shutdown, peer loss)."""
+    from an abnormal abort (stall shutdown, peer loss). ``tuned`` broadcasts
+    autotuned (fusion_threshold, cycle_time_ms) so every rank applies the
+    same parameters at the same tick."""
     w = Writer()
     w.u8(flags)
     w.str(shutdown_reason)
@@ -251,6 +272,10 @@ def encode_response_list(flags: int, last_joined: int,
     w.u32(len(stall_warnings))
     for s in stall_warnings:
         w.str(s)
+    w.u8(0 if tuned is None else 1)
+    if tuned is not None:
+        w.i64(int(tuned[0]))
+        w.f64(float(tuned[1]))
     return w.getvalue()
 
 
@@ -287,5 +312,8 @@ def decode_response_list(buf: bytes):
         responses.append(resp)
         assignments.append(cids)
     warnings = [rd.str() for _ in range(rd.u32())]
-    return flags, last_joined, responses, assignments, warnings, \
-        shutdown_reason
+    tuned = None
+    if rd.remaining() and rd.u8():
+        tuned = (rd.i64(), rd.f64())
+    return (flags, last_joined, responses, assignments, warnings,
+            shutdown_reason, tuned)

@@ -66,36 +66,36 @@ def _sanitize(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_.]", "_", name)
 
 
-def _graph_name(prefix: str, tensor) -> str:
-    """Engine name for an unnamed graph collective: the symbolic tensor name
-    (deterministic given the same program, like the reference's
-    `tensorflow/mpi_ops.py:102-103`) plus a per-graph trace-order counter —
-    two unnamed collectives on the SAME tensor in one step must not collide
-    on the engine's in-flight duplicate-name check."""
+def _next_trace_index() -> int:
+    """Per-graph trace-order counter. All ranks trace the same program, so
+    counter order — and every name derived from it — is rank-deterministic."""
     g = tf.compat.v1.get_default_graph()
     n = getattr(g, "_hvd_tpu_name_counter", 0)
     g._hvd_tpu_name_counter = n + 1
+    return n
+
+
+def _graph_name(prefix: str, tensor) -> str:
+    """Engine name for an unnamed graph collective: the symbolic tensor name
+    (deterministic given the same program, like the reference's
+    `tensorflow/mpi_ops.py:102-103`) plus the trace-order counter — two
+    unnamed collectives on the SAME tensor in one step must not collide on
+    the engine's in-flight duplicate-name check."""
     try:
         tn = tensor.name
     except Exception:
         tn = None
     base = f"{prefix}.{_sanitize(tn)}" if tn else f"{prefix}.graph"
-    return f"{base}.{n}"
+    return f"{base}.{_next_trace_index()}"
 
 
 def _derived_name(name: str, kind: str) -> str:
-    """Engine name for a collective derived from another node's gradient.
-
-    Appends the same per-graph trace counter `_graph_name` uses: tracing one
-    forward collective's gradient twice (two ``tape.gradient`` calls over a
-    shared forward, or grad-of-grad) must yield distinct engine names, or the
-    in-flight duplicate-name check rejects the second at runtime. All ranks
-    trace the same program, so counter order — and therefore the derived
-    names — stay rank-deterministic."""
-    g = tf.compat.v1.get_default_graph()
-    n = getattr(g, "_hvd_tpu_name_counter", 0)
-    g._hvd_tpu_name_counter = n + 1
-    return f"{name}.{kind}.{n}"
+    """Engine name for a collective derived from another node's gradient:
+    tracing one forward collective's gradient twice (two ``tape.gradient``
+    calls over a shared forward, or grad-of-grad) must yield distinct engine
+    names, or the in-flight duplicate-name check rejects the second at
+    runtime."""
+    return f"{name}.{kind}.{_next_trace_index()}"
 
 
 def _start(py_start, tensor):

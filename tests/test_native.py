@@ -150,9 +150,11 @@ def test_wire_request_response_roundtrip_randomized():
                 average=bool(rng.randint(2)),
                 prescale=float(rng.choice([1.0, 1e-30, 1e30, -2.5])),
                 postscale=float(rng.choice([1.0, 0.5]))))
-        buf = wire.encode_request_list(flags, cached, reqs)
-        f2, c2, r2 = wire.decode_request_list(buf)
-        assert (f2, c2) == (flags, cached)
+        score = ((int(rng.randint(0, 2 ** 48)), float(rng.rand()))
+                 if rng.randint(2) else None)
+        buf = wire.encode_request_list(flags, cached, reqs, score=score)
+        f2, c2, r2, s2 = wire.decode_request_list(buf)
+        assert (f2, c2, s2) == (flags, cached, score)
         assert [m.sig() for m in r2] == [m.sig() for m in reqs]
 
         resps, cids = [], []
@@ -177,9 +179,12 @@ def test_wire_request_response_roundtrip_randomized():
         warns = [names[rng.randint(len(names))]
                  for _ in range(rng.randint(0, 3))]
         reason = "lost peer ✗" if rng.randint(2) else ""
-        buf = wire.encode_response_list(3, -1, resps, cids, warns, reason)
-        f2, last2, r2, c2, w2, reason2 = wire.decode_response_list(buf)
-        assert (f2, reason2, last2, w2) == (3, reason, -1, warns)
+        tuned = ((int(rng.randint(0, 2 ** 31)), float(rng.rand() * 50))
+                 if rng.randint(2) else None)
+        buf = wire.encode_response_list(3, -1, resps, cids, warns, reason,
+                                        tuned=tuned)
+        f2, last2, r2, c2, w2, reason2, t2 = wire.decode_response_list(buf)
+        assert (f2, reason2, last2, w2, t2) == (3, reason, -1, warns, tuned)
         assert c2 == cids
         for a, b in zip(r2, resps):
             assert a.response_type == b.response_type
