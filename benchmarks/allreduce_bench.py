@@ -112,18 +112,76 @@ def bench_eager(sizes_mb, iters, warmup):
     return results
 
 
+def bench_allgather(sizes_mb, iters, warmup):
+    """Eager allgather across cluster sizes at FIXED total output size.
+
+    The result of each gather is one compiled program whose outputs stay
+    replicated on the rank devices (`executor._allgather_assemble_fn`) —
+    nothing moves through the host per destination. Evidence: time per op
+    stays ~flat as the rank count grows (the round-2 per-destination
+    ``device_put`` loop grew linearly in world size x output bytes).
+    """
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import testing
+
+    results = []
+    world_sizes = [n for n in (2, 4, 8) if n <= len(jax.devices())]
+    for mb in sizes_mb:
+        for n in world_sizes:
+            total_elems = max(n, int(mb * (1 << 20)) // 4)
+            rows = total_elems // n  # per-rank contribution; output constant
+
+            def worker():
+                import time as _t
+
+                x = np.full((rows,), float(hvd.rank()), np.float32)
+                for i in range(warmup):
+                    hvd.allgather(x, name="agb")
+                t0 = _t.perf_counter()
+                for i in range(iters):
+                    out = hvd.allgather(x, name="agb")
+                return (_t.perf_counter() - t0) / iters
+
+            if hvd.is_initialized():
+                hvd.shutdown()
+            dts = testing.run_cluster(worker, np=n)
+            hvd.shutdown()
+            dt = max(dts)
+            results.append({"path": "eager-allgather", "size_mb": mb, "n": n,
+                            "time_us": round(dt * 1e6, 1),
+                            "gather_gbps": round(total_elems * 4 / dt / 1e9,
+                                                 3)})
+            print(json.dumps(results[-1]))
+    return results
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes-mb", default="0.0625,0.25,1,4,16,64",
                     help="comma-separated message sizes in MB")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--path", choices=["spmd", "eager", "both"],
+    ap.add_argument("--path", choices=["spmd", "eager", "allgather", "both"],
                     default="both")
     args = ap.parse_args(argv)
     sizes = [float(s) for s in args.sizes_mb.split(",")]
 
     import horovod_tpu as hvd
+
+    if args.path == "allgather":
+        results = bench_allgather(sizes, args.iters, args.warmup)
+        by_size = {}
+        for r in results:
+            by_size.setdefault(r["size_mb"], []).append(r)
+        for mb, rs in by_size.items():
+            times = [r["time_us"] for r in sorted(rs, key=lambda r: r["n"])]
+            print(json.dumps({"metric": "allgather_time_vs_world_us",
+                              "size_mb": mb, "times_us": times,
+                              "flat_ratio": round(times[-1] / times[0], 2)}))
+        return results
+
     hvd.init()
 
     results = []

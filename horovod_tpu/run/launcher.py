@@ -81,6 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def make_rank_envs(ranks, coordinator_addr: str, kv_addr: str, secret: str,
                    knob_env: Dict[str, str]) -> List[Dict[str, str]]:
+    # hierarchical collectives require every rank to compile the IDENTICAL
+    # program; the grouping env must therefore be a GLOBAL fact, exported
+    # identically everywhere — 0 when hosts hold unequal rank counts
+    # (heterogeneous hostfile tails), which disables the two-level path
+    local_sizes = {r.local_size for r in ranks}
+    uniform = ranks[0].local_size if len(local_sizes) == 1 else 0
     envs = []
     for r in ranks:
         env = dict(knob_env)
@@ -92,6 +98,7 @@ def make_rank_envs(ranks, coordinator_addr: str, kv_addr: str, secret: str,
             "HVD_LOCAL_SIZE": str(r.local_size),
             "HVD_CROSS_RANK": str(r.cross_rank),
             "HVD_CROSS_SIZE": str(r.cross_size),
+            "HVD_UNIFORM_LOCAL_SIZE": str(uniform),
             "HVD_KV_ADDR": kv_addr,
             "HVD_SECRET": secret,
         })

@@ -34,6 +34,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..exceptions import DuplicateNameError, ShutdownError
+from ..utils.env import env_float as _env_float, env_on as _env_on
 from .executor import Executor
 from .handles import HandleManager
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
@@ -42,11 +43,6 @@ DEFAULT_FUSION_BYTES = 64 * 1024 * 1024
 DEFAULT_CYCLE_MS = 5.0
 
 logger = logging.getLogger("horovod_tpu")
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    return float(v) if v else default
 
 
 def _make_controller(world: int, mode: str, self_rank: int = 0):
@@ -75,8 +71,7 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
                 fusion_enabled=True,
                 timeline_path=(os.environ.get("HOROVOD_TIMELINE")
                                if self_rank == 0 else None),
-                autotune=os.environ.get("HOROVOD_AUTOTUNE", "")
-                in ("1", "true"),
+                autotune=_env_on("HOROVOD_AUTOTUNE"),
                 cycle_time_ms=cycle_ms,
                 self_rank=self_rank,
             )
@@ -99,7 +94,7 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
         timeline_path=(os.environ.get("HOROVOD_TIMELINE")
                        if (mode != "multiprocess" or self_rank == 0)
                        else None),
-        autotune=os.environ.get("HOROVOD_AUTOTUNE", "") in ("1", "true"),
+        autotune=_env_on("HOROVOD_AUTOTUNE"),
         cycle_time_ms=cycle_ms,
         # multiprocess: only the local rank submits to this process's table;
         # readiness must not wait on remote ranks (they negotiate in their own

@@ -20,10 +20,12 @@ skips all compilation/negotiation overhead.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Sequence, Tuple
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils.env import env_on as _env_on
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 
 MESH_AXIS = "hvd"
@@ -58,6 +60,39 @@ class Executor:
         self._self_rank = state.rank0
         # compiled-collective cache (ResponseCache analogue)
         self._fn_cache: Dict[Tuple, Any] = {}
+        # two-level ("dcn","ici") factorization of the rank mesh: ranks on
+        # one host form an ici row (reference LOCAL communicator), one row
+        # per host (CROSS). Opt-in per op via the reference's env knobs
+        # HOROVOD_HIERARCHICAL_ALLREDUCE / _ALLGATHER
+        # (operations.cc:433-443); HVD_LOCAL_SIZE overrides the grouping for
+        # single-host topologies (tests, virtual-device CI).
+        self._mesh2 = self._build_two_level_mesh(state)
+        self._hier_allreduce = (self._mesh2 is not None
+                                and _env_on("HOROVOD_HIERARCHICAL_ALLREDUCE"))
+        self._hier_allgather = (self._mesh2 is not None
+                                and _env_on("HOROVOD_HIERARCHICAL_ALLGATHER"))
+
+    def _build_two_level_mesh(self, state):
+        from jax.sharding import Mesh
+
+        if self._multiproc:
+            # multi-controller: every process must compile the IDENTICAL
+            # program for a negotiated collective, so the grouping may only
+            # come from a env fact the launcher exports identically to all
+            # ranks — per-host local_size can differ across heterogeneous
+            # hosts and would silently split the job onto two programs
+            ls = int(os.environ.get("HVD_UNIFORM_LOCAL_SIZE", 0))
+        else:
+            # single process (cluster/standalone): any grouping is trivially
+            # uniform; HVD_LOCAL_SIZE overrides for virtual-topology tests
+            ls = int(os.environ.get("HVD_LOCAL_SIZE", 0)) or state.local_size
+        if ls <= 1 or ls >= self._world or self._world % ls != 0:
+            return None
+        # rank numbering is host-major (launcher assigns local ranks
+        # contiguously): rank = cross_rank * local_size + local_rank
+        rows = np.asarray(self._rank_devices, dtype=object).reshape(
+            self._world // ls, ls)
+        return Mesh(rows, ("dcn", "ici"))
 
     # ------------------------------------------------------------------ pack
     def _pack(self, entries: Sequence[TensorTableEntry], pad_to: int = 0):
@@ -73,16 +108,25 @@ class Executor:
             buf = jnp.pad(buf, (0, pad_to - buf.shape[0]))
         return buf
 
-    def _global_array(self, bufs: List[Any], length: int):
+    def _global_array(self, bufs: List[Any], length: int,
+                      sharding: Optional[Any] = None):
         """Stack per-rank buffers into a (world, L) array sharded over the mesh."""
         jax = self._jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        sharding = NamedSharding(self._mesh, P(MESH_AXIS))
+        if sharding is None:
+            sharding = NamedSharding(self._mesh, P(MESH_AXIS))
         shards = [b.reshape(1, length) for b in bufs]
         return jax.make_array_from_single_device_arrays(
             (self._world, length), sharding, shards
         )
+
+    def _row_sharding2(self):
+        """Row-per-rank sharding expressed over the two-level mesh (same
+        device order as the flat rank mesh, so shards place identically)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self._mesh2, P(("dcn", "ici")))
 
     def _shard_by_rank(self, out) -> Dict[int, Any]:
         dev_to_rank = {d: r for r, d in enumerate(self._rank_devices)}
@@ -119,6 +163,53 @@ class Executor:
                 return jnp.broadcast_to(s, (n, length))
 
             fn = jax.jit(kernel, out_shardings=sharding)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _allreduce2_fn(self, n: int, length: int, dtype: str, average: bool,
+                       prescale: float, postscale: float):
+        """Two-level allreduce over the ("dcn","ici") rank mesh:
+        reduce_scatter ICI → allreduce DCN → all_gather ICI, the
+        NCCLHierarchicalAllreduce decomposition (`nccl_operations.cc:150-346`)
+        expressed with explicit XLA collectives under shard_map."""
+        key = ("allreduce2", n, length, dtype, average, prescale, postscale)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            mesh = self._mesh2
+            ici = mesh.shape["ici"]
+            size = self._world
+            isint = np.issubdtype(np.dtype(dtype), np.integer)
+            pad = (-length) % ici
+
+            def body(row):  # [1, L]: this rank's contribution
+                x = row[0]
+                if prescale != 1.0:
+                    x = x * np.asarray(prescale, x.dtype)
+                if pad:
+                    x = jnp.pad(x, (0, pad))
+                s = lax.psum_scatter(x, "ici", scatter_dimension=0,
+                                     tiled=True)
+                s = lax.psum(s, "dcn")
+                out = lax.all_gather(s, "ici", tiled=True)
+                if pad:
+                    out = out[:length]
+                if average:
+                    out = (out // size if isint
+                           else out / np.asarray(size, out.dtype))
+                if postscale != 1.0:
+                    out = out * np.asarray(postscale, out.dtype)
+                return out[None]
+
+            sm = jax.shard_map(body, mesh=mesh,
+                               in_specs=P(("dcn", "ici")),
+                               out_specs=P(("dcn", "ici")),
+                               check_vma=False)
+            fn = jax.jit(sm)
             self._fn_cache[key] = fn
         return fn
 
@@ -163,17 +254,62 @@ class Executor:
             self._fn_cache[key] = fn
         return fn
 
-    def _allgather_fn(self, n: int, length: int, dtype: str):
-        """Replicate the stacked buffers to all ranks (allgatherv analogue,
-        `mpi_operations.cc:83-166`); variable sizes handled by padding + offsets."""
-        key = ("allgather", n, length, dtype)
+    def _allgather_assemble_fn(self, world: int, lmax: int, dtype: str,
+                               ecounts: Tuple[Tuple[int, ...], ...],
+                               tails: Tuple[Tuple[int, ...], ...]):
+        """ONE compiled program: gather the padded per-rank buffers and
+        assemble every output tensor, leaving the results replicated on the
+        rank devices. Replaces the round-2 per-destination host
+        ``device_put`` loop (quadratic host traffic in world × tensor size)
+        — on-device assembly keeps per-rank host traffic zero regardless of
+        world size. ``ecounts[t][src]`` = element count tensor ``t``
+        contributes from rank ``src``; ``tails[t]`` = trailing shape.
+        Honors HOROVOD_HIERARCHICAL_ALLGATHER with the two-level
+        ici-then-dcn gather (`mpi_operations.cc:168-310`'s node-leader
+        decomposition)."""
+        key = ("allgatherA", world, lmax, dtype, ecounts, tails,
+               self._hier_allgather)
         fn = self._fn_cache.get(key)
         if fn is None:
             jax = self._jax
+            import jax.numpy as jnp
+            from jax import lax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            replicated = NamedSharding(self._mesh, P())
-            fn = jax.jit(lambda g: g + 0, out_shardings=replicated)
+            nt = len(tails)
+            offs = [[sum(ecounts[u][src] for u in range(t))
+                     for src in range(world)] for t in range(nt)]
+
+            def assemble(full):
+                outs = []
+                for t, tail in enumerate(tails):
+                    segs = [full[src, offs[t][src]:offs[t][src]
+                                 + ecounts[t][src]]
+                            for src in range(world)]
+                    cat = jnp.concatenate(segs) if len(segs) > 1 else segs[0]
+                    elems = int(np.prod(tail)) if tail else 1
+                    outs.append(cat.reshape((cat.shape[0] // elems,)
+                                            + tuple(tail)))
+                return tuple(outs)
+
+            if self._hier_allgather:
+                mesh = self._mesh2
+
+                def gather(row):  # [1, lmax] per device
+                    g1 = lax.all_gather(row, "ici", axis=0, tiled=True)
+                    return lax.all_gather(g1, "dcn", axis=0, tiled=True)
+
+                sm = jax.shard_map(gather, mesh=mesh,
+                                   in_specs=P(("dcn", "ici")), out_specs=P(),
+                                   check_vma=False)
+                fn = jax.jit(
+                    lambda g: assemble(sm(g)),
+                    out_shardings=NamedSharding(mesh, P()))
+            else:
+                # GSPMD inserts the all-gather: inputs row-sharded, outputs
+                # replicated
+                fn = jax.jit(assemble,
+                             out_shardings=NamedSharding(self._mesh, P()))
             self._fn_cache[key] = fn
         return fn
 
@@ -266,10 +402,15 @@ class Executor:
                 # controller.cc:202-256, operations.cc:908-934)
                 z = jnp.zeros((length,), dtype=dtype)
                 bufs.append(self._jax.device_put(z, self._rank_devices[r]))
-        g = self._global_array(bufs, length)
         if adasum:
+            g = self._global_array(bufs, length)
             fn = self._adasum_fn(world, length, dtype)
+        elif self._hier_allreduce:
+            g = self._global_array(bufs, length, self._row_sharding2())
+            fn = self._allreduce2_fn(world, length, dtype, response.average,
+                                     e0.prescale_factor, e0.postscale_factor)
         else:
+            g = self._global_array(bufs, length)
             fn = self._allreduce_fn(world, length, dtype, response.average,
                                     e0.prescale_factor, e0.postscale_factor)
         out = fn(g)
@@ -300,10 +441,15 @@ class Executor:
         else:
             buf = self._jax.device_put(jnp.zeros((length,), dtype=dtype),
                                        self._rank_devices[r])
-        g = self._global_array([buf], length)
         if adasum:
+            g = self._global_array([buf], length)
             fn = self._adasum_fn(world, length, dtype)
+        elif self._hier_allreduce:
+            g = self._global_array([buf], length, self._row_sharding2())
+            fn = self._allreduce2_fn(world, length, dtype, response.average,
+                                     response.prescale, response.postscale)
         else:
+            g = self._global_array([buf], length)
             fn = self._allreduce_fn(world, length, dtype, response.average,
                                     response.prescale, response.postscale)
         out = fn(g)
@@ -341,35 +487,23 @@ class Executor:
         lmax = max(lengths.values())
         bufs = [self._pack(entries_by_rank[r], pad_to=lmax)
                 for r in self._local_ranks]
-        g = self._global_array(bufs, lmax)
-        full = self._allgather_fn(world, lmax, dtype)(g)  # replicated (world, lmax)
-
-        # build the gathered tensors ONCE (identical for every destination),
-        # then place per rank
-        import jax.numpy as jnp
-        outs = []
-        for t in range(nt):
-            segs = []
-            for src in range(world):
-                off = sum(sizes[src][:t])
-                sz = sizes[src][t]
-                segs.append(jnp.ravel(full[src])[off:off + sz])
-            cat = jnp.concatenate(segs)
-            tail = entries_by_rank[ranks[0]][t].array.shape[1:]
-            d0 = sum(int(entries_by_rank[src][t].array.shape[0])
-                     for src in range(world))
-            outs.append(cat.reshape((d0,) + tuple(tail)))
-        return {r: [self._jax.device_put(o, self._rank_devices[r])
-                    for o in outs]
-                for r in ranks}
+        sharding = self._row_sharding2() if self._hier_allgather else None
+        g = self._global_array(bufs, lmax, sharding)
+        ecounts = tuple(tuple(sizes[src][t] for src in range(world))
+                        for t in range(nt))
+        tails = tuple(tuple(entries_by_rank[ranks[0]][t].array.shape[1:])
+                      for t in range(nt))
+        outs = self._allgather_assemble_fn(world, lmax, dtype, ecounts,
+                                           tails)(g)
+        # the outputs are replicated over the rank devices — every rank
+        # reads its local copy; nothing moves through the host
+        return {r: list(outs) for r in ranks}
 
     def _exec_allgather_mp(self, response, entries_by_rank):
         """Coordinated multiprocess allgather: every rank's dim0 comes from
         the negotiated ``Response.tensor_sizes`` (the reference's allgatherv
         displacement math, `collective_operations.h:91-125`), so ragged
         gathers work with only the local entries visible."""
-        import jax.numpy as jnp
-
         world = self._world
         r = self._self_rank
         entries = entries_by_rank[r]  # allgather+join is rejected upstream
@@ -383,24 +517,17 @@ class Executor:
         lmax = max(len_r)
 
         buf = self._pack(entries, pad_to=lmax)
-        g = self._global_array([buf], lmax)
-        full = self._allgather_fn(world, lmax, dtype)(g)  # replicated
-        # slice on this process's addressable copy (the global replicated
-        # array is not device_put-able across processes)
-        local = full.addressable_data(0)
-
-        outs = []
-        for t in range(nt):
-            segs = []
-            for src in range(world):
-                off = sum(int(response.tensor_sizes[u][src]) * elems[u]
-                          for u in range(t))
-                sz = int(response.tensor_sizes[t][src]) * elems[t]
-                segs.append(jnp.ravel(local[src])[off:off + sz])
-            cat = jnp.concatenate(segs)
-            d0 = int(sum(response.tensor_sizes[t]))
-            outs.append(cat.reshape((d0,) + tails[t]))
-        return {r: outs}
+        sharding = self._row_sharding2() if self._hier_allgather else None
+        g = self._global_array([buf], lmax, sharding)
+        ecounts = tuple(
+            tuple(int(response.tensor_sizes[t][src]) * elems[t]
+                  for src in range(world))
+            for t in range(nt))
+        outs = self._allgather_assemble_fn(world, lmax, dtype, ecounts,
+                                           tuple(tails))(g)
+        # outputs are replicated global arrays; this process reads its
+        # addressable copy directly — no host round-trip
+        return {r: list(outs)}
 
     def _exec_broadcast(self, response, entries_by_rank):
         world = self._world
