@@ -256,7 +256,8 @@ class Executor:
 
     def _allgather_assemble_fn(self, world: int, lmax: int, dtype: str,
                                ecounts: Tuple[Tuple[int, ...], ...],
-                               tails: Tuple[Tuple[int, ...], ...]):
+                               tails: Tuple[Tuple[int, ...], ...],
+                               d0s: Tuple[int, ...]):
         """ONE compiled program: gather the padded per-rank buffers and
         assemble every output tensor, leaving the results replicated on the
         rank devices. Replaces the round-2 per-destination host
@@ -267,7 +268,7 @@ class Executor:
         Honors HOROVOD_HIERARCHICAL_ALLGATHER with the two-level
         ici-then-dcn gather (`mpi_operations.cc:168-310`'s node-leader
         decomposition)."""
-        key = ("allgatherA", world, lmax, dtype, ecounts, tails,
+        key = ("allgatherA", world, lmax, dtype, ecounts, tails, d0s,
                self._hier_allgather)
         fn = self._fn_cache.get(key)
         if fn is None:
@@ -287,9 +288,7 @@ class Executor:
                                  + ecounts[t][src]]
                             for src in range(world)]
                     cat = jnp.concatenate(segs) if len(segs) > 1 else segs[0]
-                    elems = int(np.prod(tail)) if tail else 1
-                    outs.append(cat.reshape((cat.shape[0] // elems,)
-                                            + tuple(tail)))
+                    outs.append(cat.reshape((d0s[t],) + tuple(tail)))
                 return tuple(outs)
 
             if self._hier_allgather:
@@ -402,15 +401,15 @@ class Executor:
                 # controller.cc:202-256, operations.cc:908-934)
                 z = jnp.zeros((length,), dtype=dtype)
                 bufs.append(self._jax.device_put(z, self._rank_devices[r]))
+        hier = self._hier_allreduce and not adasum
+        g = self._global_array(bufs, length,
+                               self._row_sharding2() if hier else None)
         if adasum:
-            g = self._global_array(bufs, length)
             fn = self._adasum_fn(world, length, dtype)
-        elif self._hier_allreduce:
-            g = self._global_array(bufs, length, self._row_sharding2())
+        elif hier:
             fn = self._allreduce2_fn(world, length, dtype, response.average,
                                      e0.prescale_factor, e0.postscale_factor)
         else:
-            g = self._global_array(bufs, length)
             fn = self._allreduce_fn(world, length, dtype, response.average,
                                     e0.prescale_factor, e0.postscale_factor)
         out = fn(g)
@@ -441,15 +440,15 @@ class Executor:
         else:
             buf = self._jax.device_put(jnp.zeros((length,), dtype=dtype),
                                        self._rank_devices[r])
+        hier = self._hier_allreduce and not adasum
+        g = self._global_array([buf], length,
+                               self._row_sharding2() if hier else None)
         if adasum:
-            g = self._global_array([buf], length)
             fn = self._adasum_fn(world, length, dtype)
-        elif self._hier_allreduce:
-            g = self._global_array([buf], length, self._row_sharding2())
+        elif hier:
             fn = self._allreduce2_fn(world, length, dtype, response.average,
                                      response.prescale, response.postscale)
         else:
-            g = self._global_array([buf], length)
             fn = self._allreduce_fn(world, length, dtype, response.average,
                                     response.prescale, response.postscale)
         out = fn(g)
@@ -493,8 +492,10 @@ class Executor:
                         for t in range(nt))
         tails = tuple(tuple(entries_by_rank[ranks[0]][t].array.shape[1:])
                       for t in range(nt))
+        d0s = tuple(sum(int(entries_by_rank[src][t].array.shape[0])
+                        for src in range(world)) for t in range(nt))
         outs = self._allgather_assemble_fn(world, lmax, dtype, ecounts,
-                                           tails)(g)
+                                           tails, d0s)(g)
         # the outputs are replicated over the rank devices — every rank
         # reads its local copy; nothing moves through the host
         return {r: list(outs) for r in ranks}
@@ -523,11 +524,14 @@ class Executor:
             tuple(int(response.tensor_sizes[t][src]) * elems[t]
                   for src in range(world))
             for t in range(nt))
+        d0s = tuple(int(sum(response.tensor_sizes[t])) for t in range(nt))
         outs = self._allgather_assemble_fn(world, lmax, dtype, ecounts,
-                                           tuple(tails))(g)
-        # outputs are replicated global arrays; this process reads its
-        # addressable copy directly — no host round-trip
-        return {r: list(outs)}
+                                           tuple(tails), d0s)(g)
+        # the jit outputs are GLOBAL replicated arrays spanning other
+        # processes' devices; hand the user this process's on-device copy
+        # (single-device, fully addressable, no host round-trip) so results
+        # chain into further ops — a global array would fail device_put
+        return {r: [o.addressable_data(0) for o in outs]}
 
     def _exec_broadcast(self, response, entries_by_rank):
         world = self._world

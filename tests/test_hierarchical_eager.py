@@ -124,3 +124,40 @@ def test_mp_two_level_bitidentical_to_flat():
     assert set(flat_res) == set(hier_res) == {0, 1, 2, 3}
     for r in range(4):
         assert flat_res[r] == hier_res[r], f"rank {r} diverged"
+
+
+def _mp_chain_worker():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    r = hvd.rank()
+    # allgather result must be USABLE as input to a further collective in
+    # multiprocess mode (fully addressable local copy, not a global array)
+    g = hvd.allgather(np.full((r + 1, 2), float(r + 1), np.float32),
+                      name="chain_g")
+    s = hvd.allreduce(np.asarray(g) * 0 + np.asarray(g), name="chain_r",
+                      op=hvd.Sum)
+    # zero-width tail: gathered dim0 must come from negotiated sizes
+    z = hvd.allgather(np.zeros((r + 2, 0), np.float32), name="chain_z")
+    return (r, np.asarray(s).tolist(), list(np.asarray(z).shape))
+
+
+@pytest.mark.integration
+def test_mp_allgather_chains_and_zero_width():
+    from horovod_tpu.run.api import run
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.pathsep.join([os.path.dirname(here), here]),
+    }
+    res = {r: (s, z) for r, s, z in
+           run(_mp_chain_worker, np=2, env=env, start_timeout=240)}
+    want = [[2.0, 2.0]] + [[4.0, 4.0]] * 2  # 2x the gathered rows
+    for r in (0, 1):
+        s, zshape = res[r]
+        assert s == want, (r, s)
+        assert zshape == [5, 0], (r, zshape)
