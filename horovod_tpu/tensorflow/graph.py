@@ -66,6 +66,43 @@ def _sanitize(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_.]", "_", name)
 
 
+def _unserialize_pyfunc_nodes() -> None:
+    """Let engine nodes overlap: exempt py_function ops from tf.function's
+    automatic control-dependency serialization.
+
+    tf.function chains every stateful op in creation order, which would
+    serialize sync(A) → start(B) — collective B could not even *submit*
+    until A completed, destroying the negotiation overlap the reference's
+    AsyncOpKernels provide (`tensorflow/mpi_ops.cc:286-345`). TF's own
+    collectives escape via the same mechanism used here
+    (`auto_control_deps.MUST_RUN_ORDER_INSENSITIVE_STATEFUL_OPS`, the list
+    holding CrossReplicaSum/CollectivePermute): ops on it still always run
+    (no pruning) but are not serialized against other stateful ops.
+
+    Cross-rank submission determinism does not depend on ACD — the start
+    halves are explicitly chained per graph (`_start`). Consequence for
+    users: two of THEIR py_functions inside one compiled step are no longer
+    implicitly ordered against each other; order-critical side effects need
+    an explicit ``tf.control_dependencies`` (set ``HVD_TF_SERIALIZE_PYFUNC=1``
+    to restore stock serialization and give up collective overlap)."""
+    from ..utils.env import env_on
+
+    if env_on("HVD_TF_SERIALIZE_PYFUNC"):
+        return
+    try:
+        from tensorflow.python.framework import auto_control_deps as _acd
+
+        # list in some TF versions, frozenset in others — rebind either way
+        _acd.MUST_RUN_ORDER_INSENSITIVE_STATEFUL_OPS = frozenset(
+            set(_acd.MUST_RUN_ORDER_INSENSITIVE_STATEFUL_OPS)
+            | {"EagerPyFunc", "PyFunc", "PyFuncStateless"})
+    except Exception:  # private module moved: keep correctness, lose overlap
+        pass
+
+
+_unserialize_pyfunc_nodes()
+
+
 def _next_trace_index() -> int:
     """Per-graph trace-order counter. All ranks trace the same program, so
     counter order — and every name derived from it — is rank-deterministic."""

@@ -81,3 +81,35 @@ def run_cluster(fn: Callable, np: int = 2, args: Sequence = (),
         if t.error is not None:
             raise t.error
     return [t.result for t in threads]
+
+
+def eager_dryrun_worker():
+    """Per-process body of the driver gate's negotiated-engine leg
+    (``__graft_entry__._dryrun_eager_leg``): fused allreduce, ragged
+    allgather and join through the coordinated engine. Lives here so it
+    pickles by importable reference (the launcher's stdlib-pickle fallback
+    cannot ship script-``__main__`` functions)."""
+    import numpy as np
+
+    from . import basics
+    from .ops import collective_ops as C
+
+    r = basics.rank()
+    outs = {}
+    # three tensors in flight at once: the coordinator fuses same-signature
+    # requests under the threshold into one response
+    hs = [C.allreduce_async(np.full((32,), float(r + i), np.float32),
+                            name=f"dr{i}", op=basics.Sum) for i in range(3)]
+    outs["ar"] = [float(np.asarray(C.synchronize(h))[0]) for h in hs]
+    # ragged allgather: rank r contributes r+1 rows
+    g = C.allgather_async(np.full((r + 1, 2), float(r), np.float32),
+                          name="drg")
+    outs["ag"] = np.asarray(C.synchronize(g)).tolist()
+    # uneven data + join: rank 0 runs one extra allreduce; the joined rank 1
+    # contributes zeros
+    if r == 0:
+        h = C.allreduce_async(np.full((4,), 5.0, np.float32), name="drj",
+                              op=basics.Sum)
+        outs["post"] = float(np.asarray(C.synchronize(h))[0])
+    outs["last"] = C.join()
+    return (r, outs)
