@@ -41,6 +41,7 @@ import hashlib
 import hmac
 import logging
 import os
+import re
 import socket
 import struct
 import threading
@@ -780,11 +781,26 @@ class CoordController:
                 self._join_handle = None
                 self._join_announced = False
         if self._rank != 0:
-            warnings = []  # only the coordinator logs stalls
+            # the coordinator logs every stall; a WORKER logs only stalls it
+            # is itself causing (its rank appears in the missing list), so a
+            # lagging rank has local evidence instead of being warn-blind
+            warnings = [w for w in warnings if self._stall_names_me(w)]
         if not responses and not join_released and not warnings:
             return None
         return (responses, handle_pairs, join_released, last_joined,
                 warnings, False)
+
+    def _stall_names_me(self, warning: str) -> bool:
+        """True if this rank is in the warning's 'waiting on ranks [...]'
+        list. The suffix is appended by CoordState._negotiate AFTER the
+        user-controlled tensor name, so take the LAST pattern match — a
+        tensor name containing the same phrase cannot shadow it
+        (format coupling pinned by test_stall_names_me_parsing)."""
+        ms = re.findall(r"waiting on ranks \[([0-9, ]*)\]", warning)
+        if not ms:
+            return False
+        missing = {int(x) for x in ms[-1].split(",") if x.strip()}
+        return self._rank in missing
 
     def _exchange(self, seq: int, payload: bytes) -> bytes:
         if self._rank == 0:

@@ -45,6 +45,19 @@ DEFAULT_CYCLE_MS = 5.0
 logger = logging.getLogger("horovod_tpu")
 
 
+def _timeline_path(mode: str, self_rank: int) -> "Optional[str]":
+    """Rank 0 writes HOROVOD_TIMELINE verbatim; in multiprocess mode every
+    other rank writes its LOCAL activity spans to ``<path>.rank<N>``
+    (reference ``--output-filename``-style suffixing) — a hung worker keeps
+    local observability instead of being trace-blind."""
+    path = os.environ.get("HOROVOD_TIMELINE")
+    if not path:
+        return None
+    if mode != "multiprocess" or self_rank == 0:
+        return path
+    return f"{path}.rank{self_rank}"
+
+
 def _make_controller(world: int, mode: str, self_rank: int = 0):
     fusion_threshold = int(_env_float("HOROVOD_FUSION_THRESHOLD",
                                       DEFAULT_FUSION_BYTES))
@@ -69,8 +82,7 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
                     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
                 cache_capacity=int(_env_float("HOROVOD_CACHE_CAPACITY", 1024)),
                 fusion_enabled=True,
-                timeline_path=(os.environ.get("HOROVOD_TIMELINE")
-                               if self_rank == 0 else None),
+                timeline_path=_timeline_path(mode, self_rank),
                 autotune=_env_on("HOROVOD_AUTOTUNE"),
                 cycle_time_ms=cycle_ms,
                 self_rank=self_rank,
@@ -89,11 +101,10 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
         # multiprocess fusion requires the cross-process control plane:
         # bucket contents must not depend on per-process tick timing
         fusion_enabled=(mode != "multiprocess"),
-        # only the coordinator writes the timeline (operations.cc:389-396);
-        # concurrent writers on a shared path would corrupt the JSON
-        timeline_path=(os.environ.get("HOROVOD_TIMELINE")
-                       if (mode != "multiprocess" or self_rank == 0)
-                       else None),
+        # rank 0 writes the shared path; multiprocess workers write local
+        # activity to a .rank<N>-suffixed file (never the shared path —
+        # concurrent writers would corrupt the JSON, operations.cc:389-396)
+        timeline_path=_timeline_path(mode, self_rank),
         autotune=_env_on("HOROVOD_AUTOTUNE"),
         cycle_time_ms=cycle_ms,
         # multiprocess: only the local rank submits to this process's table;
