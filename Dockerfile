@@ -7,12 +7,12 @@
 FROM python:3.12-slim
 
 RUN apt-get update && apt-get install -y --no-install-recommends \
-        g++ make openssh-client && \
+        g++ make openssh-client default-jre-headless && \
     rm -rf /var/lib/apt/lists/*
 
 RUN pip install --no-cache-dir \
         "jax[cpu]" flax optax chex einops ml_dtypes numpy pytest \
-        cloudpickle tensorflow-cpu && \
+        cloudpickle tensorflow-cpu pyspark && \
     pip install --no-cache-dir torch \
         --index-url https://download.pytorch.org/whl/cpu
 

@@ -26,6 +26,8 @@ stage "unit suite (8-device virtual CPU platform)"
 python -m pytest tests/ -q -m "not integration"
 
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
+# includes tests/test_spark_real.py (real-pyspark scenarios; they skip
+# when pyspark is absent from the image)
 python -m pytest tests/ -q -m integration
 
 stage "launcher smoke: 2-process training job under hvdrun"

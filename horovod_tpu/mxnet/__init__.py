@@ -8,14 +8,26 @@ gradients by 1/size (`__init__.py:40-67`), gluon ``DistributedTrainer``
 
 MXNet is NOT part of the TPU image (the project is retired upstream); this
 module exists for users porting MXNet scripts from the reference — it
-requires an environment with mxnet installed. The **priority** argument is
-accepted for API compatibility only: these ops synchronize inline, so there
-is no pending queue for priority to reorder (the reference feeds MXNet's
-dependency engine, `mxnet/mpi_ops.cc:132-200`, which has no analogue here).
+requires an environment with mxnet installed.
+
+**Priority semantics**: the reference pushes ops into MXNet's dependency
+engine with a priority that reorders pending submissions
+(`mxnet/mpi_ops.cc:132-200`). There is no dependency engine here; instead a
+:func:`deferred_execution` window provides the async-handle layer — inside
+it, the in-place ops (``allreduce_``/``broadcast_``) queue instead of
+executing, and on exit every queued op is SUBMITTED to the engine in
+(-priority, call-order) order, then synchronized and written back. The gluon
+``DistributedTrainer`` wraps its gradient pass in this window, so
+``priority`` genuinely reorders engine submission exactly where the
+reference uses it. Outside a window (and for out-of-place ops, whose return
+value is needed immediately) execution is inline and ``priority`` is a
+no-op — recorded as a disposition in docs/design.md.
 """
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional
 
 import numpy as np
@@ -72,8 +84,55 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
                                               op=op)), tensor)
 
 
+# ---------------------------------------------------------- deferral window
+# thread-local stack of pending (priority, seq, submit, writeback) entries;
+# see the module docstring for the semantics
+_defer_local = threading.local()
+
+
+def _defer_queue():
+    return getattr(_defer_local, "queue", None)
+
+
+@contextlib.contextmanager
+def deferred_execution():
+    """Async-handle window: in-place collectives called inside queue, and on
+    exit are submitted in (-priority, call-order) order — the TPU analogue
+    of the reference handing ops to MXNet's dependency engine with a
+    priority (`mxnet/mpi_ops.cc:132-200`). All ranks must order identically,
+    which holds because priorities derive from shared structure (parameter
+    indices) on every rank."""
+    if _defer_queue() is not None:
+        raise RuntimeError("deferred_execution windows do not nest")
+    _defer_local.queue = []
+    try:
+        yield
+        queue, _defer_local.queue = _defer_local.queue, None
+        order = sorted(range(len(queue)),
+                       key=lambda k: (-queue[k][0], queue[k][1]))
+        handles = [(k, queue[k][2]()) for k in order]  # submit by priority
+        for k, h in handles:
+            queue[k][3](_ops.synchronize(h))
+    finally:
+        _defer_local.queue = None
+
+
 def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
                priority: int = 0):
+    queue = _defer_queue()
+    if queue is not None:
+        op = Average if average else Sum
+        arr = _to_numpy(tensor)
+        nm = name
+
+        def submit():
+            return _ops.allreduce_async(arr, name=nm, op=op)
+
+        def writeback(result):
+            tensor[:] = _from_result(result, tensor)
+
+        queue.append((priority, len(queue), submit, writeback))
+        return tensor
     out = allreduce(tensor, average=average, name=name, priority=priority)
     tensor[:] = out
     return tensor
@@ -94,6 +153,19 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
 
 def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
                priority: int = 0):
+    queue = _defer_queue()
+    if queue is not None:
+        arr = _to_numpy(tensor)
+        nm = name
+
+        def submit():
+            return _ops.broadcast_async(arr, root_rank, name=nm)
+
+        def writeback(result):
+            tensor[:] = _from_result(result, tensor)
+
+        queue.append((priority, len(queue), submit, writeback))
+        return tensor
     out = broadcast(tensor, root_rank=root_rank, name=name, priority=priority)
     tensor[:] = out
     return tensor
@@ -131,11 +203,19 @@ def DistributedTrainer(params, optimizer, optimizer_params=None):
 
     class _Trainer(gluon.Trainer):
         def _allreduce_grads(self):
-            for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    for g in param.list_grad():
-                        allreduce_(g, average=True, name=f"grad.{i}",
-                                   priority=-i)
+            # the deferral window submits every gradient in priority order
+            # (the reference's dependency-engine priority, mpi_ops.py:52-89)
+            # before synchronizing any of them — all collectives overlap in
+            # the engine instead of running strictly one at a time
+            with deferred_execution():
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        # per-context suffix: all grads are now in flight
+                        # CONCURRENTLY, and the engine rejects duplicate
+                        # in-flight names
+                        for j, g in enumerate(param.list_grad()):
+                            allreduce_(g, average=True, name=f"grad.{i}.{j}",
+                                       priority=-i)
 
     scaled = dict(optimizer_params or {})
     return _Trainer(params, optimizer, scaled)

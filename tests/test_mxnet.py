@@ -129,3 +129,53 @@ def test_mx_broadcast_parameters_with_deferred(hvd_mx):
 
     for a in testing.run_cluster(fn, np=2):
         np.testing.assert_allclose(a, 1.0)  # root rank 1's value everywhere
+
+
+def test_mx_deferred_execution_priority_reorders_submission(hvd_mx):
+    """VERDICT r2 #8: inside a deferred_execution window, in-place ops are
+    SUBMITTED to the engine in (-priority, call-order) order — the reference's
+    dependency-engine priority semantics (`mxnet/mpi_ops.py:52-89`) — and the
+    results are still correct."""
+    from fake_mxnet import NDArray
+
+    from horovod_tpu.ops import collective_ops as C
+
+    submitted = {}  # rank -> submission order
+    real_async = C.allreduce_async
+
+    def spy(arr, name=None, **kw):
+        submitted.setdefault(hvd.rank(), []).append(name)
+        return real_async(arr, name=name, **kw)
+
+    def fn():
+        r = hvd.rank()
+        ts = {n: NDArray(np.full((4,), float(r + 1)))
+              for n in ("p0", "p5", "pneg")}
+        with hvd_mx.deferred_execution():
+            hvd_mx.allreduce_(ts["p0"], name="p0", priority=0)
+            hvd_mx.allreduce_(ts["p5"], name="p5", priority=5)
+            hvd_mx.allreduce_(ts["pneg"], name="pneg", priority=-2)
+        return {n: t.asnumpy().tolist() for n, t in ts.items()}
+
+    C.allreduce_async = spy
+    try:
+        res = testing.run_cluster(fn, np=2)
+    finally:
+        C.allreduce_async = real_async
+    # EVERY rank submitted highest priority first
+    for r, order in submitted.items():
+        assert order == ["p5", "p0", "pneg"], (r, order)
+    for out in res:
+        for n in ("p0", "p5", "pneg"):
+            assert out[n] == [1.5] * 4  # average of ranks 1 and 2
+
+
+def test_mx_deferred_execution_does_not_nest(hvd_mx):
+    def fn():
+        with hvd_mx.deferred_execution():
+            with pytest.raises(RuntimeError, match="nest"):
+                with hvd_mx.deferred_execution():
+                    pass
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))

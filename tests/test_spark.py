@@ -8,10 +8,24 @@ import pytest
 
 from tests import fake_pyspark
 
-sys.modules.setdefault("pyspark", fake_pyspark)
-
 import horovod_tpu.spark as hvd_spark  # noqa: E402
 from horovod_tpu.spark.task import rank_env_from_hosts  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fake_pyspark_installed():
+    """Pin the FAKE pyspark for this module's duration only — a real
+    installed pyspark (Docker CI image) must stay importable for
+    tests/test_spark_real.py, and the fake must win here even then."""
+    prev = sys.modules.get("pyspark")
+    sys.modules["pyspark"] = fake_pyspark
+    try:
+        yield
+    finally:
+        if prev is None:
+            sys.modules.pop("pyspark", None)
+        else:
+            sys.modules["pyspark"] = prev
 
 
 def _env_probe():
