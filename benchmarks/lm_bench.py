@@ -129,7 +129,16 @@ def main():
         updates, opt = tx.update(grads, opt, p)
         return optax.apply_updates(p, updates), opt, loss
 
-    jitted = jax.jit(_step, out_shardings=(repl, repl, repl),
+    opt_sh = repl
+    if os.environ.get("LM_ZERO1", "0") == "1":
+        # shard AdamW m/v 1/N over the replica axis (optim/zero.py); a
+        # single-chip mesh degenerates to replicated, multi-chip runs keep
+        # 1/N of the state per chip
+        from horovod_tpu.optim.zero import zero1_shardings
+
+        opt_sh = zero1_shardings(opt_state, mesh)
+        opt_state = jax.tree_util.tree_map(jax.device_put, opt_state, opt_sh)
+    jitted = jax.jit(_step, out_shardings=(repl, opt_sh, repl),
                      donate_argnums=(0, 1) if donate else ())
     step = jitted
     if on_tpu:

@@ -110,11 +110,36 @@ def deferred_execution():
         queue, _defer_local.queue = _defer_local.queue, None
         order = sorted(range(len(queue)),
                        key=lambda k: (-queue[k][0], queue[k][1]))
-        handles = [(k, queue[k][2]()) for k in order]  # submit by priority
-        for k, h in handles:
-            queue[k][3](_ops.synchronize(h))
+        handles = []
+        try:
+            for k in order:  # submit by priority
+                handles.append((k, queue[k][2]()))
+            for k, h in handles:
+                queue[k][3](_ops.synchronize(h))
+        except Exception:
+            # drain whatever is already in flight so a transient error does
+            # not orphan named ops (which would collide as duplicates or
+            # stall peers on the NEXT step), then surface the original
+            for k, h in handles:
+                try:
+                    _ops.synchronize(h)
+                except Exception:
+                    pass
+            raise
     finally:
         _defer_local.queue = None
+
+
+def _enqueue_deferred(queue, priority, tensor, submit):
+    """Queue one in-place op: snapshot the input now (the engine sees the
+    value at call time, like the reference's engine push), write back on
+    synchronize."""
+
+    def writeback(result):
+        tensor[:] = _from_result(result, tensor)
+
+    queue.append((priority, len(queue), submit, writeback))
+    return tensor
 
 
 def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
@@ -123,16 +148,9 @@ def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
     if queue is not None:
         op = Average if average else Sum
         arr = _to_numpy(tensor)
-        nm = name
-
-        def submit():
-            return _ops.allreduce_async(arr, name=nm, op=op)
-
-        def writeback(result):
-            tensor[:] = _from_result(result, tensor)
-
-        queue.append((priority, len(queue), submit, writeback))
-        return tensor
+        return _enqueue_deferred(
+            queue, priority, tensor,
+            lambda: _ops.allreduce_async(arr, name=name, op=op))
     out = allreduce(tensor, average=average, name=name, priority=priority)
     tensor[:] = out
     return tensor
@@ -156,16 +174,9 @@ def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
     queue = _defer_queue()
     if queue is not None:
         arr = _to_numpy(tensor)
-        nm = name
-
-        def submit():
-            return _ops.broadcast_async(arr, root_rank, name=nm)
-
-        def writeback(result):
-            tensor[:] = _from_result(result, tensor)
-
-        queue.append((priority, len(queue), submit, writeback))
-        return tensor
+        return _enqueue_deferred(
+            queue, priority, tensor,
+            lambda: _ops.broadcast_async(arr, root_rank, name=name))
     out = broadcast(tensor, root_rank=root_rank, name=name, priority=priority)
     tensor[:] = out
     return tensor

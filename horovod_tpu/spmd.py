@@ -166,7 +166,8 @@ def replicate(tree, mesh: Optional[Mesh] = None):
 
 
 def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True, zero1: bool = False,
+                    example_opt_state=None) -> Callable:
     """Build the jitted data-parallel train step (the bench hot loop).
 
     ``loss_fn(params, batch) -> scalar loss`` computed on the *local* shard;
@@ -174,11 +175,26 @@ def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
     because params are replicated while the batch is sharded. ``tx`` is an
     optax GradientTransformation. Returns
     ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    ``zero1=True`` shards the optimizer state 1/N over the replica axis
+    (`optim/zero.py`): pass ``example_opt_state`` (an abstract or concrete
+    ``tx.init(params)`` pytree) so the per-leaf shardings can be derived,
+    and place the live state with :func:`optim.zero.shard_opt_state` before
+    the first call.
     """
     import optax
 
     mesh = mesh or basics.mesh()
     repl = NamedSharding(mesh, P())
+    opt_sh: Any = repl
+    if zero1:
+        if example_opt_state is None:
+            raise ValueError(
+                "zero1=True needs example_opt_state (tx.init(params) or its "
+                "jax.eval_shape) to derive per-leaf shardings")
+        from .optim.zero import zero1_shardings
+
+        opt_sh = zero1_shardings(example_opt_state, mesh)
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -190,5 +206,5 @@ def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
     return jax.jit(
         step,
         donate_argnums=donate_argnums,
-        out_shardings=(repl, repl, repl),
+        out_shardings=(repl, opt_sh, repl),
     )

@@ -47,35 +47,30 @@ def spark_session():
     spark.stop()
 
 
-def _allgather_fn():
-    import numpy as np
+def _make_allgather_fn():
+    # defined INSIDE a function: cloudpickle serializes the closure by VALUE,
+    # so Spark python workers (which cannot import this test module — tests/
+    # is only on the pytest driver's sys.path) can still run it
+    def fn():
+        import numpy as np
 
-    import horovod_tpu as hvd
+        import horovod_tpu as hvd
 
-    hvd.init()
-    r = hvd.rank()
-    out = hvd.allgather(np.asarray([r], np.int64), name="ranks")
-    res = [int(x) for x in np.asarray(out)]
-    hvd.shutdown()
-    return res, r
+        hvd.init()
+        r = hvd.rank()
+        out = hvd.allgather(np.asarray([r], np.int64), name="ranks")
+        res = [int(x) for x in np.asarray(out)]
+        hvd.shutdown()
+        return res, r
 
-
-def _failing_fn():
-    import horovod_tpu as hvd
-
-    hvd.init()
-    r = hvd.rank()
-    if r == 1:
-        raise RuntimeError("boom on rank 1")
-    hvd.shutdown()
-    return r
+    return fn
 
 
 @pytest.mark.integration
 def test_real_spark_happy_run(spark_session):
     """Reference `test_spark.py:83-91`: a real collective across barrier
     tasks, per-rank results in rank order."""
-    res = horovod_tpu.spark.run(_allgather_fn, num_proc=2,
+    res = horovod_tpu.spark.run(_make_allgather_fn(), num_proc=2,
                                 extra_env=dict(_RANK_ENV))
     assert res == [([0, 1], 0), ([0, 1], 1)]
 
@@ -85,15 +80,25 @@ def test_real_spark_startup_timeout(spark_session):
     """Reference `test_spark.py:93-98`: more tasks than the cluster can
     schedule at once -> startup timeout, not a hang."""
     with pytest.raises(TimeoutError, match="tasks were"):
-        horovod_tpu.spark.run(_allgather_fn, num_proc=4, start_timeout=8,
-                              extra_env=dict(_RANK_ENV))
+        horovod_tpu.spark.run(_make_allgather_fn(), num_proc=4,
+                              start_timeout=8, extra_env=dict(_RANK_ENV))
 
 
 @pytest.mark.integration
 def test_real_spark_rank_failure(spark_session):
     """Reference `test_spark.py:134-137` (non-zero exit): a failing rank
     surfaces as RuntimeError naming the rank, with the traceback."""
+    def failing():
+        import horovod_tpu as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        if r == 1:
+            raise RuntimeError("boom on rank 1")
+        hvd.shutdown()
+        return r
+
     with pytest.raises(RuntimeError, match="rank") as exc:
-        horovod_tpu.spark.run(_failing_fn, num_proc=2,
+        horovod_tpu.spark.run(failing, num_proc=2,
                               extra_env=dict(_RANK_ENV))
     assert "boom on rank 1" in str(exc.value)

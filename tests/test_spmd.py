@@ -139,3 +139,78 @@ def test_spmd_reduce_scatter_allgather_roundtrip():
     out = _shard_map(fn, mesh, P("hvd"), P("hvd"))(gx)
     np.testing.assert_allclose(np.asarray(out),
                                np.full((n, n * 2), float(n)))
+
+
+# ------------------------------------------------------------------- ZeRO-1
+def test_zero1_state_sharded_and_math_identical():
+    """optim/zero.py: optimizer state shards 1/N over the replica axis; the
+    training math matches the replicated step's (GSPMD only changes
+    placement)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.optim.zero import shard_opt_state, zero1_shardings
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n = mesh.shape["hvd"]
+    rng = np.random.RandomState(0)
+    dim = 8 * n
+    xs = jnp.asarray(rng.randn(4 * n, dim).astype(np.float32))
+    w_true = jnp.asarray(rng.randn(dim).astype(np.float32))
+    ys = xs @ w_true
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ params["w"] - yb) ** 2)
+
+    tx = optax.adamw(1e-2)
+    params0 = {"w": jnp.zeros(dim)}
+    opt0 = tx.init(params0)
+
+    step_r = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False)
+    p_r = spmd.replicate(params0, mesh)
+    o_r = spmd.replicate(opt0, mesh)
+    batch = (spmd.shard_batch(xs, mesh), spmd.shard_batch(ys, mesh))
+    for _ in range(5):
+        p_r, o_r, loss_r = step_r(p_r, o_r, batch)
+
+    step_z = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False,
+                                  zero1=True, example_opt_state=opt0)
+    p_z = spmd.replicate(params0, mesh)
+    o_z = shard_opt_state(opt0, mesh)
+    mu_leaf = o_z[0].mu["w"]
+    assert mu_leaf.addressable_shards[0].data.shape == (dim // n,)
+    for _ in range(5):
+        p_z, o_z, loss_z = step_z(p_z, o_z, batch)
+
+    np.testing.assert_allclose(np.asarray(p_r["w"]), np.asarray(p_z["w"]),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(float(loss_r), float(loss_z), rtol=1e-6)
+    abstract = jax.eval_shape(tx.init, params0)
+    sh = zero1_shardings(abstract, mesh)
+    assert sh[0].mu["w"].spec == jax.sharding.PartitionSpec("hvd")
+
+
+def test_zero1_odd_shapes_replicate():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.optim.zero import zero1_shardings
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n = mesh.shape["hvd"]
+    params = {"odd": jnp.zeros((n + 1,)), "scalar": jnp.zeros(()),
+              "mat": jnp.zeros((3, 2 * n))}
+    tx = optax.adam(1e-3)
+    sh = zero1_shardings(tx.init(params), mesh)
+    P = jax.sharding.PartitionSpec
+    assert sh[0].mu["odd"].spec == P()
+    assert sh[0].mu["scalar"].spec == P()
+    assert sh[0].mu["mat"].spec == P(None, "hvd")
