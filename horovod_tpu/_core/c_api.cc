@@ -251,6 +251,18 @@ int32_t hvd_tuner_update(int64_t h, int64_t bytes, double seconds) {
   return (it != g_tuners.end() && it->second->Update(bytes, seconds)) ? 1 : 0;
 }
 
+// 1 while still exploring; 0 once settled on the best configuration
+int32_t hvd_tuner_active(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_tuners.find(h);
+  return (it != g_tuners.end() && it->second->enabled()) ? 1 : 0;
+}
+
+int32_t hvd_core_autotune_active(int64_t eng) {
+  EngineCore* c = Get(eng);
+  return (c && c->params->enabled()) ? 1 : 0;
+}
+
 int64_t hvd_tuner_threshold(int64_t h) {
   std::lock_guard<std::mutex> l(g_mu);
   auto it = g_tuners.find(h);

@@ -217,8 +217,17 @@ class CoordState:
         if self.tuner is None:
             return None
         if self.round_bytes > 0 and self.round_seconds > 0:
-            if self.tuner.update(self.round_bytes, self.round_seconds):
+            changed = self.tuner.update(self.round_bytes, self.round_seconds)
+            if changed:
                 self.threshold = int(self.tuner.fusion_threshold())
+            if changed or self.tuner.active():
+                # stop logging once the GP settles (bounded file growth;
+                # the settling update itself is the last line)
+                from ..utils.autotune_log import log_sample
+
+                log_sample(os.environ.get("HOROVOD_AUTOTUNE_LOG"),
+                           self.round_bytes, self.round_seconds,
+                           self.threshold, float(self.tuner.cycle_time_ms()))
             self.round_bytes = 0
             self.round_seconds = 0.0
         self.tuned = (self.threshold, float(self.tuner.cycle_time_ms()))

@@ -220,8 +220,24 @@ class Engine:
         return user
 
     def report_score(self, nbytes: int, seconds: float) -> None:
-        if self.controller.report_score(nbytes, seconds):
+        changed = self.controller.report_score(nbytes, seconds)
+        if changed:
             self.cycle_time_s = self.controller.cycle_time_ms() / 1e3
+        # in-process tuner: log while it still explores (the coordinated
+        # controller's samples are logged where they are aggregated and
+        # scored — the rank-0 coordinator)
+        active = getattr(self.controller, "autotune_active", None)
+        if active is not None and (changed or active()):
+            from ..utils.autotune_log import log_sample
+
+            path = os.environ.get("HOROVOD_AUTOTUNE_LOG")
+            if path and self._mode == "multiprocess" and self._state.rank0:
+                # fallback (uncoordinated) multiprocess: every process has
+                # its own tuner; same per-rank suffixing as the timeline
+                path = f"{path}.rank{self._state.rank0}"
+            log_sample(path, nbytes, seconds,
+                       self.controller.fusion_threshold(),
+                       self.controller.cycle_time_ms())
 
     # ----------------------------------------------------------------- loop
     def _loop(self) -> None:

@@ -102,6 +102,10 @@ def load_library():
         lib.hvd_core_cache_hits.argtypes = [ctypes.c_int64]
         lib.hvd_core_cache_misses.restype = ctypes.c_uint64
         lib.hvd_core_cache_misses.argtypes = [ctypes.c_int64]
+        lib.hvd_tuner_active.restype = ctypes.c_int32
+        lib.hvd_tuner_active.argtypes = [ctypes.c_int64]
+        lib.hvd_core_autotune_active.restype = ctypes.c_int32
+        lib.hvd_core_autotune_active.argtypes = [ctypes.c_int64]
         lib.hvd_tuner_create.restype = ctypes.c_int64
         lib.hvd_tuner_create.argtypes = [ctypes.c_int64, ctypes.c_double,
                                          ctypes.c_uint64]
@@ -136,6 +140,10 @@ class NativeTuner:
     def update(self, nbytes: int, seconds: float) -> bool:
         """Record one scored interval; True if tuned params changed."""
         return bool(self._lib.hvd_tuner_update(self._h, nbytes, seconds))
+
+    def active(self) -> bool:
+        """True while the GP is still exploring (False once settled)."""
+        return bool(self._lib.hvd_tuner_active(self._h))
 
     def fusion_threshold(self) -> int:
         return self._lib.hvd_tuner_threshold(self._h)
@@ -228,6 +236,9 @@ class NativeController:
     def report_score(self, nbytes: int, seconds: float) -> bool:
         return bool(self._lib.hvd_core_report_score(self._eng, nbytes,
                                                     seconds))
+
+    def autotune_active(self) -> bool:
+        return bool(self._lib.hvd_core_autotune_active(self._eng))
 
     def fusion_threshold(self) -> int:
         return self._lib.hvd_core_fusion_threshold(self._eng)

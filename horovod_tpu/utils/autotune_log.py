@@ -1,0 +1,43 @@
+"""Autotune sample log (HOROVOD_AUTOTUNE_LOG / --autotune-log).
+
+Reference parity: the parameter manager's CSV sample log
+(`horovod/common/parameter_manager.cc` SetAutoTuningLog role) — one line per
+scored interval (~10 intervals feed each GP sample) while the tuner is still
+exploring, ending with the settling update, so a user can see what the GP
+explored and where it settled. Written by whichever component runs the
+tuner: the in-process engine (standalone/cluster modes, per-rank suffix in
+the uncoordinated multiprocess fallback) or the rank-0 coordinator.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_header_written: set = set()
+
+
+def log_sample(path: Optional[str], nbytes: int, seconds: float,
+               fusion_threshold: int, cycle_time_ms: float) -> None:
+    """Append one CSV sample; creates the file with a header on first use.
+    Never raises — a broken log path must not take down training."""
+    if not path:
+        return
+    try:
+        with _lock:
+            new = path not in _header_written and (
+                not os.path.exists(path) or os.path.getsize(path) == 0)
+            with open(path, "a") as f:
+                if new:
+                    f.write("timestamp,bytes,seconds,score_bytes_per_sec,"
+                            "fusion_threshold,cycle_time_ms\n")
+                score = nbytes / seconds if seconds > 0 else 0.0
+                f.write(f"{time.time():.3f},{nbytes},{seconds:.6f},"
+                        f"{score:.1f},{fusion_threshold},"
+                        f"{cycle_time_ms:.3f}\n")
+            _header_written.add(path)
+    except OSError:
+        pass

@@ -118,3 +118,35 @@ def test_cache_hit_rate_visible_in_timeline(tmp_path, monkeypatch):
     assert last["hits"] + last["misses"] > 0
     if hits + misses > 0 and hits > 0:
         assert last["hits"] > 0
+
+
+def test_autotune_log_written(tmp_path, monkeypatch):
+    """HOROVOD_AUTOTUNE_LOG (parity: the parameter manager's sample log)
+    records one CSV line per scored interval, in-process mode."""
+    import horovod_tpu as hvd
+    from horovod_tpu import testing
+    from horovod_tpu.ops import collective_ops as C
+
+    log = tmp_path / "at.csv"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+    if hvd.is_initialized():
+        hvd.shutdown()
+
+    def fn():
+        import numpy as np
+
+        r = hvd.rank()
+        for i in range(6):
+            h = C.allreduce_async(np.full((128,), float(r), np.float32),
+                                  name="atlog", op=hvd.Sum)
+            C.synchronize(h)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+    hvd.shutdown()
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("timestamp,bytes,seconds,")
+    assert len(lines) >= 3  # several scored intervals (first exec unscored)
+    parts = lines[1].split(",")
+    assert int(parts[1]) > 0 and float(parts[4]) > 0
