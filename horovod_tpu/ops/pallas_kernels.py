@@ -39,6 +39,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 
+# Mosaic grid semantics: independent cells may pipeline freely ("parallel");
+# an innermost dimension that revisits/accumulates into the same output tile
+# must stay sequential ("arbitrary").
+_SEM_PAR2 = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel"))
+_SEM_PAR_ARB = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary"))
+_SEM_PAR2_ARB = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
 
 def mode() -> str:
     """'on' | 'off' | 'interpret' — resolved from HVD_PALLAS + backend."""
@@ -260,6 +270,8 @@ def _flash_step_call_streaming(qt, kt, vt, mt, lt, ot, offs, *, causal,
             _struct((bh, tq, 1), jnp.float32, qt, kt, mt, offs),
             _struct((bh, tq, d), jnp.float32, qt, kt, mt, offs),
         ],
+        # k is innermost and ACCUMULATES into the revisited q-side tiles
+        compiler_params=_SEM_PAR2_ARB,
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * tq * tk * d,
             bytes_accessed=4 * (2 * bh * tq * d + 2 * bh * tk * d),
@@ -310,6 +322,8 @@ def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
             flops=flops,
             bytes_accessed=4 * (2 * bh * tq * d + 2 * bh * tk * d),
             transcendentals=bh * tq * tk),
+        # independent grid cells: Mosaic may pipeline across bh and q tiles
+        compiler_params=_SEM_PAR2,
         interpret=interpret,
     )(offs, qt, kt, vt, mt, lt, ot)
 
@@ -588,6 +602,7 @@ def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
             flops=6 * bh * tq * tk * d,
             bytes_accessed=4 * bh * (3 * tq * d + 2 * tk * d),
             transcendentals=bh * tq * tk),
+        compiler_params=_SEM_PAR2,
         interpret=interpret,
     )(offs, lset, ddt, qt, kt, vt, dot)
 
@@ -618,6 +633,7 @@ def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
             flops=8 * bh * tq * tk * d,
             bytes_accessed=4 * bh * (3 * tq * d + 3 * tk * d),
             transcendentals=bh * tq * tk),
+        compiler_params=_SEM_PAR2,
         interpret=interpret,
     )(offs, lset, ddt, qt, kt, vt, dot)
 
@@ -684,6 +700,7 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
             flops=6 * bh * tq * tk * d,
             bytes_accessed=4 * bh * (3 * tq * d + 2 * tk * d),
             transcendentals=bh * tq * tk),
+        compiler_params=_SEM_PAR2_ARB,
         interpret=interpret,
     )(offs, lset, ddt, qt, kt, vt, dot)
 
@@ -714,6 +731,7 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
             flops=8 * bh * tq * tk * d,
             bytes_accessed=4 * bh * (3 * tq * d + 3 * tk * d),
             transcendentals=bh * tq * tk),
+        compiler_params=_SEM_PAR2_ARB,
         interpret=interpret,
     )(offs, lset, ddt, qt, kt, vt, dot)
 
@@ -879,6 +897,8 @@ def adasum_combine_pairs(a, b):
         out_specs=s_tile,
         out_shape=_struct((m, 8, _LANES), jnp.float32, af, bf),
         scratch_shapes=[pltpu.SMEM((3,), jnp.float32)],
+        # j accumulates dot/norms into the SAME revisited scalar tile
+        compiler_params=_SEM_PAR_ARB,
         interpret=interpret,
     )(af, bf)
 
@@ -888,6 +908,7 @@ def adasum_combine_pairs(a, b):
         in_specs=[s_tile, tile, tile],
         out_specs=tile,
         out_shape=_struct((m, rows, _LANES), dtype, af, bf),
+        compiler_params=_SEM_PAR2,
         interpret=interpret,
     )(scalars, af, bf)
     return out.reshape(shape)
