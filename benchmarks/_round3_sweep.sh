@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-3 TPU re-measurement: run when the axon tunnel returns.
+# Each line prints the config then the bench JSON.
+set -u
+cd "$(dirname "$0")/.."
+
+run() {
+  echo "=== $* ==="
+  timeout 560 env "$@" python benchmarks/lm_bench.py 2>&1 | tail -2
+}
+
+# 1. round-2 kernel config (block 128, no new levers) — regression anchor
+run LM_REMAT=none LM_CHUNKED_LOSS=0 LM_MU_DTYPE=f32 LM_DONATE=0 HVD_PALLAS_BLOCK=128
+# 2. block 128 + donation/mu/chunked (isolates the dimension-semantics delta vs the recorded 26.7k)
+run LM_REMAT=none HVD_PALLAS_BLOCK=128
+# 3. round-3 default (block 256 + semantics) — headline candidate
+run LM_REMAT=none
+# 4. block 256, batch 16 (semantics may change the batch story)
+run LM_REMAT=none LM_BATCH=16
+# 5. ResNet sanity (the driver's bench.py metric)
+echo "=== bench.py ==="
+timeout 560 python bench.py 2>&1 | tail -2
