@@ -20,3 +20,9 @@ run LM_REMAT=none LM_BATCH=16
 # 5. ResNet sanity (the driver's bench.py metric)
 echo "=== bench.py ==="
 timeout 560 python bench.py 2>&1 | tail -2
+
+# 6. asymmetric backward blocks at 256 base
+run LM_REMAT=none HVD_PALLAS_BLOCK_Q=512 HVD_PALLAS_BLOCK_K=256
+run LM_REMAT=none HVD_PALLAS_BLOCK_Q=256 HVD_PALLAS_BLOCK_K=512
+# 7. long-context point with the new defaults (round-2: 4586 tok/s)
+run LM_SEQ=8192 LM_BATCH=1 LM_REMAT=none

@@ -90,15 +90,23 @@ def vma_active(*arrays) -> bool:
     return any(getattr(jax.typeof(x), "vma", frozenset()) for x in arrays)
 
 
-def _pick_block(t: int, preferred: int = None) -> Optional[int]:
+def _pick_block(t: int, preferred: int = None,
+                side: Optional[str] = None) -> Optional[int]:
     """Largest power-of-2 tile ≤ preferred dividing t (None if none ≥ 8).
 
     Default tile edge comes from ``HVD_PALLAS_BLOCK`` (256 if unset): bigger
-    tiles mean quadratically fewer grid cells — measured 26.7k → 31.1k tok/s
+    tiles mean quadratically fewer grid cells — measured 26.7k → 32.7k tok/s
     on the lm_bench step going 128 → 256 on a v5e, where per-cell grid
-    overhead, not FLOPs, dominated the attention kernels."""
+    overhead, not FLOPs, dominated the attention kernels.
+    ``side`` ("q" or "k") lets ``HVD_PALLAS_BLOCK_Q`` / ``HVD_PALLAS_BLOCK_K``
+    override the two sides independently for tuning."""
     if preferred is None:
-        preferred = int(os.environ.get("HVD_PALLAS_BLOCK", "256"))
+        if side is not None:
+            v = os.environ.get(f"HVD_PALLAS_BLOCK_{side.upper()}")
+            if v:
+                preferred = int(v)
+        if preferred is None:
+            preferred = int(os.environ.get("HVD_PALLAS_BLOCK", "256"))
     b = preferred
     while b >= 8:
         if t % b == 0:
@@ -370,8 +378,8 @@ def flash_attention_step(q, k, v, m, l, o, q_off, k_off, *,
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    block_q = _pick_block(tq)
-    block_k = _pick_block(tk)
+    block_q = _pick_block(tq, side="q")
+    block_k = _pick_block(tk, side="k")
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
@@ -646,8 +654,8 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
     OK — ring hops).  Returns (dq, dk, dv) in f32."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    block_q = _pick_block(tq)
-    block_k = _pick_block(tk)
+    block_q = _pick_block(tq, side="q")
+    block_k = _pick_block(tk, side="k")
     bh = b * h
 
     def heads_major(x):
