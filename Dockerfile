@@ -12,7 +12,7 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 
 RUN pip install --no-cache-dir \
         "jax[cpu]" flax optax chex einops ml_dtypes numpy pytest \
-        cloudpickle tensorflow-cpu pyspark && \
+        cloudpickle tensorflow-cpu pyspark orbax-checkpoint && \
     pip install --no-cache-dir torch \
         --index-url https://download.pytorch.org/whl/cpu
 

@@ -64,6 +64,65 @@ def save(path: str, state: Any, overwrite: bool = True) -> bool:
     return True
 
 
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise ImportError(
+            "save_sharded/restore_sharded require orbax-checkpoint "
+            "(pip install orbax-checkpoint); the replicated save/restore "
+            "path has no such dependency") from e
+    return ocp
+
+
+def save_sharded(path: str, state: Any) -> None:
+    """Checkpoint a pytree that contains SHARDED global arrays (ZeRO-1
+    optimizer state, tensor-parallel params) via orbax: every host writes
+    only the shards it owns, so nothing is gathered through one host's
+    memory — the TPU-native extension of the reference's rank-0 pattern,
+    needed once state stops being replicated (`optim/zero.py`). ``path``
+    becomes a directory; all processes must call this collectively."""
+    ocp = _orbax()
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        ckptr.save(os.path.abspath(path), state, force=True)
+        ckptr.wait_until_finished()
+    finally:
+        ckptr.close()
+
+
+def restore_sharded(path: str, template: Any) -> Any:
+    """Restore a :func:`save_sharded` checkpoint with the SHARDINGS of
+    ``template``: a pytree of device-placed arrays (or
+    ``jax.ShapeDtypeStruct`` with shardings) matching the saved structure —
+    each host reads only its shards and the restored arrays come back
+    placed exactly like the template, so no broadcast pass is needed
+    (unlike the replicated :func:`restore_and_broadcast` path). Every array
+    leaf must carry a sharding; restoring onto an unplaced template would
+    silently fall back to whatever topology saved the checkpoint."""
+    ocp = _orbax()
+    import numpy as np
+
+    def abstract(leaf):
+        shape = np.shape(leaf)
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            raise ValueError(
+                "restore_sharded: template leaf has no sharding "
+                f"(shape {shape}); pass device-placed arrays (e.g. via "
+                "optim.zero.shard_opt_state / spmd.replicate) so the "
+                "restore targets THIS topology, not the saving one")
+        dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    target = jax.tree_util.tree_map(abstract, template)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(os.path.abspath(path), target)
+    finally:
+        ckptr.close()
+
+
 def restore(path: str, template: Any) -> Any:
     """Load a checkpoint into the structure of ``template`` (local read —
     use :func:`restore_and_broadcast` in multi-rank jobs)."""
