@@ -161,3 +161,54 @@ def test_mp_allgather_chains_and_zero_width():
         s, zshape = res[r]
         assert s == want, (r, s)
         assert zshape == [5, 0], (r, zshape)
+
+
+def _fused_scaled_worker():
+    r = hvd.rank()
+    import ml_dtypes
+
+    outs = []
+    # several same-signature tensors in flight: the controller fuses them,
+    # and the two-level kernel must unpack the fused buffer identically
+    hs = [C.allreduce_async(np.full((64,), float(r + i), np.float32),
+                            name=f"fz{i}", op=hvd.Sum) for i in range(4)]
+    outs.append([float(np.asarray(C.synchronize(h))[0]) for h in hs])
+    # prescale/postscale ride the decomposed path too
+    h = C.allreduce_async(np.full((8,), float(r + 1), np.float32),
+                          name="fz_scaled", op=hvd.Sum,
+                          prescale_factor=2.0, postscale_factor=0.5)
+    outs.append(float(np.asarray(C.synchronize(h))[0]))
+    # bf16 wire dtype through pad/reduce_scatter/all_gather
+    b = np.asarray([r + 1] * 24, ml_dtypes.bfloat16)
+    h = C.allreduce_async(b, name="fz_bf16", op=hvd.Average)
+    out = np.asarray(C.synchronize(h))
+    outs.append((str(out.dtype), float(out.astype(np.float32)[0])))
+    return outs
+
+
+def test_two_level_fusion_scales_and_bf16(monkeypatch):
+    """Fusion buckets, prescale/postscale and bf16 all flow through the
+    hierarchical decomposition bit-identically to the flat mesh."""
+    def run_cfg(hier):
+        if hvd.is_initialized():
+            hvd.shutdown()
+        if hier:
+            monkeypatch.setenv("HVD_LOCAL_SIZE", "4")
+            monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+        else:
+            monkeypatch.delenv("HVD_LOCAL_SIZE", raising=False)
+            monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE",
+                               raising=False)
+        res = testing.run_cluster(_fused_scaled_worker, np=8)
+        hvd.shutdown()
+        return res
+
+    flat = run_cfg(False)
+    hier = run_cfg(True)
+    assert flat == hier
+    # and the values are right: sum over ranks 0..7 of (r+i)
+    for r_outs in hier:
+        assert r_outs[0] == [28.0 + 8 * i for i in range(4)]
+        assert r_outs[1] == 36.0  # 2.0 * sum(r+1) * 0.5
+        dt, v = r_outs[2]
+        assert dt == "bfloat16" and v == 4.5  # mean of 1..8
