@@ -39,6 +39,13 @@ from .expert import (  # noqa: F401
     make_ep_train_step,
     shard_params_ep,
 )
+from .hybrid import (  # noqa: F401
+    hybrid_model,
+    make_dp_tp_sp_mesh,
+    make_hybrid_train_step,
+    shard_data_hybrid,
+    shard_params_hybrid,
+)
 from .pipeline import (  # noqa: F401
     make_pipeline_fn,
     make_pp_mesh,

@@ -163,19 +163,22 @@ def _ring_fa_vjp(axis_name: str, causal: bool, scale: float):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, use_pallas: bool = True):
     """Exact (flash-accumulated) attention across a sequence-sharded ring.
 
     Call inside ``shard_map`` with q/k/v sharded on dim 1 (sequence) over
     ``axis_name``. Shapes per shard: ``[batch, seq/sp, heads, head_dim]``.
     Returns the attention output in the input dtype, same sharding.
+    ``use_pallas=False`` forces the jnp block path — needed where a Pallas
+    custom call cannot be partitioned (heads sharded over a GSPMD auto
+    axis, `parallel/hybrid.py`).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
     from ..ops import pallas_kernels
 
-    if pallas_kernels.step_supported(q, k):
+    if use_pallas and pallas_kernels.step_supported(q, k):
         # Pallas forward AND ring-structured Pallas backward (the blockwise
         # backward kernels cover any shard length — resident or streaming)
         return _ring_fa_vjp(axis_name, causal, float(scale))(q, k, v)

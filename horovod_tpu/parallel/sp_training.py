@@ -76,13 +76,18 @@ def _check_global_seq_len(model, t_local: int, mesh: Mesh, sp_axis: str):
 
 
 def make_sp_train_step(model, tx, mesh: Mesh, dp_axis: str = DP_AXIS,
-                       sp_axis: str = SP_AXIS):
+                       sp_axis: str = SP_AXIS, manual_axes=None):
     """Jitted full training step: ``(params, opt_state, tokens, targets) ->
     (params, opt_state, loss)``.
 
     ``tokens``/``targets`` are GLOBAL ``[B, T]`` int arrays (shift-by-one
     target construction happens before sharding, so next-token targets are
     correct across shard boundaries); the step shards them ``P(dp, sp)``.
+
+    ``manual_axes`` restricts which mesh axes shard_map makes manual
+    (default: all). `parallel/hybrid.py` passes {dp, sp} so a third ``tp``
+    axis stays GSPMD-automatic and tensor-parallel param shardings flow
+    through this same step unchanged.
     """
     import optax
 
@@ -105,11 +110,12 @@ def make_sp_train_step(model, tx, mesh: Mesh, dp_axis: str = DP_AXIS,
         return new_params, new_opt, loss
 
     data_spec = P(dp_axis, sp_axis)
+    extra = {} if manual_axes is None else {"axis_names": set(manual_axes)}
     fn = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), data_spec, data_spec),
         out_specs=(P(), P(), P()),
-        check_vma=False)
+        check_vma=False, **extra)
     return jax.jit(fn, donate_argnums=(0, 1))
 
 

@@ -37,7 +37,8 @@ def _allreduce_worker():
     return outs
 
 
-def _run_cluster_config(monkeypatch, hier: bool, np_ranks: int = 8):
+def _run_cluster_config(monkeypatch, hier: bool, np_ranks: int = 8,
+                        worker=None):
     if hvd.is_initialized():
         hvd.shutdown()
     if hier:
@@ -48,7 +49,7 @@ def _run_cluster_config(monkeypatch, hier: bool, np_ranks: int = 8):
         monkeypatch.delenv("HVD_LOCAL_SIZE", raising=False)
         monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
         monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLGATHER", raising=False)
-    res = testing.run_cluster(_allreduce_worker, np=np_ranks)
+    res = testing.run_cluster(worker or _allreduce_worker, np=np_ranks)
     hvd.shutdown()
     return res
 
@@ -189,22 +190,10 @@ def _fused_scaled_worker():
 def test_two_level_fusion_scales_and_bf16(monkeypatch):
     """Fusion buckets, prescale/postscale and bf16 all flow through the
     hierarchical decomposition bit-identically to the flat mesh."""
-    def run_cfg(hier):
-        if hvd.is_initialized():
-            hvd.shutdown()
-        if hier:
-            monkeypatch.setenv("HVD_LOCAL_SIZE", "4")
-            monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
-        else:
-            monkeypatch.delenv("HVD_LOCAL_SIZE", raising=False)
-            monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE",
-                               raising=False)
-        res = testing.run_cluster(_fused_scaled_worker, np=8)
-        hvd.shutdown()
-        return res
-
-    flat = run_cfg(False)
-    hier = run_cfg(True)
+    flat = _run_cluster_config(monkeypatch, hier=False,
+                               worker=_fused_scaled_worker)
+    hier = _run_cluster_config(monkeypatch, hier=True,
+                               worker=_fused_scaled_worker)
     assert flat == hier
     # and the values are right: sum over ranks 0..7 of (r+i)
     for r_outs in hier:
