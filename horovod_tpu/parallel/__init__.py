@@ -44,6 +44,7 @@ from .hybrid import (  # noqa: F401
     make_dp_tp_sp_mesh,
     make_hybrid_train_step,
     shard_data_hybrid,
+    shard_opt_state_hybrid,
     shard_params_hybrid,
 )
 from .pipeline import (  # noqa: F401

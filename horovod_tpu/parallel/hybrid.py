@@ -65,6 +65,29 @@ def shard_params_hybrid(params, mesh: Mesh):
     return shard_params_tp(params, mesh, TP_AXIS)
 
 
+def shard_opt_state_hybrid(opt_state, params, mesh: Mesh):
+    """Place optimizer state so PARAM-STRUCTURED subtrees (Adam's m/v,
+    momentum traces — optax states embed copies of the param tree) follow
+    their parameter's Megatron tp spec; everything else (step counts,
+    schedules) replicates. tp is the AUTO axis, so sharded state flows
+    through the hybrid step exactly like the params do."""
+    from .tensor import tp_param_shardings
+
+    param_sh = tp_param_shardings(params, mesh, TP_AXIS)
+    p_def = jax.tree_util.tree_structure(params)
+    repl = NamedSharding(mesh, P())
+
+    def is_param_tree(x):
+        return jax.tree_util.tree_structure(x) == p_def
+
+    def place(node):
+        if is_param_tree(node):
+            return jax.tree_util.tree_map(jax.device_put, node, param_sh)
+        return jax.device_put(node, repl)
+
+    return jax.tree_util.tree_map(place, opt_state, is_leaf=is_param_tree)
+
+
 def shard_data_hybrid(tokens, mesh: Mesh):
     """Global [B, T] int arrays -> batch over dp, sequence over sp."""
     return jax.device_put(tokens, NamedSharding(mesh, P(DP_AXIS, SP_AXIS)))

@@ -100,3 +100,39 @@ def test_hybrid_params_stay_tp_sharded():
     assert qkv.addressable_shards[0].data.shape == n_shard_before
     assert qkv.addressable_shards[0].data.shape[1] == qkv.shape[1] // 2
     assert np.isfinite(float(loss))
+
+
+def test_hybrid_opt_state_follows_param_shardings():
+    """Adam m/v shard like their params over tp; scalar state replicates;
+    training still matches the replicated-state run exactly."""
+    mesh = hybrid.make_dp_tp_sp_mesh(dp=2, tp=2, sp=2)
+    tokens, targets = _data(4, 32, seed=5)
+    hmodel = hybrid.hybrid_model(
+        TransformerLM, vocab_size=VOCAB, num_layers=2, num_heads=2,
+        d_model=64, max_seq_len=64, dtype=jnp.float32)
+    params0 = _model().init(jax.random.PRNGKey(2), tokens)["params"]
+    tx = optax.adamw(1e-3)
+    step = hybrid.make_hybrid_train_step(hmodel, tx, mesh)
+    x = hybrid.shard_data_hybrid(tokens, mesh)
+    y = hybrid.shard_data_hybrid(targets, mesh)
+
+    p_a = hybrid.shard_params_hybrid(params0, mesh)
+    o_a = hybrid.shard_opt_state_hybrid(tx.init(params0), params0, mesh)
+    mu = o_a[0].mu["block_0"]["qkv"]["kernel"]
+    # column-parallel kernel state: output dim split over tp
+    assert mu.addressable_shards[0].data.shape[1] == mu.shape[1] // 2
+    assert o_a[0].count.addressable_shards[0].data.shape == ()
+
+    # place run B from independent host copies: device_put may alias
+    # already-placed buffers, and the step donates its inputs
+    params0_copy = jax.tree_util.tree_map(np.array, params0)
+    p_b = hybrid.shard_params_hybrid(params0_copy, mesh)
+    o_b = jax.device_put(tx.init(params0_copy), jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()))
+    for _ in range(2):
+        p_a, o_a, loss_a = step(p_a, o_a, x, y)
+        p_b, o_b, loss_b = step(p_b, o_b, x, y)
+    np.testing.assert_array_equal(float(loss_a), float(loss_b))
+    for a, b in zip(jax.tree_util.tree_leaves(p_a),
+                    jax.tree_util.tree_leaves(p_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
