@@ -120,6 +120,24 @@ def main():
     out = ring(q, q, q)
     print(f"sp   ring-attention out norm {float(jnp.linalg.norm(out)):.4f}")
 
+    # ---- dp x tp x sp: 3D hybrid (manual dp/sp + GSPMD-auto tp)
+    if n >= 8:
+        from horovod_tpu.parallel import hybrid as hpar
+
+        hmesh = hpar.make_dp_tp_sp_mesh(dp=2, tp=2, sp=n // 4)
+        hm = hpar.hybrid_model(TransformerLMTiny, vocab_size=vocab,
+                               dtype=jnp.float32)
+        htoks = jnp.asarray(rng.randint(0, vocab, (4, 16 * (n // 4) + 1)))
+        hx, hy = htoks[:, :-1], htoks[:, 1:]
+        hp0 = TransformerLMTiny(vocab_size=vocab, dtype=jnp.float32).init(
+            jax.random.PRNGKey(3), hx)["params"]
+        hstep = hpar.make_hybrid_train_step(hm, tx, hmesh)
+        hp = hpar.shard_params_hybrid(hp0, hmesh)
+        ho = hpar.shard_opt_state_hybrid(tx.init(hp0), hp0, hmesh)
+        hp, ho, loss = hstep(hp, ho, hpar.shard_data_hybrid(hx, hmesh),
+                             hpar.shard_data_hybrid(hy, hmesh))
+        print(f"3d   loss {float(loss):.4f} (dp x tp x sp)")
+
     print("all parallelism axes ran")
     hvd.shutdown()
 
