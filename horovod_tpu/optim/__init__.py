@@ -1,0 +1,10 @@
+from .broadcast import (  # noqa: F401
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .distributed import (  # noqa: F401
+    DistributedAdasumOptimizer,
+    DistributedOptimizer,
+    allreduce_gradients,
+)
+from .zero import shard_opt_state, zero1_shardings  # noqa: F401
