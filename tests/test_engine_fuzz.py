@@ -21,7 +21,7 @@ from horovod_tpu.ops import collective_ops as C
 WORLD = 4
 
 
-def _gen_ops(seed, n_ops):
+def _gen_ops(seed, n_ops, world=WORLD):
     """Deterministic op schedule; identical on every rank."""
     rng = np.random.RandomState(seed)
     ops = []
@@ -29,7 +29,7 @@ def _gen_ops(seed, n_ops):
         kind = rng.choice(["allreduce", "allgather", "broadcast"])
         shape = tuple(int(x) for x in rng.randint(1, 5, rng.randint(1, 3)))
         op = int(rng.choice([hvd.Sum, hvd.Average]))
-        root = int(rng.randint(WORLD))
+        root = int(rng.randint(world))
         ragged = bool(rng.randint(2))
         ops.append((i, kind, shape, op, root, ragged))
     return ops
@@ -54,9 +54,9 @@ def _expected(ops, world):
     return out
 
 
-def _worker(seed, n_ops):
+def _worker(seed, n_ops, world=WORLD):
     r = hvd.rank()
-    ops = _gen_ops(seed, n_ops)
+    ops = _gen_ops(seed, n_ops, world)
     delays = np.random.RandomState(seed * 1000 + r)
     handles = {}
     results = {}
@@ -94,3 +94,31 @@ def test_fuzz_negotiation_under_timing_skew(seed):
             np.testing.assert_allclose(
                 got, want[i], rtol=1e-6,
                 err_msg=f"seed {seed} rank {r} op {i}")
+
+
+def _mp_fuzz_worker():
+    return _worker(13, 18, world=2)
+
+
+@pytest.mark.integration
+def test_fuzz_coordinated_plane():
+    """Same chaos through the RANK-0 coordinator (TCP exchange, wire codec,
+    fusion, response cache) across 2 real processes."""
+    import os
+
+    from horovod_tpu.run.api import run
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.pathsep.join([os.path.dirname(here), here]),
+    }
+    want = _expected(_gen_ops(13, 18, world=2), 2)
+    res = run(_mp_fuzz_worker, np=2, env=env, start_timeout=240)
+    for r, results, _ in res:
+        assert len(results) == 18
+        for i, got in results.items():
+            np.testing.assert_allclose(got, want[i], rtol=1e-6,
+                                       err_msg=f"rank {r} op {i}")
