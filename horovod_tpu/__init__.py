@@ -36,6 +36,8 @@ from .basics import (  # noqa: F401
     mesh,
     mlsl_built,
     mpi_built,
+    gloo_enabled,
+    mpi_enabled,
     mpi_threads_supported,
     nccl_built,
     num_replicas,

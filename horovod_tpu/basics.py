@@ -305,6 +305,19 @@ def mpi_threads_supported() -> bool:
     return False
 
 
+def mpi_enabled() -> bool:
+    """Runtime-mode probe (`basics.py:151-160`): MPI is never the control
+    or data plane here — the coordinator service + XLA collectives are."""
+    return False
+
+
+def gloo_enabled() -> bool:
+    """Runtime-mode probe (`basics.py:171-179`): reports whether the
+    non-MPI (coordinated / jax.distributed) control plane is active, the
+    role Gloo mode plays in the reference."""
+    return is_initialized()
+
+
 def mpi_built() -> bool:
     return False
 
