@@ -21,34 +21,51 @@ from horovod_tpu.ops import collective_ops as C
 WORLD = 4
 
 
+_DTYPES = [np.float32, np.float64, np.int32]
+
+
 def _gen_ops(seed, n_ops, world=WORLD):
     """Deterministic op schedule; identical on every rank."""
     rng = np.random.RandomState(seed)
     ops = []
     for i in range(n_ops):
-        kind = rng.choice(["allreduce", "allgather", "broadcast"])
+        kind = rng.choice(["allreduce", "allgather", "broadcast",
+                           "alltoall"])
         shape = tuple(int(x) for x in rng.randint(1, 5, rng.randint(1, 3)))
+        if kind == "alltoall":
+            # equal-split contract: dim0 divisible by world
+            shape = (world * int(rng.randint(1, 3)),) + shape[1:]
         op = int(rng.choice([hvd.Sum, hvd.Average]))
         root = int(rng.randint(world))
         ragged = bool(rng.randint(2))
-        ops.append((i, kind, shape, op, root, ragged))
+        dtype = _DTYPES[rng.randint(len(_DTYPES))]
+        ops.append((i, kind, shape, op, root, ragged, dtype))
     return ops
 
 
 def _expected(ops, world):
     """Numpy ground truth for rank-dependent inputs full(shape, r+1+i)."""
     out = {}
-    for i, kind, shape, op, root, ragged in ops:
-        vals = [np.full(shape, float(r + 1 + i), np.float32)
-                for r in range(world)]
+    for i, kind, shape, op, root, ragged, dtype in ops:
+        vals = [np.full(shape, r + 1 + i, dtype) for r in range(world)]
         if kind == "allreduce":
             s = np.sum(vals, axis=0)
-            out[i] = s / world if op == hvd.Average else s
+            if op == hvd.Average:
+                # integer Average floor-divides (engine int semantics)
+                s = (s // world if np.issubdtype(dtype, np.integer)
+                     else s / world)
+            out[i] = s
         elif kind == "allgather":
             rows = [np.full(((r % 2 + 1) if ragged else shape[0],)
-                            + shape[1:], float(r + 1 + i), np.float32)
+                            + shape[1:], r + 1 + i, dtype)
                     for r in range(world)]
             out[i] = np.concatenate(rows, axis=0)
+        elif kind == "alltoall":
+            # each dst receives src's dst-th segment, concatenated by src
+            seg = shape[0] // world
+            out[i] = {dst: np.concatenate(
+                [vals[src][dst * seg:(dst + 1) * seg] for src in range(world)],
+                axis=0) for dst in range(world)}
         else:
             out[i] = vals[root]
     return out
@@ -61,16 +78,18 @@ def _worker(seed, n_ops, world=WORLD):
     handles = {}
     results = {}
     checked = 0
-    for i, kind, shape, op, root, ragged in ops:
+    for i, kind, shape, op, root, ragged, dtype in ops:
         if delays.rand() < 0.4:
             time.sleep(float(delays.rand()) * 0.01)
-        x = np.full(shape, float(r + 1 + i), np.float32)
+        x = np.full(shape, r + 1 + i, dtype)
         if kind == "allreduce":
             handles[i] = C.allreduce_async(x, name=f"fz{i}", op=op)
         elif kind == "allgather":
             rows = np.full(((r % 2 + 1) if ragged else shape[0],)
-                           + shape[1:], float(r + 1 + i), np.float32)
+                           + shape[1:], r + 1 + i, dtype)
             handles[i] = C.allgather_async(rows, name=f"fz{i}")
+        elif kind == "alltoall":
+            handles[i] = C.alltoall_async(x, name=f"fz{i}")
         else:
             handles[i] = C.broadcast_async(x, root, name=f"fz{i}")
         # randomly drain a pending handle mid-stream (its result is
@@ -91,8 +110,9 @@ def test_fuzz_negotiation_under_timing_skew(seed):
     want = _expected(_gen_ops(seed, n_ops), WORLD)
     for r, results, _ in res:
         for i, got in results.items():
+            w = want[i][r] if isinstance(want[i], dict) else want[i]
             np.testing.assert_allclose(
-                got, want[i], rtol=1e-6,
+                got, w, rtol=1e-6,
                 err_msg=f"seed {seed} rank {r} op {i}")
 
 
@@ -120,5 +140,6 @@ def test_fuzz_coordinated_plane():
     for r, results, _ in res:
         assert len(results) == 18
         for i, got in results.items():
-            np.testing.assert_allclose(got, want[i], rtol=1e-6,
+            w = want[i][r] if isinstance(want[i], dict) else want[i]
+            np.testing.assert_allclose(got, w, rtol=1e-6,
                                        err_msg=f"rank {r} op {i}")
