@@ -13,7 +13,10 @@ run() {
 run LM_REMAT=none LM_CHUNKED_LOSS=0 LM_MU_DTYPE=f32 LM_DONATE=0 HVD_PALLAS_BLOCK=128
 # 2. block 128 + donation/mu/chunked (isolates the dimension-semantics delta vs the recorded 26.7k)
 run LM_REMAT=none HVD_PALLAS_BLOCK=128
-# 3. round-3 default (block 256 + semantics) — headline candidate
+# 3. block 256 + semantics (was the in-code default when this ladder was
+#    first measured; pinned now that the default is Q512/K1024)
+run LM_REMAT=none HVD_PALLAS_BLOCK=256
+# 3b. round-3 default (Q512/K1024 + semantics) — the headline
 run LM_REMAT=none
 # 4. block 256, batch 16 (semantics may change the batch story)
 run LM_REMAT=none LM_BATCH=16

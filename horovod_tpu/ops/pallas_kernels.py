@@ -94,19 +94,27 @@ def _pick_block(t: int, preferred: int = None,
                 side: Optional[str] = None) -> Optional[int]:
     """Largest power-of-2 tile ≤ preferred dividing t (None if none ≥ 8).
 
-    Default tile edge comes from ``HVD_PALLAS_BLOCK`` (256 if unset): bigger
-    tiles mean quadratically fewer grid cells — measured 26.7k → 32.7k tok/s
-    on the lm_bench step going 128 → 256 on a v5e, where per-cell grid
-    overhead, not FLOPs, dominated the attention kernels.
-    ``side`` ("q" or "k") lets ``HVD_PALLAS_BLOCK_Q`` / ``HVD_PALLAS_BLOCK_K``
-    override the two sides independently for tuning."""
+    Default tile edges are asymmetric — q-side 512, k-side 1024: bigger
+    tiles mean quadratically fewer grid cells (the per-cell grid overhead,
+    not FLOPs, dominated the attention kernels at 128), and the k side can
+    afford the larger edge because the kernels iterate over k within a
+    cell. lm_bench ladder on a v5e, batch 8 / seq 1024:
+    128/128 → 26.3k, 256/256 → 32.8k, 512/512 → 37.7k,
+    512/1024 → 38.7k tok/s (1024/1024 exceeds scoped VMEM — the f32
+    score tile alone is 4 MB).  ``HVD_PALLAS_BLOCK`` overrides both sides;
+    ``HVD_PALLAS_BLOCK_Q`` / ``HVD_PALLAS_BLOCK_K`` override each
+    independently for tuning."""
     if preferred is None:
         if side is not None:
             v = os.environ.get(f"HVD_PALLAS_BLOCK_{side.upper()}")
             if v:
                 preferred = int(v)
         if preferred is None:
-            preferred = int(os.environ.get("HVD_PALLAS_BLOCK", "256"))
+            v = os.environ.get("HVD_PALLAS_BLOCK")
+            if v:
+                preferred = int(v)
+            else:
+                preferred = 1024 if side == "k" else 512
     b = preferred
     while b >= 8:
         if t % b == 0:
