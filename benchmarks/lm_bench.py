@@ -105,7 +105,21 @@ def main():
     n_emb = params["tok_emb"]["embedding"].size + params["pos_emb"].size
     n_nonemb = n_params - n_emb
 
-    tx = optax.adamw(3e-4, weight_decay=0.01, mu_dtype=mu_dtype)
+    fused_opt = os.environ.get("LM_FUSED_OPT", "0") == "1"
+    if fused_opt and os.environ.get("LM_ZERO1", "0") == "1":
+        # the Pallas AdamW custom call has no SPMD sharding rule: GSPMD
+        # would all-gather the dp-sharded m/v to replicas inside the step,
+        # silently undoing the ZeRO-1 memory win
+        sys.exit("LM_FUSED_OPT=1 is incompatible with LM_ZERO1=1 "
+                 "(pallas optimizer kernel would force the sharded "
+                 "optimizer state back to replicated)")
+    if fused_opt:
+        # one-pass Pallas AdamW (optim/fused.py) instead of optax's
+        # per-tensor XLA fusions
+        from horovod_tpu.optim import fused_adamw
+        tx = fused_adamw(3e-4, weight_decay=0.01, mu_dtype=mu_dtype)
+    else:
+        tx = optax.adamw(3e-4, weight_decay=0.01, mu_dtype=mu_dtype)
     opt_state = tx.init(params)
     mesh = hvd.mesh()
     params = spmd.replicate(params, mesh)
@@ -114,9 +128,12 @@ def main():
     targets = spmd.shard_batch(targets, mesh)
 
     if chunked:
+        chunk_tokens = int(os.environ.get("LM_LOSS_CHUNK", "2048"))
+
         def loss_fn(p, x, y):
             hid = model.apply({"params": p}, x, return_hidden=True)
-            return lm_loss_chunked(hid, p["tok_emb"]["embedding"], y)
+            return lm_loss_chunked(hid, p["tok_emb"]["embedding"], y,
+                                   chunk_tokens=chunk_tokens)
     else:
         def loss_fn(p, x, y):
             return lm_loss(model.apply({"params": p}, x), y)
@@ -124,10 +141,16 @@ def main():
     from jax.sharding import NamedSharding, PartitionSpec as P
     repl = NamedSharding(mesh, P())
 
-    def _step(p, opt, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
-        updates, opt = tx.update(grads, opt, p)
-        return optax.apply_updates(p, updates), opt, loss
+    if fused_opt:
+        def _step(p, opt, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+            p, opt = tx.apply(grads, opt, p)
+            return p, opt, loss
+    else:
+        def _step(p, opt, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+            updates, opt = tx.update(grads, opt, p)
+            return optax.apply_updates(p, updates), opt, loss
 
     opt_sh = repl
     if os.environ.get("LM_ZERO1", "0") == "1":

@@ -1,0 +1,176 @@
+"""Fused AdamW: the whole moment+parameter update in one Pallas pass.
+
+Why: XLA compiles ``optax.adamw``'s update into one fusion per parameter
+tensor, and on a v5e those fusions measured ~32 ms of a 209 ms
+GPT-2-medium train step — 3-4x off the HBM roofline for what is one
+read of (g, p, mu, nu) and one write of (p, mu, nu). Unlike a norm or an
+activation, the optimizer update has no neighbouring ops XLA could fuse
+it INTO (it is the terminal consumer of the gradients), so a hand kernel
+pays no fusion-boundary cost — it just moves fewer bytes in fewer passes.
+
+No reference counterpart: Horovod delegates the optimizer step to the
+framework (`horovod/torch/__init__.py:152-169` runs the wrapped
+``optimizer.step()`` after synchronize); the TPU-native analogue of "make
+the step fast" is this kernel.
+
+API is a minimal init/apply pair (NOT an optax ``GradientTransformation``:
+optax's contract returns *updates* for a separate ``apply_updates`` add,
+which would force the parameter write back out of the fused pass):
+
+    opt = fused_adamw(3e-4, weight_decay=0.01, mu_dtype=jnp.bfloat16)
+    state = opt.init(params)
+    params, state = opt.apply(grads, state, params)
+
+Numerics match ``optax.adamw`` (same bias correction, eps placement, and
+decoupled weight decay; moments computed in f32 and stored in
+``mu_dtype``/f32 exactly like optax's ``mu_dtype`` handling). Leaves whose
+size is not lane-aligned (or off-TPU) take an identical-formula jnp path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..ops import pallas_kernels as _pk
+
+_LANES = 128
+# per-leaf size below which the custom-call overhead outweighs the win;
+# small leaves (LN scales, biases) take the jnp formulas instead
+_MIN_FUSED = 1 << 16
+
+
+class AdamWState(NamedTuple):
+    count: jax.Array  # int32 step counter (shared by all leaves)
+    mu: Any           # first-moment tree, in mu_dtype
+    nu: Any           # second-moment tree, f32
+
+
+def _adamw_kernel(sc_ref, g_ref, p_ref, mu_ref, nu_ref,
+                  po_ref, muo_ref, nuo_ref, *, b1, b2, eps, wd):
+    """One row-tile: read (g, p, mu, nu), write (p', mu', nu').
+    sc (scalar prefetch): [lr, 1/(1-b1^t), 1/(1-b2^t)] f32."""
+    lr, ibc1, ibc2 = sc_ref[0], sc_ref[1], sc_ref[2]
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    mu = b1 * mu_ref[...].astype(jnp.float32) + (1.0 - b1) * g
+    nu = b2 * nu_ref[...].astype(jnp.float32) + (1.0 - b2) * g * g
+    upd = (mu * ibc1) / (jnp.sqrt(nu * ibc2) + eps) + wd * p
+    po_ref[...] = (p - lr * upd).astype(po_ref.dtype)
+    muo_ref[...] = mu.astype(muo_ref.dtype)
+    nuo_ref[...] = nu
+
+
+def _leaf_supported(n: int) -> bool:
+    return n >= _MIN_FUSED and _pk.mode() != "off"
+
+
+def _rows_block(rows: int) -> int:
+    # 2048 x 128 f32 = 1 MB/operand (7 operands inside VMEM); leaves are
+    # zero-PADDED up to a block multiple rather than degrading to tiny
+    # tiles (a divisor-only rule turns e.g. a 50257-row vocab leaf into
+    # ~50k sequential 8x128 cells)
+    b = 2048
+    while b > 8 and rows < b:
+        b //= 2
+    return b
+
+
+def _apply_leaf_fused(sc, g, p, mu, nu, *, b1, b2, eps, wd):
+    shape, n = p.shape, p.size
+    rows = -(-n // _LANES)
+    br = _rows_block(rows)
+    rows_p = -(-rows // br) * br
+    pad = rows_p * _LANES - n
+
+    def flat(x):
+        x = x.reshape(-1)
+        if pad:
+            x = jnp.pad(x, (0, pad))  # zero rows: updated, then discarded
+        return x.reshape(rows_p, _LANES)
+
+    tile = pl.BlockSpec((br, _LANES), lambda i, sc: (i, 0))
+    p2, mu2, nu2 = pl.pallas_call(
+        functools.partial(_adamw_kernel, b1=b1, b2=b2, eps=eps, wd=wd),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(rows_p // br,),
+            in_specs=[tile, tile, tile, tile],
+            out_specs=[tile, tile, tile],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((rows_p, _LANES), p.dtype),
+                   jax.ShapeDtypeStruct((rows_p, _LANES), mu.dtype),
+                   jax.ShapeDtypeStruct((rows_p, _LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_pk._interpret(),
+    )(sc, flat(g), flat(p), flat(mu), flat(nu))
+
+    def unflat(x):
+        return x.reshape(-1)[:n].reshape(shape)
+
+    return unflat(p2), unflat(mu2), unflat(nu2)
+
+
+def _apply_leaf_jnp(sc, g, p, mu, nu, *, b1, b2, eps, wd):
+    lr, ibc1, ibc2 = sc[0], sc[1], sc[2]
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    mu_f = b1 * mu.astype(jnp.float32) + (1.0 - b1) * gf
+    nu_f = b2 * nu.astype(jnp.float32) + (1.0 - b2) * gf * gf
+    upd = (mu_f * ibc1) / (jnp.sqrt(nu_f * ibc2) + eps) + wd * pf
+    return ((pf - lr * upd).astype(p.dtype), mu_f.astype(mu.dtype), nu_f)
+
+
+class FusedAdamW(NamedTuple):
+    init: Any
+    apply: Any
+
+
+def fused_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                mu_dtype=None) -> FusedAdamW:
+    """AdamW with the per-leaf update in one fused Pallas pass.
+
+    Decoupled weight decay applies to every leaf (pass 0.0 to disable),
+    matching ``optax.adamw``'s default ``mask=None``.
+    """
+
+    def init(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return AdamWState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def apply(grads, state, params):
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        sc = jnp.stack([
+            jnp.float32(learning_rate),
+            1.0 / (1.0 - jnp.float32(b1) ** t),
+            1.0 / (1.0 - jnp.float32(b2) ** t),
+        ])
+        kw = dict(b1=b1, b2=b2, eps=eps, wd=weight_decay)
+
+        def leaf(g, p, mu, nu):
+            if _leaf_supported(p.size):
+                return _apply_leaf_fused(sc, g, p, mu, nu, **kw)
+            return _apply_leaf_jnp(sc, g, p, mu, nu, **kw)
+
+        out = jax.tree_util.tree_map(leaf, grads, params, state.mu,
+                                     state.nu)
+        three = jax.tree_util.tree_transpose(
+            jax.tree_util.tree_structure(params),
+            jax.tree_util.tree_structure((0, 0, 0)), out)
+        new_p, new_mu, new_nu = three
+        return new_p, AdamWState(count, new_mu, new_nu)
+
+    return FusedAdamW(init, apply)

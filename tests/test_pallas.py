@@ -289,3 +289,160 @@ def test_ring_attention_fa2_backward_4dev(causal):
     for a, b_ in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=3e-4, atol=3e-4)
+
+
+# -------------------------------------------------------- fused layer norm
+def _flax_ln(x, gamma, beta, eps=1e-6):
+    import flax.linen as nn
+    mod = nn.LayerNorm(epsilon=eps, dtype=x.dtype, param_dtype=gamma.dtype)
+    return mod.apply({"params": {"scale": gamma, "bias": beta}}, x)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_layer_norm_matches_flax(dtype):
+    rng = jax.random.PRNGKey(3)
+    kx, kg, kb = jax.random.split(rng, 3)
+    x = jax.random.normal(kx, (4, 64, 256), dtype) * 3 + 1
+    gamma = jax.random.normal(kg, (256,), jnp.float32) + 1
+    beta = jax.random.normal(kb, (256,), jnp.float32)
+    out = pk.fused_layer_norm(x, gamma, beta)
+    ref = _flax_ln(x, gamma, beta)
+    assert out.dtype == x.dtype
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
+
+
+def test_fused_layer_norm_grads_match_flax():
+    rng = jax.random.PRNGKey(4)
+    kx, kg, kb, kd = jax.random.split(rng, 4)
+    x = jax.random.normal(kx, (8, 32, 128), jnp.float32) * 2 - 0.5
+    gamma = jax.random.normal(kg, (128,), jnp.float32) + 1
+    beta = jax.random.normal(kb, (128,), jnp.float32)
+    ct = jax.random.normal(kd, x.shape, jnp.float32)
+
+    def loss(fn):
+        return lambda x, g, b: jnp.sum(fn(x, g, b) * ct)
+
+    gx, gg, gb = jax.grad(loss(pk.fused_layer_norm), (0, 1, 2))(
+        x, gamma, beta)
+    rx, rg, rb = jax.grad(loss(_flax_ln), (0, 1, 2))(x, gamma, beta)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(rg),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fused_layer_norm_fallback_odd_shapes():
+    # last dim not lane-aligned -> jnp fallback, still correct
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 100), jnp.float32)
+    gamma = jnp.ones((100,), jnp.float32)
+    beta = jnp.zeros((100,), jnp.float32)
+    assert not pk.ln_supported(x)
+    out = pk.fused_layer_norm(x, gamma, beta)
+    ref = _flax_ln(x, gamma, beta)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_layer_norm_bf16_params():
+    # bf16 gamma/beta: kernel casts to f32 internally, grads in bf16
+    x = jax.random.normal(jax.random.PRNGKey(6), (16, 128), jnp.float32)
+    gamma = jnp.ones((128,), jnp.bfloat16)
+    beta = jnp.zeros((128,), jnp.bfloat16)
+    out = pk.fused_layer_norm(x, gamma, beta)
+    gg = jax.grad(lambda g: jnp.sum(pk.fused_layer_norm(x, g, beta)))(gamma)
+    assert gg.dtype == jnp.bfloat16
+    ref = _flax_ln(x, gamma.astype(jnp.float32), beta.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-2, atol=1e-2)
+
+
+# ------------------------------------------------------------- fused adamw
+@pytest.mark.parametrize("mu_dtype", [None, jnp.bfloat16])
+def test_fused_adamw_matches_optax(mu_dtype, monkeypatch):
+    import optax
+    from horovod_tpu.optim import fused_adamw
+
+    # drop the size floor so the fused kernel path runs at test sizes
+    monkeypatch.setattr("horovod_tpu.optim.fused._MIN_FUSED", 1)
+    rng = jax.random.PRNGKey(7)
+    kp, kg1, kg2 = jax.random.split(rng, 3)
+    params = {
+        "w": jax.random.normal(kp, (64, 128), jnp.float32),   # fused path
+        "b": jax.random.normal(kp, (100,), jnp.float32),      # jnp path
+    }
+    kw = dict(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    ours = fused_adamw(1e-2, mu_dtype=mu_dtype, **kw)
+    ref = optax.adamw(1e-2, mu_dtype=mu_dtype, **kw)
+
+    state = ours.init(params)
+    rstate = ref.init(params)
+    rparams = params
+    for key in (kg1, kg2):
+        grads = jax.tree_util.tree_map(
+            lambda p, k=key: jax.random.normal(k, p.shape, p.dtype), params)
+        params, state = ours.apply(grads, state, params)
+        upd, rstate = ref.update(grads, rstate, rparams)
+        rparams = optax.apply_updates(rparams, upd)
+    # bf16 mu: optax's `b1*mu` multiplies in bf16 (weak-type promotion)
+    # before the f32 add; the kernel upcasts first — slightly MORE precise,
+    # so the bf16 comparison carries bf16-level tolerance
+    tol = 2e-5 if mu_dtype is None else 4e-3
+    for ka in params:
+        np.testing.assert_allclose(np.asarray(params[ka]),
+                                   np.asarray(rparams[ka]),
+                                   rtol=tol, atol=tol)
+    # moment dtypes follow optax's mu_dtype contract
+    want = mu_dtype or jnp.float32
+    assert state.mu["w"].dtype == want
+    assert state.nu["w"].dtype == jnp.float32
+
+
+def test_fused_adamw_under_jit_with_donation():
+    import functools
+
+    from horovod_tpu.optim import fused_adamw
+
+    opt = fused_adamw(1e-3, weight_decay=0.0)
+    params = {"w": jnp.ones((16, 128), jnp.float32)}
+    state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(g, p, s):
+        return opt.apply(g, s, p)
+
+    g = {"w": jnp.full((16, 128), 0.5, jnp.float32)}
+    p0 = np.asarray(params["w"])  # snapshot before donation deletes it
+    p1, s1 = step(g, params, state)
+    p2, s2 = step(g, p1, s1)
+    assert int(s2.count) == 2
+    assert np.all(np.asarray(p2["w"]) < p0)
+
+
+def test_fused_adamw_pads_awkward_leaf_sizes(monkeypatch):
+    """Leaves whose row count is not a power-of-two multiple (e.g. a
+    GPT-2 50257-row vocab) are zero-padded to a full tile block instead of
+    degrading to tiny sequential tiles; numerics must match the jnp path."""
+    import optax
+    from horovod_tpu.optim import fused_adamw
+
+    monkeypatch.setattr("horovod_tpu.optim.fused._MIN_FUSED", 1)
+    shapes = [(513, 128), (50257,), (7, 300)]
+    for shape in shapes:
+        params = {"w": jax.random.normal(jax.random.PRNGKey(8), shape,
+                                         jnp.float32)}
+        grads = {"w": jax.random.normal(jax.random.PRNGKey(9), shape,
+                                        jnp.float32)}
+        ours = fused_adamw(1e-2, weight_decay=0.01)
+        ref = optax.adamw(1e-2, weight_decay=0.01)
+        state = ours.init(params)
+        new_p, _ = ours.apply(grads, state, params)
+        upd, _ = ref.update(grads, ref.init(params), params)
+        want = optax.apply_updates(params, upd)
+        np.testing.assert_allclose(np.asarray(new_p["w"]),
+                                   np.asarray(want["w"]),
+                                   rtol=2e-5, atol=2e-5)
