@@ -165,10 +165,12 @@ def main():
                      donate_argnums=(0, 1) if donate else ())
     step = jitted
     if on_tpu:
+        opts = {"xla_tpu_enable_latency_hiding_scheduler": "true"}
+        if os.environ.get("LM_VMEM_KIB"):
+            opts["xla_tpu_scoped_vmem_limit_kib"] = os.environ["LM_VMEM_KIB"]
         try:
             step = jitted.lower(params, opt_state, tokens, targets).compile(
-                compiler_options={
-                    "xla_tpu_enable_latency_hiding_scheduler": "true"})
+                compiler_options=opts)
         except Exception:
             step = jitted
 

@@ -136,15 +136,39 @@ def _pick_block(t: int, preferred: int = None,
     return None
 
 
+def _pick_bh_block(bh: int, per_g_bytes: int = 0, cap: int = 0) -> int:
+    """Rows of the fused batch·head dimension handled per grid cell in the
+    RESIDENT kernels (``HVD_PALLAS_BLOCK_BH``): G sub-problems share one
+    cell (statically unrolled in-kernel), dividing the cell count by G —
+    the grid-geometry lever applied to the third axis. Measured on the
+    lm_bench step: G=2 exactly neutral (38.46k vs 38.45k tok/s), G=4
+    exceeds the 16 MB scoped-VMEM stack (17.98M) at the Q512/K1024 tile
+    defaults — so the default is 1 and the knob exists for parts/configs
+    with different VMEM headroom.
+
+    G is floored to a power of two, then halved until it both divides
+    ``bh`` AND keeps ``G * per_g_bytes`` within ``cap`` (when given) —
+    one loop so neither constraint can be satisfied while silently
+    breaking the other (a non-divisor G would leave trailing bh rows
+    unvisited by the grid)."""
+    g = max(1, int(os.environ.get("HVD_PALLAS_BLOCK_BH", "1")))
+    g = 1 << (g.bit_length() - 1)                     # power-of-two floor
+    while g > 1 and (bh % g or (cap and g * per_g_bytes > cap)):
+        g //= 2
+    return g
+
+
 # =========================================================== flash attention
 def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
                        mo_ref, lo_ref, oo_ref, *, causal, scale, block_k):
-    """One q-tile of flash accumulation against the whole resident k/v block.
+    """G q-tiles (G = bh-block, statically unrolled) of flash accumulation,
+    each against its whole resident k/v block.
 
-    Refs (VMEM): q [1, BQ, D], k/v [1, TK, D], m/l [1, BQ, 1] (trailing
+    Refs (VMEM): q [G, BQ, D], k/v [G, TK, D], m/l [G, BQ, 1] (trailing
     singleton keeps the block tile-legal: (BQ, 1) instead of (1, BQ)),
-    o [1, BQ, D]; offs (scalar prefetch): [q_off, k_off] global sequence
-    origins for causal masking (ring hop offsets).
+    o [G, BQ, D]; offs (scalar prefetch): [q_off, k_off] global sequence
+    origins for causal masking (ring hop offsets) — shared by all G
+    sub-problems (they are different batch·head slices of one sequence).
     """
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -152,50 +176,54 @@ def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
     # dot operands stay in the INPUT dtype (bf16 models run the MXU at bf16
     # rate, f32 inputs stay exact); accumulation is always f32
     in_dt = q_ref.dtype
-    q = q_ref[0]                                      # [BQ, D]
-    # carried m enters in natural units; base-2 inside (see _LOG2E note)
-    m = m_ref[0, :, 0].astype(jnp.float32) * _LOG2E   # [BQ]
-    l = l_ref[0, :, 0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)                  # [BQ, D]
     q_off = offs_ref[0] + iq * bq
     k_off = offs_ref[1]
 
     nk = tk // block_k
-
-    def body(j, carry):
-        m, l, o = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        # [BQ, BK] base-2 logits on the MXU; scale applied to the f32 result
-        s = (scale * _LOG2E) * lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = (k_off + j * block_k
-                    + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        p = jnp.exp2(s - m_safe[:, None])             # exp2(-inf) == 0
-        alpha = jnp.exp2(m - m_safe)                  # m=-inf -> 0
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        pv = lax.dot_general(p.astype(in_dt), v, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        o_new = o * alpha[:, None] + pv
-        return m_new, l_new, o_new
-
     if causal:
         # k blocks past the last unmasked key for this q tile contribute
         # nothing — bound the loop (exact: those blocks are fully masked)
         hi = jnp.clip((q_off + bq - k_off + block_k - 1) // block_k, 0, nk)
     else:
         hi = nk
-    m, l, o = lax.fori_loop(0, hi, body, (m, l, o))
-    mo_ref[0, :, 0] = m * _LN2                        # back to natural units
-    lo_ref[0, :, 0] = l
-    oo_ref[0] = o
+
+    for g in range(q_ref.shape[0]):
+        q = q_ref[g]                                  # [BQ, D]
+        # carried m enters in natural units; base-2 inside (_LOG2E note)
+        m = m_ref[g, :, 0].astype(jnp.float32) * _LOG2E   # [BQ]
+        l = l_ref[g, :, 0].astype(jnp.float32)
+        o = o_ref[g].astype(jnp.float32)              # [BQ, D]
+
+        def body(j, carry, q=q):
+            m, l, o = carry
+            k = k_ref[g, pl.ds(j * block_k, block_k), :]
+            v = v_ref[g, pl.ds(j * block_k, block_k), :]
+            # [BQ, BK] base-2 logits on the MXU; scale on the f32 result
+            s = (scale * _LOG2E) * lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if causal:
+                qpos = q_off + lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                kpos = (k_off + j * block_k
+                        + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            m_blk = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+            p = jnp.exp2(s - m_safe[:, None])         # exp2(-inf) == 0
+            alpha = jnp.exp2(m - m_safe)              # m=-inf -> 0
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            pv = lax.dot_general(p.astype(in_dt), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            o_new = o * alpha[:, None] + pv
+            return m_new, l_new, o_new
+
+        m, l, o = lax.fori_loop(0, hi, body, (m, l, o))
+        mo_ref[g, :, 0] = m * _LN2                    # back to natural units
+        lo_ref[g, :, 0] = l
+        oo_ref[g] = o
 
 
 def _flash_step_stream_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
@@ -323,24 +351,26 @@ def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
         return _flash_step_call_streaming(
             qt, kt, vt, mt, lt, ot, offs, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, interpret=interpret)
-    grid = (bh, tq // block_q)
+    # keep the resident k/v inside the VMEM budget as G grows
+    g = _pick_bh_block(bh, tk * d * kt.dtype.itemsize, 2 * _KV_VMEM_CAP)
+    grid = (bh // g, tq // block_q)
     kernel = functools.partial(_flash_step_kernel, causal=causal, scale=scale,
                                block_k=block_k)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, tk, d), lambda i, j, offs: (i, 0, 0)),
+            pl.BlockSpec((g, tk, d), lambda i, j, offs: (i, 0, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
         ],
     )
     flops = 4 * bh * tq * tk * d  # 2 matmuls
@@ -443,35 +473,38 @@ def _flash_bwd_dq_kernel_res(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
     tk = k_ref.shape[1]
     nk = tk // block_k
     in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
-    q = q_ref[0]                                      # [BQ, D]
-    do = do_ref[0]                                    # [BQ, D]
-    lse = lse_ref[0] * _LOG2E                         # [BQ, 1] f32, base-2
-    dd = dd_ref[0]                                    # [BQ, 1] f32
     q_off = offs_ref[0] + iq * bq
     k_off = offs_ref[1]
-
-    def body(j, acc):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = (scale * _LOG2E) * lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = (k_off + j * block_k
-                    + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp2(s - lse)                         # exp2(-inf) == 0
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = (p * (dp - dd) * scale).astype(in_dt)
-        return acc + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-
     hi = jnp.clip((q_off + bq - k_off + block_k - 1) // block_k, 0, nk) \
         if causal else nk
-    dq_ref[0] = lax.fori_loop(0, hi, body,
-                              jnp.zeros(q.shape, jnp.float32))
+
+    for g in range(q_ref.shape[0]):                   # bh-block unroll
+        q = q_ref[g]                                  # [BQ, D]
+        do = do_ref[g]                                # [BQ, D]
+        lse = lse_ref[g] * _LOG2E                     # [BQ, 1] f32, base-2
+        dd = dd_ref[g]                                # [BQ, 1] f32
+
+        def body(j, acc, q=q, do=do, lse=lse, dd=dd):
+            k = k_ref[g, pl.ds(j * block_k, block_k), :]
+            v = v_ref[g, pl.ds(j * block_k, block_k), :]
+            s = (scale * _LOG2E) * lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if causal:
+                qpos = q_off + lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                kpos = (k_off + j * block_k
+                        + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            p = jnp.exp2(s - lse)                     # exp2(-inf) == 0
+            dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            ds = (p * (dp - dd) * scale).astype(in_dt)
+            return acc + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+        dq_ref[g] = lax.fori_loop(0, hi, body,
+                                  jnp.zeros(q.shape, jnp.float32))
 
 
 def _flash_bwd_dkv_kernel_res(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
@@ -483,42 +516,45 @@ def _flash_bwd_dkv_kernel_res(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
     tq = q_ref.shape[1]
     nq = tq // block_q
     in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
-    k = k_ref[0]                                      # [BK, D]
-    v = v_ref[0]
     q_off = offs_ref[0]
     k_off = offs_ref[1] + jk * bk
-
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :] * _LOG2E  # [BQ, 1]
-        dd = dd_ref[0, pl.ds(i * block_q, block_q), :]
-        s = (scale * _LOG2E) * lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
-            qpos = (q_off + i * block_q
-                    + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
-            kpos = k_off + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp2(s - lse)                         # [BQ, BK] f32
-        pc = p.astype(in_dt)
-        dv = dv + lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = (p * (dp - dd) * scale).astype(in_dt)
-        dk = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        return dk, dv
-
     lo = jnp.clip((k_off - q_off) // block_q, 0, nq) if causal else 0
-    dk, dv = lax.fori_loop(lo, nq, body,
-                           (jnp.zeros(k.shape, jnp.float32),
-                            jnp.zeros(v.shape, jnp.float32)))
-    dk_ref[0] = dk
-    dv_ref[0] = dv
+
+    for g in range(q_ref.shape[0]):                   # bh-block unroll
+        k = k_ref[g]                                  # [BK, D]
+        v = v_ref[g]
+
+        def body(i, carry, k=k, v=v):
+            dk, dv = carry
+            q = q_ref[g, pl.ds(i * block_q, block_q), :]
+            do = do_ref[g, pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[g, pl.ds(i * block_q, block_q), :] * _LOG2E
+            dd = dd_ref[g, pl.ds(i * block_q, block_q), :]
+            s = (scale * _LOG2E) * lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if causal:
+                qpos = (q_off + i * block_q
+                        + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+                kpos = k_off + lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 1)
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            p = jnp.exp2(s - lse)                     # [BQ, BK] f32
+            pc = p.astype(in_dt)
+            dv = dv + lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            ds = (p * (dp - dd) * scale).astype(in_dt)
+            dk = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            return dk, dv
+
+        dk, dv = lax.fori_loop(lo, nq, body,
+                               (jnp.zeros(k.shape, jnp.float32),
+                                jnp.zeros(v.shape, jnp.float32)))
+        dk_ref[g] = dk
+        dv_ref[g] = dv
 
 
 def _flash_bwd_dq_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
@@ -617,22 +653,26 @@ def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
     heads-major f32 gradients out)."""
     bh, tq = qt.shape[0], qt.shape[1]
     tk = kt.shape[1]
+    # the dq pass holds G resident k/v pairs, the dkv pass G resident
+    # q/do pairs — keep the larger side inside the backward VMEM budget
+    g = _pick_bh_block(bh, max(tq, tk) * d * qt.dtype.itemsize,
+                       2 * _BWD_RESIDENT_CAP)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel_res, causal=causal,
                           scale=scale, block_k=block_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, tq // block_q),
+            grid=(bh // g, tq // block_q),
             in_specs=[
-                pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
-                pl.BlockSpec((1, tk, d), lambda i, j, offs: (i, 0, 0)),
-                pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, tk, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, tk, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d),
+            out_specs=pl.BlockSpec((g, block_q, d),
                                    lambda i, j, offs: (i, j, 0)),
         ),
         out_shape=_struct((bh, tq, d), jnp.float32, qt, kt, offs),
@@ -649,18 +689,18 @@ def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
                           scale=scale, block_q=block_q),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, tk // block_k),
+            grid=(bh // g, tk // block_k),
             in_specs=[
-                pl.BlockSpec((1, tq, 1), lambda i, j, offs: (i, 0, 0)),
-                pl.BlockSpec((1, tq, 1), lambda i, j, offs: (i, 0, 0)),
-                pl.BlockSpec((1, tq, d), lambda i, j, offs: (i, 0, 0)),
-                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, tq, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, tq, 1), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, tq, 1), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, tq, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, tq, d), lambda i, j, offs: (i, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_k, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_k, d), lambda i, j, offs: (i, j, 0)),
             ],
         ),
         out_shape=[

@@ -446,3 +446,47 @@ def test_fused_adamw_pads_awkward_leaf_sizes(monkeypatch):
         np.testing.assert_allclose(np.asarray(new_p["w"]),
                                    np.asarray(want["w"]),
                                    rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_bh_blocked_cells(causal, monkeypatch):
+    """HVD_PALLAS_BLOCK_BH > 1: G batch-head slices share one grid cell
+    (statically unrolled) in the resident fwd/dq/dkv kernels; numerics
+    must equal the unblocked kernels in forward AND backward."""
+    monkeypatch.setenv("HVD_PALLAS_BLOCK_BH", "2")
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), 2, 128, 2, 64)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    out = pk.flash_attention(q, k, v, causal=causal)
+    g2 = jax.grad(loss(lambda *a: pk.flash_attention(*a, causal=causal)),
+                  argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("HVD_PALLAS_BLOCK_BH", "1")
+    ref = pk.flash_attention(q, k, v, causal=causal)
+    g1 = jax.grad(loss(lambda *a: pk.flash_attention(*a, causal=causal)),
+                  argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    for a, b in zip(g2, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_bh_block_pick_divisibility_and_cap():
+    """The bh-block G must always divide bh even when the VMEM cap shrinks
+    it (a non-divisor would leave trailing rows unvisited — silent wrong
+    numerics), and non-power-of-two env values floor to a power of two."""
+    import os
+    os.environ["HVD_PALLAS_BLOCK_BH"] = "7"
+    try:
+        # floor(7) -> 4; 28 % 4 == 0 -> 4
+        assert pk._pick_bh_block(28) == 4
+        # cap forces shrink: per_g 512k, cap 1M -> g=2; 28 % 2 == 0
+        assert pk._pick_bh_block(28, 512 * 1024, 1 << 20) == 2
+        # bh=6: floor(7)->4, 6%4 -> 2
+        assert pk._pick_bh_block(6) == 2
+        # impossible cap -> 1 (always valid)
+        assert pk._pick_bh_block(28, 1 << 30, 1 << 20) == 1
+    finally:
+        del os.environ["HVD_PALLAS_BLOCK_BH"]
