@@ -351,8 +351,14 @@ def _flash_step_call(qt, kt, vt, mt, lt, ot, offs, *, causal, scale,
         return _flash_step_call_streaming(
             qt, kt, vt, mt, lt, ot, offs, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, interpret=interpret)
-    # keep the resident k/v inside the VMEM budget as G grows
-    g = _pick_bh_block(bh, tk * d * kt.dtype.itemsize, 2 * _KV_VMEM_CAP)
+    # clamp G on an estimate of the full per-slice VMEM footprint — the
+    # f32 score tile (block_q x block_k) dominates, not the resident k/v;
+    # the estimate + _BH_VMEM_CAP reproduce the measured cliff (G=2 fits,
+    # G=4 -> 17.98M > 16M scoped at the Q512/K1024 defaults)
+    it = kt.dtype.itemsize
+    per_g = (2 * tk * d * it + block_q * block_k * 4
+             + 3 * block_q * d * 4)
+    g = _pick_bh_block(bh, per_g, _BH_VMEM_CAP)
     grid = (bh // g, tq // block_q)
     kernel = functools.partial(_flash_step_kernel, causal=causal, scale=scale,
                                block_k=block_k)
@@ -401,10 +407,17 @@ _KV_VMEM_CAP = 2 ** 20
 # Budget for the backward's whole-resident layout; beyond it _flash_bwd
 # switches to the streaming 3D-grid kernels (any length works there).
 # Tighter than the forward's: the resident dkv pass holds q AND do (plus
-# lse/dd and double-buffered tiles) — measured on v5e, 512 KB/operand
-# (seq 4096 at d=64 bf16) compiles within the 16 MB scoped-VMEM limit and
-# 1 MB (seq 8192) does not.
-_BWD_RESIDENT_CAP = 512 * 2 ** 10
+# lse/dd and double-buffered tiles). Re-measured at the Q512/K1024 default
+# tiles: 256 KB/operand (seq 2048 at d=64 bf16) compiles within the 16 MB
+# scoped-VMEM limit, 512 KB (seq 4096) exceeds it by 1.45 MB — the old
+# 512 KB cap dated from the 128-edge-tile era.
+_BWD_RESIDENT_CAP = 256 * 2 ** 10
+# Per-grid-cell VMEM budget for bh-blocking (G): half the 16 MB scoped
+# limit, leaving the rest for Mosaic's double buffering. With the per-g
+# footprint estimates at the call sites this admits the measured-working
+# G=2 (5.2 MB/slice x 2 <= 8 MB... per-slice 2.6 MB) and rejects the
+# measured-failing G=4 at the Q512/K1024 defaults.
+_BH_VMEM_CAP = 8 * 2 ** 20
 
 
 def step_supported(q, k) -> bool:
@@ -653,10 +666,13 @@ def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
     heads-major f32 gradients out)."""
     bh, tq = qt.shape[0], qt.shape[1]
     tk = kt.shape[1]
-    # the dq pass holds G resident k/v pairs, the dkv pass G resident
-    # q/do pairs — keep the larger side inside the backward VMEM budget
-    g = _pick_bh_block(bh, max(tq, tk) * d * qt.dtype.itemsize,
-                       2 * _BWD_RESIDENT_CAP)
+    # clamp G on the fuller of the two passes' per-slice VMEM footprints
+    # (dq holds resident k/v, dkv holds resident q/do; both build the f32
+    # score tile) — same estimate/cap scheme as the forward
+    it = qt.dtype.itemsize
+    per_g = (2 * max(tq, tk) * d * it + block_q * block_k * 4
+             + 3 * max(block_q, block_k) * d * 4)
+    g = _pick_bh_block(bh, per_g, _BH_VMEM_CAP)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel_res, causal=causal,
