@@ -30,6 +30,7 @@ from .basics import (  # noqa: F401
     ddl_built,
     gloo_built,
     init,
+    is_homogeneous,
     is_initialized,
     local_rank,
     local_size,

@@ -300,6 +300,28 @@ def set_thread_rank(r: Optional[int]) -> None:
     _rank_ctx.set(r)
 
 
+def is_homogeneous() -> bool:
+    """True if every node in the job has the same number of ranks
+    (reference `common/basics.py:122-129`). The launcher computes this
+    GLOBAL fact over the whole hostfile and exports it identically to
+    every rank as ``HVD_UNIFORM_LOCAL_SIZE`` (0 when heterogeneous) — a
+    rank-local ``size == local_size * cross_size`` test is NOT exact
+    (e.g. node sizes 4,2,1,1 satisfy it on one rank). Jobs without the
+    launcher env (standalone / thread-cluster) are single-node and
+    homogeneous by construction."""
+    _require_init()
+    uniform = os.environ.get("HVD_UNIFORM_LOCAL_SIZE")
+    if uniform:  # empty string == unset (a wrapper's `export VAR=`)
+        try:
+            return int(uniform) > 0
+        except ValueError:
+            raise ValueError(
+                f"HVD_UNIFORM_LOCAL_SIZE={uniform!r} is not an integer; "
+                "the launcher exports the uniform local size (0 when "
+                "hosts hold unequal rank counts)")
+    return True
+
+
 # --- build-capability probes: parity with horovod/common/basics.py ------------
 def mpi_threads_supported() -> bool:
     return False

@@ -31,10 +31,18 @@ from ..basics import (  # noqa: F401  (reference re-exports `keras/__init__.py:2
     Adasum,
     Average,
     Sum,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
     init,
+    is_homogeneous,
     local_rank,
     local_size,
+    mlsl_built,
+    mpi_built,
+    mpi_enabled,
     mpi_threads_supported,
+    nccl_built,
     rank,
     shutdown,
     size,

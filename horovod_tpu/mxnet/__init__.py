@@ -33,14 +33,24 @@ from typing import Optional
 import numpy as np
 
 from .. import basics
-from ..basics import (  # noqa: F401  (re-exported API surface)
+from ..basics import (  # noqa: F401  (re-exported API surface; probe set
+    # mirrors reference mxnet/__init__.py via mxnet/mpi_ops.py)
     Adasum,
     Average,
     Sum,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
     init,
+    is_homogeneous,
     is_initialized,
     local_rank,
     local_size,
+    mlsl_built,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     rank,
     shutdown,
     size,

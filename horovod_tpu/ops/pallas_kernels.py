@@ -414,9 +414,10 @@ _KV_VMEM_CAP = 2 ** 20
 _BWD_RESIDENT_CAP = 256 * 2 ** 10
 # Per-grid-cell VMEM budget for bh-blocking (G): half the 16 MB scoped
 # limit, leaving the rest for Mosaic's double buffering. With the per-g
-# footprint estimates at the call sites this admits the measured-working
-# G=2 (5.2 MB/slice x 2 <= 8 MB... per-slice 2.6 MB) and rejects the
-# measured-failing G=4 at the Q512/K1024 defaults.
+# footprint estimates at the call sites (2.6 MB per slice at the
+# lm_bench shapes) this admits the measured-working G=2
+# (2 x 2.6 = 5.2 MB <= 8 MB) and rejects the measured-failing G=4
+# (10.5 MB) at the Q512/K1024 defaults.
 _BH_VMEM_CAP = 8 * 2 ** 20
 
 

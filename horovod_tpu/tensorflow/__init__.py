@@ -25,16 +25,26 @@ from typing import Any, List, Optional
 import numpy as np
 
 from .. import basics
-from ..basics import (  # noqa: F401  (re-exported API surface)
+from ..basics import (  # noqa: F401  (re-exported API surface; probe set
+    # mirrors reference tensorflow/__init__.py:30-43)
     Adasum,
     Average,
     Sum,
     cross_rank,
     cross_size,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
     init,
+    is_homogeneous,
     is_initialized,
     local_rank,
     local_size,
+    mlsl_built,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     rank,
     shutdown,
     size,
@@ -50,6 +60,21 @@ try:
 except ImportError:  # pragma: no cover - exercised only without tensorflow
     tf = None
     _HAVE_TF = False
+
+
+def _gpu_available() -> bool:
+    if not _HAVE_TF:
+        return False
+    try:
+        return bool(tf.config.list_physical_devices("GPU"))
+    except Exception:  # pragma: no cover - defensive against TF quirks
+        return False
+
+
+#: reference parity (`tensorflow/__init__.py:43`): True when TF sees a GPU.
+#: Always False on the TPU-native platform — kept so ported scripts that
+#: branch on it (e.g. Adasum GPU scaling) take their CPU/TPU path.
+has_gpu = _gpu_available()
 
 
 def _require_tf():

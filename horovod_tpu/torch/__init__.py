@@ -24,16 +24,26 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from .. import basics
-from ..basics import (  # noqa: F401  (re-exported API surface)
+from ..basics import (  # noqa: F401  (re-exported API surface; probe set
+    # mirrors reference torch/mpi_ops.py:60-77)
     Adasum,
     Average,
     Sum,
     cross_rank,
     cross_size,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
     init,
+    is_homogeneous,
     is_initialized,
     local_rank,
     local_size,
+    mlsl_built,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     rank,
     shutdown,
     size,

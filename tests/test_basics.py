@@ -47,3 +47,35 @@ def test_shutdown_resets():
     hvd.init()
     hvd.shutdown()
     assert not hvd.is_initialized()
+
+
+def test_probe_surface_parity(monkeypatch):
+    """Every framework surface re-exports the reference's build/runtime
+    probe set (reference torch/mpi_ops.py:60-77, tensorflow/__init__.py:
+    30-43), and is_homogeneous reflects the launcher's global fact."""
+    import importlib
+
+    monkeypatch.delenv("HVD_UNIFORM_LOCAL_SIZE", raising=False)
+
+    probes = ["mpi_built", "gloo_built", "nccl_built", "ddl_built",
+              "mlsl_built", "mpi_enabled", "gloo_enabled",
+              "is_homogeneous", "mpi_threads_supported"]
+    for mod in ["horovod_tpu", "horovod_tpu.torch", "horovod_tpu.mxnet",
+                "horovod_tpu.keras"]:
+        m = importlib.import_module(mod)
+        missing = [p for p in probes if not hasattr(m, p)]
+        assert not missing, (mod, missing)
+
+    import horovod_tpu as hvd
+    hvd.init()
+    # no launcher env: single-node modes are homogeneous by construction
+    assert hvd.is_homogeneous() is True
+
+
+def test_is_homogeneous_follows_launcher_fact(monkeypatch):
+    import horovod_tpu as hvd
+    hvd.init()
+    monkeypatch.setenv("HVD_UNIFORM_LOCAL_SIZE", "0")
+    assert hvd.is_homogeneous() is False
+    monkeypatch.setenv("HVD_UNIFORM_LOCAL_SIZE", "4")
+    assert hvd.is_homogeneous() is True
