@@ -159,6 +159,14 @@ void ParameterManager::Decode(const std::vector<double>& x) {
   cycle_ms_ = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
 }
 
+void ParameterManager::Configure(int warmup_samples, int steps_per_sample,
+                                 int max_samples, double gp_noise) {
+  if (warmup_samples >= 0) warmup_remaining_ = warmup_samples;
+  if (steps_per_sample > 0) steps_per_sample_ = steps_per_sample;
+  if (max_samples > 0) max_samples_ = max_samples;
+  if (gp_noise > 0) opt_.SetNoise(gp_noise);
+}
+
 bool ParameterManager::Update(int64_t bytes, double seconds) {
   if (!enabled_) return false;
   acc_bytes_ += bytes;
@@ -168,6 +176,10 @@ bool ParameterManager::Update(int64_t bytes, double seconds) {
   acc_bytes_ = 0;
   acc_seconds_ = 0;
   steps_ = 0;
+  if (warmup_remaining_ > 0) {  // discard spin-up windows entirely
+    warmup_remaining_--;
+    return false;
+  }
   if (score > best_score_) {
     best_score_ = score;
     best_threshold_ = threshold_;

@@ -44,6 +44,9 @@ class BayesianOptimizer {
       : dims_(dims), rng_(seed) {}
   void AddSample(const std::vector<double>& x, double y);
   std::vector<double> NextSample();
+  // GP observation noise on the standardized scores
+  // (HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, parameter_manager.cc:31)
+  void SetNoise(double noise) { gp_ = GaussianProcess(0.3, noise); }
 
  private:
   int dims_;
@@ -63,6 +66,11 @@ class ParameterManager {
                    uint64_t seed = 0);
   void SetEnabled(bool e) { enabled_ = e; }
   bool enabled() const { return enabled_; }
+
+  // The reference's four HOROVOD_AUTOTUNE_* tuning knobs
+  // (parameter_manager.cc:42-59); values <= 0 keep the current setting.
+  void Configure(int warmup_samples, int steps_per_sample, int max_samples,
+                 double gp_noise);
 
   // record bytes moved in an interval; returns true if params changed
   bool Update(int64_t bytes, double seconds);
@@ -87,6 +95,9 @@ class ParameterManager {
   double best_cycle_ms_;
   int samples_ = 0;
   int max_samples_ = 40;  // then settle on best (parameter_manager stops too)
+  // sample windows discarded before scoring starts (measurements during
+  // spin-up are unstable; reference parameter_manager.cc:177-181)
+  int warmup_remaining_ = 0;
 };
 
 }  // namespace hvdtpu

@@ -244,6 +244,18 @@ int64_t hvd_tuner_create(int64_t fusion_threshold_bytes, double cycle_time_ms,
   return h;
 }
 
+// the reference's four HOROVOD_AUTOTUNE_* tuning knobs; <=0 keeps defaults
+void hvd_tuner_configure(int64_t h, int32_t warmup_samples,
+                         int32_t steps_per_sample, int32_t max_samples,
+                         double gp_noise) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_tuners.find(h);
+  if (it != g_tuners.end()) {
+    it->second->Configure(warmup_samples, steps_per_sample, max_samples,
+                          gp_noise);
+  }
+}
+
 // returns 1 if (threshold, cycle_time) changed
 int32_t hvd_tuner_update(int64_t h, int64_t bytes, double seconds) {
   std::lock_guard<std::mutex> l(g_mu);
