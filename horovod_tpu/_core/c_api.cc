@@ -191,6 +191,19 @@ void hvd_core_timeline_cycle(int64_t eng) {
   if (c) c->timeline->CycleMarker(NowUs());
 }
 
+// apply the reference's four HOROVOD_AUTOTUNE_* tuning knobs
+// (parameter_manager.cc:42-59) to the engine-internal tuner; pass -1
+// (or <=0 for the float) to keep a knob at its default — warmup accepts 0
+void hvd_core_tuner_configure(int64_t eng, int32_t warmup_samples,
+                              int32_t steps_per_sample, int32_t max_samples,
+                              double gp_noise) {
+  EngineCore* c = Get(eng);
+  if (c && c->params) {
+    c->params->Configure(warmup_samples, steps_per_sample, max_samples,
+                         gp_noise);
+  }
+}
+
 // autotune: report an execution interval; returns 1 if params changed
 int32_t hvd_core_report_score(int64_t eng, int64_t bytes, double seconds) {
   EngineCore* c = Get(eng);

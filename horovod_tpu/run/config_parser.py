@@ -19,9 +19,30 @@ _KNOBS = {
                              lambda v: "1" if v else "0"),
     "autotune": ("HOROVOD_AUTOTUNE", lambda v: "1" if v else "0"),
     "autotune_log": ("HOROVOD_AUTOTUNE_LOG", str),
+    "autotune_warmup_samples": ("HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+                                lambda v: str(int(v))),
+    "autotune_steps_per_sample": ("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+                                  lambda v: str(int(v))),
+    "autotune_bayes_opt_max_samples": (
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", lambda v: str(int(v))),
+    "autotune_gaussian_process_noise": (
+        "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", lambda v: str(float(v))),
     "stall_check_time": ("HOROVOD_STALL_CHECK_TIME_SECONDS", str),
     "stall_shutdown_time": ("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", str),
     "log_level": ("HOROVOD_LOG_LEVEL", str),
+}
+
+# tri-state booleans: True and False both export (the reference maps
+# --no-hierarchical-allreduce to HOROVOD_HIERARCHICAL_ALLREDUCE=0, and
+# --no-stall-check to HOROVOD_STALL_CHECK_DISABLE=1 —
+# `run/common/util/config_parser.py:140-180`); None leaves the env alone
+_TRISTATE = {
+    "hierarchical_allreduce": ("HOROVOD_HIERARCHICAL_ALLREDUCE",
+                               lambda v: "1" if v else "0"),
+    "hierarchical_allgather": ("HOROVOD_HIERARCHICAL_ALLGATHER",
+                               lambda v: "1" if v else "0"),
+    "stall_check": ("HOROVOD_STALL_CHECK_DISABLE",
+                    lambda v: "0" if v else "1"),
 }
 
 
@@ -32,6 +53,10 @@ def args_to_env(args) -> Dict[str, str]:
     for flag, (var, conv) in _KNOBS.items():
         v = d.get(flag)
         if v is not None and v is not False:
+            env[var] = conv(v)
+    for flag, (var, conv) in _TRISTATE.items():
+        v = d.get(flag)
+        if v is not None:
             env[var] = conv(v)
     return env
 
@@ -55,11 +80,34 @@ def parse_config_file(path: str) -> Dict[str, object]:
         out["timeline_filename"] = tl["filename"]
     if "mark-cycles" in tl:
         out["timeline_mark_cycles"] = tl["mark-cycles"]
+    # reference layout nests the two-level knobs under ``params:``
+    # (`run/common/util/config_parser.py:60-66`); accept them top-level and
+    # in underscore spelling too, like every other knob in this file
+    params = data.get("params") or {}
+    for k in ("hierarchical-allreduce", "hierarchical-allgather"):
+        ku = k.replace("-", "_")
+        for src in (data, params):  # params: section wins when both given
+            if k in src:
+                out[ku] = bool(src[k])
+            elif ku in src:
+                out[ku] = bool(src[ku])
     at = data.get("autotune") or {}
     if at.get("enabled"):
         out["autotune"] = True
     if "log-file" in at:
         out["autotune_log"] = at["log-file"]
+    for k in ("warmup-samples", "steps-per-sample", "bayes-opt-max-samples",
+              "gaussian-process-noise"):
+        if k in at:
+            out["autotune_" + k.replace("-", "_")] = at[k]
+    # ``stall-check:`` section (`config_parser.py:86-92` there)
+    sc = data.get("stall-check") or data.get("stall_check") or {}
+    if "enabled" in sc:
+        out["stall_check"] = bool(sc["enabled"])
+    if "warning-time-seconds" in sc:
+        out["stall_check_time"] = sc["warning-time-seconds"]
+    if "shutdown-time-seconds" in sc:
+        out["stall_shutdown_time"] = sc["shutdown-time-seconds"]
     return out
 
 
@@ -70,6 +118,11 @@ def env_from_config(path: Optional[str], args=None) -> Dict[str, str]:
     if args is not None:
         d = vars(args) if not isinstance(args, dict) else dict(args)
         for k, v in d.items():
-            if v is not None and v is not False:
-                merged[k] = v
+            if v is None:
+                continue
+            # tri-states: an explicit False (--no-*) must override the
+            # config file, not vanish
+            if v is False and k not in _TRISTATE:
+                continue
+            merged[k] = v
     return args_to_env(merged)

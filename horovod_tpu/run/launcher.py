@@ -63,9 +63,40 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--autotune", action="store_true")
-    p.add_argument("--autotune-log", default=None)
-    p.add_argument("--stall-check-time", type=float, default=None)
-    p.add_argument("--stall-shutdown-time", type=float, default=None)
+    p.add_argument("--autotune-log", "--autotune-log-file",
+                   dest="autotune_log", default=None)
+    # the four GP-tuner cadence knobs (run.py:502-521, parameter_manager.cc)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int, default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
+    # two-level collectives (run.py:433-447): tri-state — unset leaves the
+    # workers' own HOROVOD_HIERARCHICAL_* env/default in force
+    hier_ar = p.add_mutually_exclusive_group()
+    hier_ar.add_argument("--hierarchical-allreduce",
+                         dest="hierarchical_allreduce", action="store_true",
+                         default=None)
+    hier_ar.add_argument("--no-hierarchical-allreduce",
+                         dest="hierarchical_allreduce", action="store_false")
+    hier_ag = p.add_mutually_exclusive_group()
+    hier_ag.add_argument("--hierarchical-allgather",
+                         dest="hierarchical_allgather", action="store_true",
+                         default=None)
+    hier_ag.add_argument("--no-hierarchical-allgather",
+                         dest="hierarchical_allgather", action="store_false")
+    stall = p.add_mutually_exclusive_group()
+    stall.add_argument("--stall-check", dest="stall_check",
+                       action="store_true", default=None)
+    stall.add_argument("--no-stall-check", dest="stall_check",
+                       action="store_false",
+                       help="disable the stall inspector entirely "
+                            "(HOROVOD_STALL_CHECK_DISABLE)")
+    p.add_argument("--stall-check-time", "--stall-check-warning-time-seconds",
+                   dest="stall_check_time", type=float, default=None)
+    p.add_argument("--stall-shutdown-time",
+                   "--stall-check-shutdown-time-seconds",
+                   dest="stall_shutdown_time", type=float, default=None)
     p.add_argument("--log-level", default=None)
     p.add_argument("--config-file", default=None, help="YAML config file")
     p.add_argument("-cb", "--check-build", action="store_true",

@@ -20,6 +20,10 @@ Env knobs (parity with `common.h:61-87` / `operations.cc:388-485`):
   HOROVOD_CACHE_CAPACITY   (default 1024)
   HOROVOD_STALL_CHECK_TIME_SECONDS (default 60,  stall_inspector.h:75)
   HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (default 0 = never, stall_inspector.h:80)
+  HOROVOD_STALL_CHECK_DISABLE (1 = never warn/shutdown, env_parser.cc:120)
+  HOROVOD_AUTOTUNE_WARMUP_SAMPLES / _STEPS_PER_SAMPLE /
+  _BAYES_OPT_MAX_SAMPLES / _GAUSSIAN_PROCESS_NOISE
+                           (tuner cadence knobs, parameter_manager.cc:42-59)
   HOROVOD_TIMELINE         (path for Chrome-trace output)
   HOROVOD_AUTOTUNE         (1 = GP/EI tuning of fusion threshold+cycle time)
   HVD_TPU_NATIVE           (0 = force the pure-Python controller)
@@ -58,10 +62,21 @@ def _timeline_path(mode: str, self_rank: int) -> "Optional[str]":
     return f"{path}.rank{self_rank}"
 
 
+def _stall_knobs():
+    """(warning_s, shutdown_s) with HOROVOD_STALL_CHECK_DISABLE folded in:
+    disabling the check (`env_parser.cc:120`) means neither warning nor
+    forced shutdown ever fires, regardless of the time knobs."""
+    if _env_on("HOROVOD_STALL_CHECK_DISABLE"):
+        return float("inf"), 0.0
+    return (_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+            _env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0))
+
+
 def _make_controller(world: int, mode: str, self_rank: int = 0):
     fusion_threshold = int(_env_float("HOROVOD_FUSION_THRESHOLD",
                                       DEFAULT_FUSION_BYTES))
     cycle_ms = _env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_MS)
+    stall_warning_s, stall_shutdown_s = _stall_knobs()
     if mode == "multiprocess" and world > 1:
         # cross-process control plane: negotiation/validation/fusion/
         # allgather/join coordinated at rank 0 (controller.cc:55-336 +
@@ -76,10 +91,8 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
             ctrl = CoordController(
                 world=world,
                 fusion_threshold=fusion_threshold,
-                stall_warning_s=_env_float(
-                    "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
-                stall_shutdown_s=_env_float(
-                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+                stall_warning_s=stall_warning_s,
+                stall_shutdown_s=stall_shutdown_s,
                 cache_capacity=int(_env_float("HOROVOD_CACHE_CAPACITY", 1024)),
                 fusion_enabled=True,
                 timeline_path=_timeline_path(mode, self_rank),
@@ -95,8 +108,8 @@ def _make_controller(world: int, mode: str, self_rank: int = 0):
     kwargs = dict(
         world=world,
         fusion_threshold=fusion_threshold,
-        stall_warning_s=_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
-        stall_shutdown_s=_env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+        stall_warning_s=stall_warning_s,
+        stall_shutdown_s=stall_shutdown_s,
         cache_capacity=int(_env_float("HOROVOD_CACHE_CAPACITY", 1024)),
         # multiprocess fusion requires the cross-process control plane:
         # bucket contents must not depend on per-process tick timing

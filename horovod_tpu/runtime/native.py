@@ -59,66 +59,117 @@ def load_library():
             return _lib
         if not os.path.exists(_LIB_PATH) and not _build():
             return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        lib.hvd_core_create.restype = ctypes.c_int64
-        lib.hvd_core_create.argtypes = [
-            ctypes.c_int32, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
-            ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
-            ctypes.c_double, ctypes.c_int32, ctypes.c_int32]
-        lib.hvd_core_destroy.argtypes = [ctypes.c_int64]
-        lib.hvd_core_submit.restype = ctypes.c_int64
-        lib.hvd_core_submit.argtypes = [
-            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_double]
-        lib.hvd_core_join.restype = ctypes.c_int64
-        lib.hvd_core_join.argtypes = [ctypes.c_int64, ctypes.c_int32]
-        lib.hvd_core_tick.restype = ctypes.c_int64
-        lib.hvd_core_tick.argtypes = [ctypes.c_int64,
-                                      ctypes.POINTER(ctypes.c_char_p)]
-        lib.hvd_core_shutdown.restype = ctypes.c_int64
-        lib.hvd_core_shutdown.argtypes = [ctypes.c_int64,
-                                          ctypes.POINTER(ctypes.c_char_p)]
-        for f in ("hvd_core_timeline_op_start", "hvd_core_timeline_activity"):
-            getattr(lib, f).argtypes = [ctypes.c_int64, ctypes.c_char_p,
-                                        ctypes.c_char_p]
-        lib.hvd_core_timeline_op_end.argtypes = [ctypes.c_int64,
-                                                 ctypes.c_char_p]
-        lib.hvd_core_timeline_cycle.argtypes = [ctypes.c_int64]
-        lib.hvd_core_timeline_cache.argtypes = [ctypes.c_int64,
-                                                ctypes.c_uint64,
-                                                ctypes.c_uint64]
-        lib.hvd_core_report_score.restype = ctypes.c_int32
-        lib.hvd_core_report_score.argtypes = [ctypes.c_int64, ctypes.c_int64,
-                                              ctypes.c_double]
-        lib.hvd_core_fusion_threshold.restype = ctypes.c_int64
-        lib.hvd_core_fusion_threshold.argtypes = [ctypes.c_int64]
-        lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
-        lib.hvd_core_cycle_time_ms.argtypes = [ctypes.c_int64]
-        lib.hvd_core_cache_hits.restype = ctypes.c_uint64
-        lib.hvd_core_cache_hits.argtypes = [ctypes.c_int64]
-        lib.hvd_core_cache_misses.restype = ctypes.c_uint64
-        lib.hvd_core_cache_misses.argtypes = [ctypes.c_int64]
-        lib.hvd_tuner_active.restype = ctypes.c_int32
-        lib.hvd_tuner_active.argtypes = [ctypes.c_int64]
-        lib.hvd_core_autotune_active.restype = ctypes.c_int32
-        lib.hvd_core_autotune_active.argtypes = [ctypes.c_int64]
-        lib.hvd_tuner_create.restype = ctypes.c_int64
-        lib.hvd_tuner_create.argtypes = [ctypes.c_int64, ctypes.c_double,
-                                         ctypes.c_uint64]
-        lib.hvd_tuner_update.restype = ctypes.c_int32
-        lib.hvd_tuner_update.argtypes = [ctypes.c_int64, ctypes.c_int64,
-                                         ctypes.c_double]
-        lib.hvd_tuner_threshold.restype = ctypes.c_int64
-        lib.hvd_tuner_threshold.argtypes = [ctypes.c_int64]
-        lib.hvd_tuner_cycle_ms.restype = ctypes.c_double
-        lib.hvd_tuner_cycle_ms.argtypes = [ctypes.c_int64]
-        lib.hvd_tuner_destroy.argtypes = [ctypes.c_int64]
+        lib = _load_and_bind()
+        if lib is None and _build():
+            # a prebuilt .so can predate newly added C entry points (the
+            # build products are gitignored); one rebuild-and-retry keeps
+            # the returns-None-on-failure contract instead of raising
+            lib = _load_and_bind()
         _lib = lib
         return _lib
+
+
+def _load_and_bind():
+    """dlopen + bind every C symbol; None if the library is unloadable or
+    missing a symbol (stale build)."""
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    try:
+        _bind(lib)
+    except AttributeError:
+        return None
+    return lib
+
+
+def _bind(lib) -> None:
+    lib.hvd_core_create.restype = ctypes.c_int64
+    lib.hvd_core_create.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_int32, ctypes.c_int32]
+    lib.hvd_core_destroy.argtypes = [ctypes.c_int64]
+    lib.hvd_core_submit.restype = ctypes.c_int64
+    lib.hvd_core_submit.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+    lib.hvd_core_join.restype = ctypes.c_int64
+    lib.hvd_core_join.argtypes = [ctypes.c_int64, ctypes.c_int32]
+    lib.hvd_core_tick.restype = ctypes.c_int64
+    lib.hvd_core_tick.argtypes = [ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_char_p)]
+    lib.hvd_core_shutdown.restype = ctypes.c_int64
+    lib.hvd_core_shutdown.argtypes = [ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_char_p)]
+    for f in ("hvd_core_timeline_op_start", "hvd_core_timeline_activity"):
+        getattr(lib, f).argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                    ctypes.c_char_p]
+    lib.hvd_core_timeline_op_end.argtypes = [ctypes.c_int64,
+                                             ctypes.c_char_p]
+    lib.hvd_core_timeline_cycle.argtypes = [ctypes.c_int64]
+    lib.hvd_core_timeline_cache.argtypes = [ctypes.c_int64,
+                                            ctypes.c_uint64,
+                                            ctypes.c_uint64]
+    lib.hvd_core_report_score.restype = ctypes.c_int32
+    lib.hvd_core_report_score.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                          ctypes.c_double]
+    lib.hvd_core_fusion_threshold.restype = ctypes.c_int64
+    lib.hvd_core_fusion_threshold.argtypes = [ctypes.c_int64]
+    lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_core_cycle_time_ms.argtypes = [ctypes.c_int64]
+    lib.hvd_core_cache_hits.restype = ctypes.c_uint64
+    lib.hvd_core_cache_hits.argtypes = [ctypes.c_int64]
+    lib.hvd_core_cache_misses.restype = ctypes.c_uint64
+    lib.hvd_core_cache_misses.argtypes = [ctypes.c_int64]
+    lib.hvd_tuner_active.restype = ctypes.c_int32
+    lib.hvd_tuner_active.argtypes = [ctypes.c_int64]
+    lib.hvd_core_autotune_active.restype = ctypes.c_int32
+    lib.hvd_core_autotune_active.argtypes = [ctypes.c_int64]
+    lib.hvd_tuner_create.restype = ctypes.c_int64
+    lib.hvd_tuner_create.argtypes = [ctypes.c_int64, ctypes.c_double,
+                                     ctypes.c_uint64]
+    lib.hvd_tuner_configure.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_double]
+    lib.hvd_core_tuner_configure.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_double]
+    lib.hvd_tuner_update.restype = ctypes.c_int32
+    lib.hvd_tuner_update.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.c_double]
+    lib.hvd_tuner_threshold.restype = ctypes.c_int64
+    lib.hvd_tuner_threshold.argtypes = [ctypes.c_int64]
+    lib.hvd_tuner_cycle_ms.restype = ctypes.c_double
+    lib.hvd_tuner_cycle_ms.argtypes = [ctypes.c_int64]
+    lib.hvd_tuner_destroy.argtypes = [ctypes.c_int64]
+
+
+def autotune_env_knobs():
+    """Parse the reference's four HOROVOD_AUTOTUNE_* tuning knobs
+    (`horovod/common/parameter_manager.cc:42-59`): warmup samples,
+    steps per sample, Bayes-opt max samples, GP noise. Unset/invalid maps
+    to the sentinel (-1 / -1.0) the native ``Configure()`` treats as
+    keep-default (warmup accepts an explicit 0)."""
+    def _int(name: str) -> int:
+        v = os.environ.get(name, "")
+        try:
+            return int(v) if v else -1
+        except ValueError:
+            return -1
+
+    def _flt(name: str) -> float:
+        v = os.environ.get(name, "")
+        try:
+            return float(v) if v else -1.0
+        except ValueError:
+            return -1.0
+
+    return (_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES"),
+            _int("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"),
+            _int("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"),
+            _flt("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"))
 
 
 class NativeTuner:
@@ -130,12 +181,15 @@ class NativeTuner:
     caller degrades to no-tuning with a warning)."""
 
     def __init__(self, fusion_threshold: int, cycle_time_ms: float,
-                 seed: int = 0):
+                 seed: int = 0, knobs=None):
         lib = load_library()
         if lib is None:
             raise RuntimeError("native core unavailable")
         self._lib = lib
         self._h = lib.hvd_tuner_create(fusion_threshold, cycle_time_ms, seed)
+        # the four HOROVOD_AUTOTUNE_* sub-knobs (env unless given explicitly)
+        w, s, m, n = knobs if knobs is not None else autotune_env_knobs()
+        lib.hvd_tuner_configure(self._h, w, s, m, n)
 
     def update(self, nbytes: int, seconds: float) -> bool:
         """Record one scored interval; True if tuned params changed."""
@@ -183,6 +237,9 @@ class NativeController:
             timeline_path.encode() if timeline_path else None,
             int(autotune), cycle_time_ms, int(local_only), self_rank)
         self._dead = False
+        if autotune:
+            self._lib.hvd_core_tuner_configure(self._eng,
+                                               *autotune_env_knobs())
 
     def submit(self, entry: TensorTableEntry) -> int:
         shape = np.asarray(entry.array.shape, dtype=np.int64)

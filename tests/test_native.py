@@ -218,3 +218,64 @@ def test_wire_roundtrip_python_decoder():
         "<i", -1) + struct.pack("<I", 0) + b"\x00"
     resp, pairs, joins, last, warns, shut = wire.decode_tick(buf)
     assert resp == [] and joins == [] and last == -1 and not shut
+
+
+def test_autotune_subknob_cadence():
+    """The four HOROVOD_AUTOTUNE_* sub-knobs observably change tuner cadence
+    (`parameter_manager.cc:42-59`): steps-per-sample sets how many scored
+    intervals make one GP sample, warmup-samples discards leading windows,
+    bayes-opt-max-samples bounds exploration before settling."""
+    from horovod_tpu.runtime.native import NativeTuner, load_library
+
+    if load_library() is None:
+        pytest.skip("native core unavailable")
+    # default cadence: 10 scored intervals per GP sample
+    t = NativeTuner(64 << 20, 5.0, seed=1, knobs=(-1, -1, -1, -1.0))
+    assert not any(t.update(1 << 20, 0.01) for _ in range(9))
+    assert t.update(1 << 20, 0.01)
+    t.close()
+    # steps-per-sample=2: retunes on the second interval
+    t = NativeTuner(64 << 20, 5.0, seed=1, knobs=(-1, 2, -1, -1.0))
+    assert not t.update(1 << 20, 0.01)
+    assert t.update(1 << 20, 0.01)
+    t.close()
+    # warmup-samples=2 (steps=1): first two complete windows are discarded
+    t = NativeTuner(64 << 20, 5.0, seed=1, knobs=(2, 1, -1, -1.0))
+    assert not t.update(1 << 20, 0.01)
+    assert not t.update(1 << 20, 0.01)
+    assert t.update(1 << 20, 0.01)
+    t.close()
+    # bayes-opt-max-samples=2: two samples of exploration, then settled
+    t = NativeTuner(64 << 20, 5.0, seed=1, knobs=(0, 1, 2, -1.0))
+    assert t.active()
+    t.update(1 << 20, 0.01)
+    t.update(1 << 20, 0.02)
+    assert not t.active()
+    t.close()
+    # gaussian-process-noise reaches the GP and tuning still functions
+    t = NativeTuner(64 << 20, 5.0, seed=1, knobs=(0, 1, -1, 0.5))
+    assert t.update(1 << 20, 0.01)
+    t.close()
+
+
+def test_autotune_env_knobs_reach_engine_tuner(monkeypatch):
+    """The HOROVOD_AUTOTUNE_* envs configure the ENGINE-internal tuner via
+    hvd_core_tuner_configure (`c_api.cc`) — the round-3 dead C surface, now
+    wired: with steps-per-sample=1 the very first scored interval retunes
+    (the default cadence would need 10)."""
+    from horovod_tpu.runtime.native import NativeController, load_library
+
+    if load_library() is None:
+        pytest.skip("native core unavailable")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+    ctrl = NativeController(world=1, fusion_threshold=64 << 20,
+                            stall_warning_s=60.0, stall_shutdown_s=0.0,
+                            cache_capacity=16, fusion_enabled=True,
+                            timeline_path=None, autotune=True,
+                            cycle_time_ms=5.0)
+    try:
+        assert ctrl.report_score(1 << 20, 0.01), \
+            "steps-per-sample=1 must retune on the first scored interval"
+    finally:
+        ctrl.shutdown()

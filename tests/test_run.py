@@ -249,3 +249,76 @@ def test_rank_death_kills_job_not_hangs(tmp_path):
     assert r.returncode != 0
     assert "SURVIVOR FINISHED" not in r.stdout
     assert time.monotonic() - t0 < 150  # killed, not timed out
+
+
+def test_round4_flag_env_mapping():
+    """Flag parity sweep (reference `run/run.py:395-616` mapped through
+    `config_parser.py:140-180`, test style `test/test_run.py:68-80`):
+    autotune sub-knobs, hierarchical collectives, stall-check disable."""
+    args = build_parser().parse_args(
+        ["-np", "2",
+         "--autotune", "--autotune-warmup-samples", "2",
+         "--autotune-steps-per-sample", "3",
+         "--autotune-bayes-opt-max-samples", "7",
+         "--autotune-gaussian-process-noise", "0.9",
+         "--hierarchical-allreduce", "--no-hierarchical-allgather",
+         "--no-stall-check", "--", "python", "x.py"])
+    env = config_parser.env_from_config(None, args)
+    assert env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] == "2"
+    assert env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] == "3"
+    assert env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "7"
+    assert env["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.9"
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HOROVOD_HIERARCHICAL_ALLGATHER"] == "0"
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+
+
+def test_tristate_flags_absent_by_default():
+    """Unset tri-state flags must NOT export env — the workers' own env or
+    defaults stay in force (reference leaves unset args out of the env)."""
+    args = build_parser().parse_args(["-np", "2", "--", "python", "x.py"])
+    env = config_parser.env_from_config(None, args)
+    for var in ("HOROVOD_HIERARCHICAL_ALLREDUCE",
+                "HOROVOD_HIERARCHICAL_ALLGATHER",
+                "HOROVOD_STALL_CHECK_DISABLE"):
+        assert var not in env, var
+
+
+def test_config_yaml_round4_sections(tmp_path):
+    """YAML sections mirror the reference layout: params.hierarchical-*,
+    autotune.{warmup,steps,bayes,noise}, stall-check.{enabled,times}
+    (`run/common/util/config_parser.py:60-92`)."""
+    import textwrap as tw
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(tw.dedent("""
+        params:
+            hierarchical-allreduce: true
+            hierarchical-allgather: false
+        autotune:
+            enabled: true
+            warmup-samples: 4
+            steps-per-sample: 5
+            bayes-opt-max-samples: 6
+            gaussian-process-noise: 0.25
+        stall-check:
+            enabled: false
+            warning-time-seconds: 30
+            shutdown-time-seconds: 90
+    """))
+    env = config_parser.env_from_config(str(cfg))
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HOROVOD_HIERARCHICAL_ALLGATHER"] == "0"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] == "4"
+    assert env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] == "5"
+    assert env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "6"
+    assert env["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.25"
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30"
+    assert env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] == "90"
+    # CLI flag overrides the config file (reference override_args behavior)
+    args = build_parser().parse_args(
+        ["-np", "2", "--stall-check", "--", "python", "x.py"])
+    env = config_parser.env_from_config(str(cfg), args)
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "0"

@@ -54,3 +54,26 @@ def test_stall_shutdown(monkeypatch):
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_stall_check_disable(monkeypatch, caplog):
+    """HOROVOD_STALL_CHECK_DISABLE=1 (`env_parser.cc:120`,
+    `--no-stall-check`) silences the inspector entirely even with an
+    aggressively low warning threshold."""
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.1")
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+
+    def fn():
+        if hvd.rank() == 1:
+            time.sleep(0.6)  # far past the (disabled) warning threshold
+        out = hvd.allreduce(np.full((4,), float(hvd.rank() + 1),
+                                    np.float32), name="quiet", op=hvd.Sum)
+        return np.asarray(out)
+
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        results = testing.run_cluster(fn, np=2)
+    for r in results:
+        np.testing.assert_allclose(r, np.full((4,), 3.0))
+    messages = [rec.getMessage() for rec in caplog.records]
+    assert not any("waiting for remainder of ranks" in m for m in messages), \
+        messages
