@@ -79,6 +79,34 @@ def _build_mesh(devices):
     return Mesh(np.asarray(devices), (MESH_AXIS,))
 
 
+# reference logging.h level names (TRACE/FATAL have no stdlib equivalents;
+# map to the nearest level the way glog-style loggers are usually bridged)
+_LOG_LEVELS = {"TRACE": logging.DEBUG, "DEBUG": logging.DEBUG,
+               "INFO": logging.INFO, "WARNING": logging.WARNING,
+               "ERROR": logging.ERROR, "FATAL": logging.CRITICAL}
+
+
+def _setup_logging() -> None:
+    """Apply HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME to the framework
+    logger (reference `common/logging.{h,cc}`: leveled macro logger driven
+    by the same envs, exported by the launcher's --log-level /
+    --log-hide-timestamp flags). Only touches the ``horovod_tpu`` logger —
+    never the root — and only adds a handler if the app hasn't."""
+    level = os.environ.get("HOROVOD_LOG_LEVEL", "").upper()
+    if level in _LOG_LEVELS:
+        logger.setLevel(_LOG_LEVELS[level])
+    if logger.handlers or logging.getLogger().handlers:
+        return  # the application configured logging; respect it
+    from .utils.env import env_on
+
+    handler = logging.StreamHandler()
+    fmt = "[%(asctime)s] %(levelname)s %(name)s: %(message)s"
+    if env_on("HOROVOD_LOG_HIDE_TIME"):
+        fmt = "%(levelname)s %(name)s: %(message)s"
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.addHandler(handler)
+
+
 def init(
     ranks: Optional[Sequence[int]] = None,
     *,
@@ -106,6 +134,7 @@ def init(
     with _init_lock:
         if _state.initialized:
             return
+        _setup_logging()
         coord = os.environ.get("HVD_COORDINATOR_ADDR")
         if _cluster_size is not None:
             devices = list(_devices) if _devices is not None else list(jax.devices())

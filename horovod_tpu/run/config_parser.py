@@ -15,9 +15,6 @@ _KNOBS = {
     "cycle_time_ms": ("HOROVOD_CYCLE_TIME", str),
     "cache_capacity": ("HOROVOD_CACHE_CAPACITY", str),
     "timeline_filename": ("HOROVOD_TIMELINE", str),
-    "timeline_mark_cycles": ("HOROVOD_TIMELINE_MARK_CYCLES",
-                             lambda v: "1" if v else "0"),
-    "autotune": ("HOROVOD_AUTOTUNE", lambda v: "1" if v else "0"),
     "autotune_log": ("HOROVOD_AUTOTUNE_LOG", str),
     "autotune_warmup_samples": ("HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
                                 lambda v: str(int(v))),
@@ -43,6 +40,11 @@ _TRISTATE = {
                                lambda v: "1" if v else "0"),
     "stall_check": ("HOROVOD_STALL_CHECK_DISABLE",
                     lambda v: "0" if v else "1"),
+    "autotune": ("HOROVOD_AUTOTUNE", lambda v: "1" if v else "0"),
+    "timeline_mark_cycles": ("HOROVOD_TIMELINE_MARK_CYCLES",
+                             lambda v: "1" if v else "0"),
+    "log_hide_timestamp": ("HOROVOD_LOG_HIDE_TIME",
+                           lambda v: "1" if v else "0"),
 }
 
 
@@ -92,14 +94,20 @@ def parse_config_file(path: str) -> Dict[str, object]:
             elif ku in src:
                 out[ku] = bool(src[ku])
     at = data.get("autotune") or {}
-    if at.get("enabled"):
-        out["autotune"] = True
+    if "enabled" in at:
+        out["autotune"] = bool(at["enabled"])
     if "log-file" in at:
         out["autotune_log"] = at["log-file"]
     for k in ("warmup-samples", "steps-per-sample", "bayes-opt-max-samples",
               "gaussian-process-noise"):
         if k in at:
             out["autotune_" + k.replace("-", "_")] = at[k]
+    # ``logging:`` section (`config_parser.py:103-107` there)
+    lg = data.get("logging") or {}
+    if "level" in lg:
+        out["log_level"] = lg["level"]
+    if "hide-timestamp" in lg:
+        out["log_hide_timestamp"] = bool(lg["hide-timestamp"])
     # ``stall-check:`` section (`config_parser.py:86-92` there)
     sc = data.get("stall-check") or data.get("stall_check") or {}
     if "enabled" in sc:

@@ -40,14 +40,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-H", "--hosts", default=None,
                    help='host:slots list, e.g. "h1:4,h2:4" (default: '
                         "localhost:np)")
-    p.add_argument("--hostfile", default=None,
+    p.add_argument("-hostfile", "--hostfile", dest="hostfile", default=None,
                    help="file with one 'host slots=N' per line")
-    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("-p", "--ssh-port", dest="ssh_port", type=int, default=22)
     p.add_argument("--no-ssh-check", action="store_true",
                    help="skip the ssh reachability pre-flight")
     p.add_argument("--no-nic-discovery", action="store_true",
                    help="skip driver/task NIC discovery; guess one address")
-    p.add_argument("--nics", default=None,
+    p.add_argument("--nics", "--network-interface", dest="nics", default=None,
                    help="comma-separated interface allowlist (skips "
                         "discovery), e.g. eth0,eth1")
     p.add_argument("--disable-cache", action="store_true",
@@ -61,8 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
     p.add_argument("--timeline-filename", default=None)
-    p.add_argument("--timeline-mark-cycles", action="store_true")
-    p.add_argument("--autotune", action="store_true")
+    tmc = p.add_mutually_exclusive_group()
+    tmc.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
+                     action="store_true", default=None)
+    tmc.add_argument("--no-timeline-mark-cycles", dest="timeline_mark_cycles",
+                     action="store_false")
+    at = p.add_mutually_exclusive_group()
+    at.add_argument("--autotune", dest="autotune", action="store_true",
+                    default=None)
+    at.add_argument("--no-autotune", dest="autotune", action="store_false")
     p.add_argument("--autotune-log", "--autotune-log-file",
                    dest="autotune_log", default=None)
     # the four GP-tuner cadence knobs (run.py:502-521, parameter_manager.cc)
@@ -97,7 +104,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-shutdown-time",
                    "--stall-check-shutdown-time-seconds",
                    dest="stall_shutdown_time", type=float, default=None)
-    p.add_argument("--log-level", default=None)
+    p.add_argument("--log-level", default=None, type=str.upper,
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"],
+                   help="worker HOROVOD_LOG_LEVEL (reference level names)")
+    lht = p.add_mutually_exclusive_group()
+    lht.add_argument("--log-hide-timestamp", dest="log_hide_timestamp",
+                     action="store_true", default=None)
+    lht.add_argument("--no-log-hide-timestamp", dest="log_hide_timestamp",
+                     action="store_false")
     p.add_argument("--config-file", default=None, help="YAML config file")
     p.add_argument("-cb", "--check-build", action="store_true",
                    help="print available frameworks/controllers/ops and exit")

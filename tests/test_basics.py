@@ -79,3 +79,28 @@ def test_is_homogeneous_follows_launcher_fact(monkeypatch):
     assert hvd.is_homogeneous() is False
     monkeypatch.setenv("HVD_UNIFORM_LOCAL_SIZE", "4")
     assert hvd.is_homogeneous() is True
+
+
+def test_log_level_env(monkeypatch):
+    """HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME reach the framework logger
+    (reference `common/logging.{h,cc}`; launcher --log-level export was a
+    silent no-op before round 4)."""
+    import logging as _logging
+
+    from horovod_tpu import basics
+
+    lg = _logging.getLogger("horovod_tpu")
+    old_level, old_handlers = lg.level, list(lg.handlers)
+    try:
+        monkeypatch.setenv("HOROVOD_LOG_LEVEL", "ERROR")
+        basics._setup_logging()
+        assert lg.level == _logging.ERROR
+        monkeypatch.setenv("HOROVOD_LOG_LEVEL", "TRACE")  # maps to DEBUG
+        basics._setup_logging()
+        assert lg.level == _logging.DEBUG
+        monkeypatch.setenv("HOROVOD_LOG_LEVEL", "bogus")  # ignored
+        basics._setup_logging()
+        assert lg.level == _logging.DEBUG
+    finally:
+        lg.setLevel(old_level)
+        lg.handlers[:] = old_handlers

@@ -489,6 +489,74 @@ def test_mp_coordinated_autotune():
             f"{untuned:.1f} ops/s")
 
 
+def _worker_autotune_knob_cadence():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import basics
+    from horovod_tpu.ops import collective_ops as C
+
+    r = hvd.rank()
+    eng = basics._engine()
+    ctrl = eng.controller
+
+    data = [np.full((65536,), float(r + i), np.float32) for i in range(4)]
+
+    def drive_round():
+        hs = [C.allreduce_async(d, name=f"akc_{i}", op=hvd.Sum)
+              for i, d in enumerate(data)]
+        for h in hs:
+            C.synchronize(h)
+
+    drive_round()  # first execution pays compile and is not scored
+    thresholds = []
+    for _ in range(14):
+        drive_round()
+        thresholds.append(ctrl.fusion_threshold())
+    # rank 0 owns the coordinator-side GP; report whether it settled
+    state = getattr(ctrl, "_state", None)
+    settled = (state.tuner is not None and not state.tuner.active()) \
+        if (state is not None and r == 0) else None
+    return (r, thresholds, settled)
+
+
+@pytest.mark.integration
+def test_mp_autotune_subknob_cadence():
+    """VERDICT r3 #2 'done' criterion: the warmup-samples and
+    steps-per-sample knobs observably change coordinated tuner cadence
+    across 2 real processes. With steps-per-sample=1, warmup-samples=1 and
+    bayes-opt-max-samples=4 the rank-0 GP retunes within the first few
+    scored rounds (default cadence would not move until round 10) and
+    settles — threshold frozen, tuner inactive — before the run ends."""
+    from horovod_tpu.run.api import run
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.pathsep.join([os.path.dirname(here), here]),
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "1",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "4",
+    }
+    res = run(_worker_autotune_knob_cadence, np=2, env=env,
+              start_timeout=240)
+    by_rank = {r: rest for r, *rest in res}
+    for r, (thresholds, settled) in by_rank.items():
+        start = 64 * 1024 * 1024
+        changed_at = next((i for i, t in enumerate(thresholds)
+                           if t != start), None)
+        assert changed_at is not None and changed_at < 9, (
+            f"rank {r}: first retune at round {changed_at} — the "
+            f"steps-per-sample=1 cadence never took (default is 10)")
+        # settled: the last rounds ride one frozen threshold
+        assert len(set(thresholds[-3:])) == 1, thresholds
+    assert by_rank[0][1] is True, "max-samples=4 never settled the rank-0 GP"
+    assert by_rank[0][0] == by_rank[1][0], "ranks saw different cadences"
+
+
 def _worker_observability():
     import logging
     import time as _time

@@ -322,3 +322,47 @@ def test_config_yaml_round4_sections(tmp_path):
         ["-np", "2", "--stall-check", "--", "python", "x.py"])
     env = config_parser.env_from_config(str(cfg), args)
     assert env["HOROVOD_STALL_CHECK_DISABLE"] == "0"
+
+
+def test_flag_audit_aliases_and_log_flags():
+    """Alias and negative-pair parity from the audit
+    (`docs/design.md` launcher flag audit): -p, -hostfile,
+    --network-interface, --no-autotune, --no-timeline-mark-cycles,
+    --[no-]log-hide-timestamp, reference stall flag spellings."""
+    args = build_parser().parse_args(
+        ["-np", "2", "-p", "2222", "-hostfile", "/tmp/hf",
+         "--network-interface", "eth0,eth1",
+         "--no-autotune", "--no-timeline-mark-cycles",
+         "--log-hide-timestamp",
+         "--stall-check-warning-time-seconds", "45",
+         "--stall-check-shutdown-time-seconds", "120",
+         "--", "python", "x.py"])
+    assert args.ssh_port == 2222
+    assert args.hostfile == "/tmp/hf"
+    assert args.nics == "eth0,eth1"
+    env = config_parser.env_from_config(None, args)
+    assert env["HOROVOD_AUTOTUNE"] == "0"
+    assert env["HOROVOD_TIMELINE_MARK_CYCLES"] == "0"
+    assert env["HOROVOD_LOG_HIDE_TIME"] == "1"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "45.0"
+    assert env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] == "120.0"
+    # unset tri-states stay absent
+    args2 = build_parser().parse_args(["-np", "2", "--", "python", "x.py"])
+    env2 = config_parser.env_from_config(None, args2)
+    for var in ("HOROVOD_AUTOTUNE", "HOROVOD_TIMELINE_MARK_CYCLES",
+                "HOROVOD_LOG_HIDE_TIME"):
+        assert var not in env2, var
+
+
+def test_config_yaml_logging_section(tmp_path):
+    import textwrap as tw
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(tw.dedent("""
+        logging:
+            level: DEBUG
+            hide-timestamp: true
+    """))
+    env = config_parser.env_from_config(str(cfg))
+    assert env["HOROVOD_LOG_LEVEL"] == "DEBUG"
+    assert env["HOROVOD_LOG_HIDE_TIME"] == "1"
