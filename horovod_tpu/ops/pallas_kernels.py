@@ -103,6 +103,13 @@ def vma_active(*arrays) -> bool:
     return any(getattr(jax.typeof(x), "vma", frozenset()) for x in arrays)
 
 
+def _env_block(name: str) -> Optional[int]:
+    """Tile-edge env override, clamped to >= 8 (below that the power-of-2
+    divide-search in _pick_block could never terminate on a divisor)."""
+    v = os.environ.get(name)
+    return max(8, int(v)) if v else None
+
+
 def _pick_block(t: int, preferred: int = None,
                 side: Optional[str] = None) -> Optional[int]:
     """Largest power-of-2 tile ≤ preferred dividing t (None if none ≥ 8).
@@ -119,15 +126,11 @@ def _pick_block(t: int, preferred: int = None,
     independently for tuning."""
     if preferred is None:
         if side is not None:
-            v = os.environ.get(f"HVD_PALLAS_BLOCK_{side.upper()}")
-            if v:
-                preferred = int(v)
+            preferred = _env_block(f"HVD_PALLAS_BLOCK_{side.upper()}")
         if preferred is None:
-            v = os.environ.get("HVD_PALLAS_BLOCK")
-            if v:
-                preferred = int(v)
-            else:
-                preferred = 1024 if side == "k" else 512
+            preferred = _env_block("HVD_PALLAS_BLOCK")
+        if preferred is None:
+            preferred = 1024 if side == "k" else 512
     b = preferred
     while b >= 8:
         if t % b == 0:
@@ -412,6 +415,13 @@ _KV_VMEM_CAP = 2 ** 20
 # scoped-VMEM limit, 512 KB (seq 4096) exceeds it by 1.45 MB — the old
 # 512 KB cap dated from the 128-edge-tile era.
 _BWD_RESIDENT_CAP = 256 * 2 ** 10
+# dq-scratch budget for the ONE-pass fused backward: the whole [TQ, D] f32
+# dq accumulator lives in VMEM beside the f32 score/p/dp tiles (~2 MB each
+# at Q512/K1024) and the streamed operand tiles. 4 MB covers seq 16384 at
+# d=64 (or 8192 at d=128); longer falls back to the legacy two-pass
+# streaming layout.
+_DQ_SCRATCH_CAP = int(os.environ.get("HVD_PALLAS_DQ_SCRATCH_CAP",
+                                     4 * 2 ** 20))
 # Per-grid-cell VMEM budget for bh-blocking (G): half the 16 MB scoped
 # limit, leaving the rest for Mosaic's double buffering. With the per-g
 # footprint estimates at the call sites (2.6 MB per slice at the
@@ -434,7 +444,10 @@ def step_supported(q, k) -> bool:
     # no length cap: k/v beyond _KV_VMEM_CAP take the streaming forward
     if vma_active(q, k):
         return False
-    return (_pick_block(tq) is not None and _pick_block(tk) is not None)
+    # probe with the SAME side= the call sites use, so per-side env
+    # overrides (HVD_PALLAS_BLOCK_Q/K) cannot pass here and fail there
+    return (_pick_block(tq, side="q") is not None
+            and _pick_block(tk, side="k") is not None)
 
 
 def flash_attention_step(q, k, v, m, l, o, q_off, k_off, *,
@@ -660,6 +673,117 @@ def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
                                      preferred_element_type=jnp.float32)
 
 
+def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
+                            do_ref, dq_ref, dk_ref, dv_ref, dq_acc, *,
+                            causal, scale):
+    """ONE-pass FlashAttention-2 backward: grid (bh, k tiles, q tiles) with
+    q innermost; each cell recomputes p ONCE and emits all three gradient
+    contributions. The legacy pair of kernels (dq pass + dkv pass) each
+    streamed the operands and rebuilt p/dp separately — twice the operand
+    DMA and 7 matmuls per (q, k) tile pair; this kernel does 5.
+
+    dk/dv accumulate in their revisited output tiles (q innermost, so the
+    visits are consecutive). dq accumulates in a whole-[TQ, D] f32 VMEM
+    scratch that persists across the bh-slice's grid cells (zeroed at the
+    slice's first cell); the current q tile of the scratch is flushed
+    through the dq output block every visit — tile i's bytes are final
+    from its last live k sweep onward, and later sweeps rewrite the same
+    final bytes (last-write-wins), so the output is correct for causal
+    and non-causal alike at the cost of nk-1 redundant tile writes."""
+    jk, iq = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+    in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1] + jk * bk
+
+    @pl.when(jnp.logical_and(jk == 0, iq == 0))
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    live = (q_off + bq - 1 >= k_off) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]                                  # [BQ, D]
+        do = do_ref[0]
+        lse = lse_ref[0] * _LOG2E                     # [BQ, 1] f32, base-2
+        dd = dd_ref[0]
+        k = k_ref[0]                                  # [BK, D]
+        v = v_ref[0]
+        s = (scale * _LOG2E) * lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp2(s - lse)                         # exp2(-inf) == 0
+        dv_ref[0] += lax.dot_general(p.astype(in_dt), do,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - dd) * scale).astype(in_dt)
+        dk_ref[0] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dq_acc[pl.ds(iq * bq, bq), :] += lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq_ref[0] = dq_acc[pl.ds(iq * bq, bq), :]
+
+
+def _flash_bwd_fused(qt, kt, vt, dot, lset, ddt, offs, d, *, causal, scale,
+                     block_q, block_k, interpret):
+    """Dispatch of the one-pass backward (any length: k/v tiles stream
+    through the grid, dq rides the VMEM scratch)."""
+    bh, tq = qt.shape[0], qt.shape[1]
+    tk = kt.shape[1]
+    _, qmap = _causal_maps(causal, block_q, block_k, tq // block_q)
+    ktile = pl.BlockSpec((1, block_k, d), lambda i, j, n, offs: (i, j, 0))
+
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, causal=causal,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # q innermost: dk/dv revisits are consecutive; j sweeps
+            # accumulate dq in the persistent scratch
+            grid=(bh, tk // block_k, tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, 1), qmap),
+                pl.BlockSpec((1, block_q, 1), qmap),
+                pl.BlockSpec((1, block_q, d), qmap),
+                ktile, ktile,
+                pl.BlockSpec((1, block_q, d), qmap),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j, n, offs: (i, n, 0)),
+                ktile, ktile,
+            ],
+            scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
+        ),
+        out_shape=[
+            _struct((bh, tq, d), jnp.float32, qt, kt, offs),
+            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
+            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=10 * bh * tq * tk * d,  # 5 matmuls per tile pair
+            bytes_accessed=4 * bh * (4 * tq * d + 4 * tk * d),
+            transcendentals=bh * tq * tk),
+        # j and the innermost q dim both accumulate into revisited state
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(offs, lset, ddt, qt, kt, vt, dot)
+
+
 def _flash_bwd_resident(qt, kt, vt, dot, lset, ddt, offs, d, *,
                         causal, scale, block_q, block_k, interpret):
     """Whole-resident backward dispatch: dq pass keeps full k/v in VMEM,
@@ -766,14 +890,32 @@ def _flash_bwd_hm(qt, kt, vt, dot, lset, ddt, q_off=0, k_off=0, *,
     way) pays no relayout. Returns (dq, dk, dv) heads-major f32."""
     bh, tq, d = qt.shape
     tk = kt.shape[1]
-    block_q = _pick_block(tq, side="q")
-    block_k = _pick_block(tk, side="k")
+    # backward tiles follow the forward defaults unless overridden
+    # independently (HVD_PALLAS_BLOCK_BWD_Q/K) — the fused one-pass kernel
+    # has a different VMEM profile (dq scratch + 3 outputs) than the
+    # forward, so its optimum can differ. Measured on the lm_bench step:
+    # BWD_K=512 neutral, BWD_Q=1024 +0.5% (noise) — defaults kept.
+    block_q = _pick_block(tq, preferred=_env_block("HVD_PALLAS_BLOCK_BWD_Q"),
+                          side="q")
+    block_k = _pick_block(tk, preferred=_env_block("HVD_PALLAS_BLOCK_BWD_K"),
+                          side="k")
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     interpret = _interpret()
 
-    # Two kernel layouts: whole-resident (one side of the score matrix
-    # stays in VMEM; ~20% faster at short T — no tile re-fetch) and
+    # Preferred layout: the ONE-pass fused kernel (dq+dk+dv from a single
+    # streaming of the operands, 5 matmuls per tile pair instead of the
+    # legacy passes' 7). Its dq scratch must fit VMEM alongside the score
+    # tiles; beyond the cap — or with HVD_PALLAS_FUSED_BWD=0 for A/B — the
+    # legacy two-pass layouts below take over.
+    if (os.environ.get("HVD_PALLAS_FUSED_BWD", "1") not in ("0", "false")
+            and tq * d * 4 <= _DQ_SCRATCH_CAP):
+        return _flash_bwd_fused(
+            qt, kt, vt, dot, lset, ddt, offs, d, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+
+    # Two legacy kernel layouts: whole-resident (one side of the score
+    # matrix stays in VMEM; ~20% faster at short T — no tile re-fetch) and
     # streaming 3D-grid (every operand tiled through the grid; the only
     # option once a full k/v or q/do side exceeds the VMEM budget).
     if (tk * d * kt.dtype.itemsize <= _BWD_RESIDENT_CAP

@@ -134,13 +134,23 @@ class FusedAdamW(NamedTuple):
     apply: Any
 
 
-def fused_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+def fused_adamw(learning_rate, b1: float = 0.9, b2: float = 0.999,
                 eps: float = 1e-8, weight_decay: float = 0.0,
                 mu_dtype=None) -> FusedAdamW:
     """AdamW with the per-leaf update in one fused Pallas pass.
 
     Decoupled weight decay applies to every leaf (pass 0.0 to disable),
     matching ``optax.adamw``'s default ``mask=None``.
+
+    ``learning_rate`` may be a static float or an optax-style schedule
+    (a callable of the step count, evaluated against ``state.count``
+    inside ``apply``).
+
+    Known numerics deviation from ``optax.adamw``: the second moment ``nu``
+    is always stored in f32, where optax keeps it in the param dtype (e.g.
+    bf16 for bf16 params). bf16 nu loses ~5 bits of mantissa on an
+    accumulating statistic, so the f32 choice is deliberately the safer
+    numerics; expect bit differences vs optax on sub-f32 params.
     """
 
     def init(params):
@@ -153,8 +163,11 @@ def fused_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
     def apply(grads, state, params):
         count = state.count + 1
         t = count.astype(jnp.float32)
+        # optax schedules are indexed by the PRE-increment step count
+        lr = learning_rate(state.count) if callable(learning_rate) \
+            else learning_rate
         sc = jnp.stack([
-            jnp.float32(learning_rate),
+            jnp.asarray(lr, jnp.float32),
             1.0 / (1.0 - jnp.float32(b1) ** t),
             1.0 / (1.0 - jnp.float32(b2) ** t),
         ])

@@ -248,12 +248,52 @@ def test_flash_streaming_forward_variant(causal, monkeypatch):
 
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_bwd_streaming_variant(causal, monkeypatch):
-    """Force the 3D-grid streaming backward (long-sequence layout) by
-    shrinking the VMEM budget: grads must match the resident variant's
-    reference."""
+    """Force the LEGACY 3D-grid streaming backward (the fallback once the
+    fused kernel's dq scratch exceeds VMEM) by disabling the fused path and
+    shrinking the resident budget: grads must match the reference."""
+    monkeypatch.setenv("HVD_PALLAS_FUSED_BWD", "0")
     monkeypatch.setattr(pk, "_BWD_RESIDENT_CAP", 1)  # force streaming
     q, k, v = _rand_qkv(jax.random.PRNGKey(11), 1, 256, 2, 64)
     w = jax.random.normal(jax.random.PRNGKey(12), q.shape, q.dtype)
+
+    g_pk = jax.grad(
+        lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_legacy_resident_variant(causal, monkeypatch):
+    """The legacy whole-resident backward pair (HVD_PALLAS_FUSED_BWD=0,
+    short sequences) keeps its own coverage — production still takes it
+    when the fused kernel's dq scratch would exceed the VMEM cap."""
+    monkeypatch.setenv("HVD_PALLAS_FUSED_BWD", "0")
+    q, k, v = _rand_qkv(jax.random.PRNGKey(21), 1, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(22), q.shape, q.dtype)
+
+    g_pk = jax.grad(
+        lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_fused_scratch_cap_fallback(causal, monkeypatch):
+    """A dq scratch over HVD_PALLAS_DQ_SCRATCH_CAP falls back to the legacy
+    layouts and still produces reference gradients (the seq > 16384 path)."""
+    monkeypatch.setattr(pk, "_DQ_SCRATCH_CAP", 1)
+    q, k, v = _rand_qkv(jax.random.PRNGKey(23), 1, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(24), q.shape, q.dtype)
 
     g_pk = jax.grad(
         lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, causal=causal)
@@ -492,3 +532,27 @@ def test_bh_block_pick_divisibility_and_cap(monkeypatch):
     per_g = 2 * 1024 * 64 * 2 + 512 * 1024 * 4 + 3 * 512 * 64 * 4
     monkeypatch.setenv("HVD_PALLAS_BLOCK_BH", "4")
     assert pk._pick_bh_block(128, per_g, pk._BH_VMEM_CAP) == 2
+
+
+def test_fused_adamw_schedule(monkeypatch):
+    """ADVICE r3: learning_rate may be an optax-style schedule — evaluated
+    against state.count inside apply, numerics matching optax.adamw with
+    the same schedule."""
+    import optax
+    from horovod_tpu.optim import fused_adamw
+
+    monkeypatch.setattr("horovod_tpu.optim.fused._MIN_FUSED", 1)
+    sched = optax.linear_schedule(1e-2, 1e-3, transition_steps=3)
+    params = {"w": jnp.ones((64, 128), jnp.float32)}
+    kw = dict(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    ours = fused_adamw(sched, **kw)
+    ref = optax.adamw(sched, **kw)
+    state, rstate, rparams = ours.init(params), ref.init(params), params
+    for i in range(4):
+        grads = {"w": jnp.full((64, 128), 0.1 * (i + 1), jnp.float32)}
+        params, state = ours.apply(grads, state, params)
+        upd, rstate = ref.update(grads, rstate, rparams)
+        rparams = optax.apply_updates(rparams, upd)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               np.asarray(rparams["w"]),
+                               rtol=2e-5, atol=2e-5)
