@@ -91,7 +91,8 @@ void hvd_core_destroy(int64_t eng) {
 int64_t hvd_core_submit(int64_t eng, const char* name, int32_t rank,
                         int32_t req_type, int32_t dtype, int32_t ndim,
                         const int64_t* dims, int32_t root_rank,
-                        int32_t average, double prescale, double postscale) {
+                        int32_t average, double prescale, double postscale,
+                        const int64_t* splits, int32_t nsplits) {
   EngineCore* c = Get(eng);
   if (!c) return -3;
   PendingEntry e;
@@ -104,6 +105,7 @@ int64_t hvd_core_submit(int64_t eng, const char* name, int32_t rank,
   e.average = average != 0;
   e.prescale = prescale;
   e.postscale = postscale;
+  if (nsplits > 0 && splits) e.splits.assign(splits, splits + nsplits);
   e.enqueue_us = NowUs();
   int64_t h = c->controller->Submit(e);
   if (h >= 0) c->timeline->NegotiateStart(e.name, rank, e.enqueue_us);

@@ -61,6 +61,8 @@ struct PendingEntry {
   bool average = false;
   double prescale = 1.0;
   double postscale = 1.0;
+  // ragged alltoall: rows of dim 0 sent to each peer (empty = equal split)
+  std::vector<int64_t> splits;
   int64_t handle = -1;
   int64_t enqueue_us = 0;  // monotonic microseconds at submit
 

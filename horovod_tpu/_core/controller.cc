@@ -93,9 +93,12 @@ std::string Controller::Validate(const std::string& name,
       return err.str();
     }
   }
+  bool a2a_ragged =
+      e0->type == RequestType::ALLTOALL && !e0->splits.empty();
   bool shapes_equal_required =
       e0->type == RequestType::ALLREDUCE || e0->type == RequestType::ADASUM ||
-      e0->type == RequestType::BROADCAST || e0->type == RequestType::ALLTOALL;
+      e0->type == RequestType::BROADCAST ||
+      (e0->type == RequestType::ALLTOALL && !a2a_ragged);
   if (shapes_equal_required) {
     for (auto& kv : st.by_rank) {
       if (kv.second.shape != e0->shape) {
@@ -132,11 +135,63 @@ std::string Controller::Validate(const std::string& name,
     }
   }
   if (e0->type == RequestType::ALLTOALL) {
-    int64_t d0 = e0->shape.empty() ? 0 : e0->shape[0];
-    if (e0->shape.empty() || d0 % opts_.world != 0) {
-      err << "Alltoall tensor '" << name << "' first dimension (" << d0
-          << ") must be divisible by world size " << opts_.world << ".";
-      return err.str();
+    // ragged (alltoallv) vs equal-split must agree across ranks
+    for (auto& kv : st.by_rank) {
+      if (kv.second.splits.empty() == a2a_ragged) {
+        err << "Mismatched alltoall splits usage for tensor '" << name
+            << "': rank " << e0->rank << (a2a_ragged ? " passed" : " omitted")
+            << " splits, rank " << kv.first << " did not match.";
+        return err.str();
+      }
+    }
+    if (a2a_ragged) {
+      if (opts_.local_only && opts_.world > 1) {
+        // peer splits live on other processes; needs the coordinated plane
+        return "Ragged alltoall is not supported in multiprocess mode "
+               "without the cross-process control plane (launch via hvdrun "
+               "so ranks share a coordinator address channel).";
+      }
+      for (auto& kv : st.by_rank) {
+        const auto& e = kv.second;
+        if (e.shape.empty())
+          return "Alltoall of scalar tensor '" + name +
+                 "' is not supported.";
+        if (static_cast<int32_t>(e.splits.size()) != opts_.world) {
+          err << "Alltoall splits for tensor '" << name << "' on rank "
+              << kv.first << " has " << e.splits.size()
+              << " entries; expected world size " << opts_.world << ".";
+          return err.str();
+        }
+        int64_t sum = 0;
+        for (int64_t s : e.splits) {
+          if (s < 0) {
+            err << "Alltoall splits for tensor '" << name << "' on rank "
+                << kv.first << " contains a negative entry.";
+            return err.str();
+          }
+          sum += s;
+        }
+        if (sum != e.shape[0]) {
+          err << "Alltoall splits for tensor '" << name << "' on rank "
+              << kv.first << " sum to " << sum << " but dim 0 is "
+              << e.shape[0] << ".";
+          return err.str();
+        }
+        if (e.shape.size() != e0->shape.size() ||
+            !std::equal(e.shape.begin() + 1, e.shape.end(),
+                        e0->shape.begin() + 1)) {
+          err << "Mismatched alltoall tensor shapes beyond first dimension "
+                 "for '" << name << "'";
+          return err.str();
+        }
+      }
+    } else {
+      int64_t d0 = e0->shape.empty() ? 0 : e0->shape[0];
+      if (e0->shape.empty() || d0 % opts_.world != 0) {
+        err << "Alltoall tensor '" << name << "' first dimension (" << d0
+            << ") must be divisible by world size " << opts_.world << ".";
+        return err.str();
+      }
     }
   }
   if (e0->type == RequestType::BROADCAST) {

@@ -46,7 +46,7 @@ def _commit(tensor, rank: int):
 
 def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
              average=False, prescale=1.0, postscale=1.0,
-             callback=None) -> int:
+             callback=None, splits=None) -> int:
     eng = basics._engine()
     r = basics.rank()
     entry = TensorTableEntry(
@@ -59,6 +59,7 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
         prescale_factor=prescale,
         postscale_factor=postscale,
         callback=callback,
+        splits=splits,
     )
     return eng.enqueue(entry)
 
@@ -127,15 +128,37 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
 
 
 # ------------------------------------------------------------------ alltoall
-def alltoall_async(tensor, name: Optional[str] = None) -> int:
-    """Equal-split alltoall (north-star op set extension): dim 0 must be
-    divisible by world size; rank r receives segment r from every rank."""
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    """Alltoall (north-star op set extension; API shape of later-horovod
+    ``alltoall(tensor, splits)``).
+
+    Without ``splits``: equal split — dim 0 must be divisible by world
+    size; rank r receives segment r from every rank. With ``splits`` (a
+    length-world sequence of non-negative ints summing to dim 0):
+    alltoallv — rank r receives ``splits[r]`` rows from this rank; the
+    output concatenates the received chunks in source-rank order. Per-rank
+    split metadata is negotiated through the control plane the way ragged
+    allgather negotiates dim 0."""
     name = _auto_name("alltoall", name)
-    return _enqueue(RequestType.ALLTOALL, tensor, name)
+    if splits is not None:
+        splits = tuple(int(s) for s in splits)
+        world = basics.size()
+        if len(splits) != world:
+            raise ValueError(
+                f"alltoall splits must have one entry per rank "
+                f"({world}); got {len(splits)}")
+        if any(s < 0 for s in splits):
+            raise ValueError("alltoall splits must be non-negative")
+        d0 = jnp.shape(tensor)[0] if jnp.ndim(tensor) else 0
+        if sum(splits) != d0:
+            raise ValueError(
+                f"alltoall splits sum to {sum(splits)} but tensor dim 0 "
+                f"is {d0}")
+    return _enqueue(RequestType.ALLTOALL, tensor, name, splits=splits)
 
 
-def alltoall(tensor, name: Optional[str] = None):
-    return synchronize(alltoall_async(tensor, name=name))
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    return synchronize(alltoall_async(tensor, splits=splits, name=name))
 
 
 # ------------------------------------------------------------- join / handles

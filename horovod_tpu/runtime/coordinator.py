@@ -335,6 +335,18 @@ class CoordState:
                     resp.tensor_sizes.append(
                         [int(pk.metas[r].shape[0]) if r in pk.metas else 0
                          for r in range(self.world)])
+                elif (int(m0.rtype) == int(RequestType.ALLTOALL)
+                        and mk0.splits is not None):
+                    # ragged alltoall: the full world x world send matrix,
+                    # row-major by source rank — the executor's alltoallv
+                    # displacement table, the role Response::tensor_sizes
+                    # plays for ragged allgather. Every rank is present:
+                    # alltoall+join is rejected in _validate, so a ready
+                    # ragged alltoall has a meta from all of them.
+                    mat: List[int] = []
+                    for r in range(self.world):
+                        mat.extend(int(s) for s in pk.metas[r].splits)
+                    resp.tensor_sizes.append(mat)
                 cids.append(self._assign_cache_id(kname, pk.metas))
             responses.append(resp)
             assignments.append(cids)
@@ -399,8 +411,11 @@ class CoordState:
                 return ("Mismatched reduction op/scale factors for tensor "
                         f"'{name}' between ranks {r0} and {r}.")
         rt = int(m0.rtype)
+        a2a_ragged = (rt == int(RequestType.ALLTOALL)
+                      and m0.splits is not None)
         if rt in (int(RequestType.ALLREDUCE), int(RequestType.ADASUM),
-                  int(RequestType.BROADCAST), int(RequestType.ALLTOALL)):
+                  int(RequestType.BROADCAST)) or (
+                rt == int(RequestType.ALLTOALL) and not a2a_ragged):
             for r, m in items[1:]:
                 if m.shape != m0.shape:
                     return (f"Mismatched tensor shapes for '{name}': rank "
@@ -419,10 +434,39 @@ class CoordState:
             return (f"Adasum requires a power-of-2 number of ranks; got "
                     f"{self.world}.")
         if rt == int(RequestType.ALLTOALL):
-            d0 = m0.shape[0] if m0.shape else 0
-            if not m0.shape or d0 % self.world != 0:
-                return (f"Alltoall tensor '{name}' first dimension ({d0}) "
-                        f"must be divisible by world size {self.world}.")
+            for r, m in items:
+                if (m.splits is None) != (m0.splits is None):
+                    return (f"Mismatched alltoall splits usage for tensor "
+                            f"'{name}': rank {r0} "
+                            f"{'passed' if a2a_ragged else 'omitted'} "
+                            f"splits, rank {r} did not match.")
+            if a2a_ragged:
+                for r, m in items:
+                    if not m.shape:
+                        return (f"Alltoall of scalar tensor '{name}' is "
+                                "not supported.")
+                    if len(m.splits) != self.world:
+                        return (f"Alltoall splits for tensor '{name}' on "
+                                f"rank {r} has {len(m.splits)} entries; "
+                                f"expected world size {self.world}.")
+                    if any(s < 0 for s in m.splits):
+                        return (f"Alltoall splits for tensor '{name}' on "
+                                f"rank {r} contains a negative entry.")
+                    if sum(m.splits) != m.shape[0]:
+                        return (f"Alltoall splits for tensor '{name}' on "
+                                f"rank {r} sum to {sum(m.splits)} but dim 0 "
+                                f"is {m.shape[0]}.")
+                    if m.shape[1:] != m0.shape[1:]:
+                        return ("Mismatched alltoall tensor shapes beyond "
+                                f"first dimension for '{name}': rank {r0} "
+                                f"has {tuple(m0.shape)}, rank {r} has "
+                                f"{tuple(m.shape)}.")
+            else:
+                d0 = m0.shape[0] if m0.shape else 0
+                if not m0.shape or d0 % self.world != 0:
+                    return (f"Alltoall tensor '{name}' first dimension "
+                            f"({d0}) must be divisible by world size "
+                            f"{self.world}.")
         if rt == int(RequestType.BROADCAST):
             for r, m in items[1:]:
                 if m.root_rank != m0.root_rank:
@@ -702,7 +746,8 @@ class CoordController:
             meta = ReqMeta(entry.tensor_name, int(entry.request_type),
                            str(entry.array.dtype), tuple(entry.array.shape),
                            entry.root_rank, entry.average,
-                           entry.prescale_factor, entry.postscale_factor)
+                           entry.prescale_factor, entry.postscale_factor,
+                           splits=entry.splits)
             cid = self._sig_cache.get(meta.sig(), -1)
             if cid >= 0:
                 self._hits += 1

@@ -556,6 +556,8 @@ class Executor:
         world = self._world
         ranks = sorted(entries_by_rank)
         template = entries_by_rank[ranks[0]]
+        if response.tensor_sizes or template[0].splits is not None:
+            return self._exec_alltoallv(response, entries_by_rank)
         shapes = [tuple(e.array.shape) for e in template]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         dtype = _np_dtype(template[0].array)
@@ -569,3 +571,97 @@ class Executor:
         out = self._alltoall_fn(world, length, dtype)(g)
         rows = self._shard_by_rank(out)
         return {r: self._unpack_row(rows[r], shapes, sizes) for r in ranks}
+
+    # ------------------------------------------------------ ragged alltoall
+    def _a2av_pack_fn(self, splits, elem: int, maxc: int, dtype: str):
+        """Per-source spread: flat input -> [world * maxc * elem] with each
+        destination's chunk padded to ``maxc`` rows at its slot (the send
+        side of the alltoallv displacement table)."""
+        key = ("a2av_pack", splits, elem, maxc, dtype)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            import jax.numpy as jnp
+
+            offs = [sum(splits[:d]) for d in range(len(splits))]
+
+            def kernel(flat):
+                parts = []
+                for d, s in enumerate(splits):
+                    seg = flat[offs[d] * elem:(offs[d] + s) * elem]
+                    if s < maxc:
+                        seg = jnp.pad(seg, (0, (maxc - s) * elem))
+                    parts.append(seg)
+                return jnp.concatenate(parts)
+
+            fn = jax.jit(kernel)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _a2av_unpack_fn(self, counts, tail, maxc: int, elem: int,
+                        dtype: str):
+        """Receive side: one rank's transposed row [world * maxc * elem] ->
+        [sum(counts), *tail] by slicing each source's live rows."""
+        key = ("a2av_unpack", counts, tail, maxc, elem, dtype)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            import jax.numpy as jnp
+
+            d0 = int(sum(counts))
+
+            def kernel(row):
+                segs = [row[src * maxc * elem:
+                            (src * maxc + counts[src]) * elem]
+                        for src in range(len(counts))]
+                cat = jnp.concatenate(segs) if len(segs) > 1 else segs[0]
+                return cat.reshape((d0,) + tuple(tail))
+
+            fn = jax.jit(kernel)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _exec_alltoallv(self, response, entries_by_rank):
+        """Ragged alltoall (`alltoall(tensor, splits)`): the padded-chunk
+        program — every (src, dst) chunk padded to the max row count, then
+        the SAME splits-independent block transpose as the equal path, then
+        per-destination slicing. Padding keeps the compiled collective
+        reusable across splits patterns; pack/unpack recompile per pattern
+        (they are cheap elementwise programs)."""
+        world = self._world
+        ranks = sorted(entries_by_rank)
+        template = entries_by_rank[ranks[0]]
+        tail = tuple(template[0].array.shape[1:])
+        elem = int(np.prod(tail)) if tail else 1
+        dtype = _np_dtype(template[0].array)
+
+        if response.tensor_sizes:
+            # negotiated matrix (coordinated plane): row-major by source
+            flat = [int(v) for v in response.tensor_sizes[0]]
+            matrix = [flat[r * world:(r + 1) * world] for r in range(world)]
+        else:
+            # local plane: every rank's entry (and its splits) is visible
+            matrix = [list(entries_by_rank[r][0].splits) for r in ranks]
+
+        if world == 1:
+            return {ranks[0]: [e.array for e in template]}
+
+        maxc = max(1, max(max(row) for row in matrix))
+        rowlen = world * maxc * elem
+
+        bufs = []
+        for r in self._local_ranks:
+            e = entries_by_rank[r][0]
+            flat_in = self._pack([e])
+            bufs.append(self._a2av_pack_fn(tuple(matrix[r]), elem, maxc,
+                                           dtype)(flat_in))
+        g = self._global_array(bufs, rowlen)
+        out = self._alltoall_fn(world, rowlen, dtype)(g)
+        rows = self._shard_by_rank(out)
+        res = {}
+        for r in ranks:
+            counts = tuple(matrix[src][r] for src in range(world))
+            row = rows[r].reshape(-1)
+            res[r] = [self._a2av_unpack_fn(counts, tail, maxc, elem,
+                                           dtype)(row)]
+        return res

@@ -94,7 +94,8 @@ def _bind(lib) -> None:
     lib.hvd_core_submit.argtypes = [
         ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
     lib.hvd_core_join.restype = ctypes.c_int64
     lib.hvd_core_join.argtypes = [ctypes.c_int64, ctypes.c_int32]
     lib.hvd_core_tick.restype = ctypes.c_int64
@@ -245,11 +246,20 @@ class NativeController:
         shape = np.asarray(entry.array.shape, dtype=np.int64)
         dims = shape.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) \
             if shape.size else ctypes.POINTER(ctypes.c_int64)()
+        nil = ctypes.POINTER(ctypes.c_int64)()
+        if entry.splits is not None:
+            sp = np.asarray(entry.splits, dtype=np.int64)
+            spp = sp.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) \
+                if sp.size else nil
+            nsp = int(sp.size)
+        else:
+            spp, nsp = nil, 0
         return self._lib.hvd_core_submit(
             self._eng, entry.tensor_name.encode(), entry.rank,
             int(entry.request_type), dtype_code(entry.array.dtype),
             len(entry.array.shape), dims, entry.root_rank,
-            int(entry.average), entry.prescale_factor, entry.postscale_factor)
+            int(entry.average), entry.prescale_factor, entry.postscale_factor,
+            spp, nsp)
 
     def join(self, rank: int) -> int:
         return self._lib.hvd_core_join(self._eng, rank)

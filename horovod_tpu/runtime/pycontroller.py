@@ -17,7 +17,7 @@ from .messages import RequestType, Response, ResponseType, TensorTableEntry
 class _Meta:
     __slots__ = ("name", "rank", "type", "dtype", "shape", "root_rank",
                  "average", "prescale", "postscale", "handle", "enqueue_t",
-                 "nbytes")
+                 "nbytes", "splits")
 
     def __init__(self, e: TensorTableEntry, handle: int):
         self.name = e.tensor_name
@@ -32,6 +32,8 @@ class _Meta:
         self.handle = handle
         self.enqueue_t = time.monotonic()
         self.nbytes = int(e.array.size) * e.array.dtype.itemsize
+        self.splits = None if e.splits is None else tuple(int(s)
+                                                          for s in e.splits)
 
 
 class PyController:
@@ -103,8 +105,11 @@ class PyController:
         if any((m.average, m.prescale, m.postscale)
                != (e0.average, e0.prescale, e0.postscale) for m in metas):
             return f"Mismatched reduction op/scale factors for tensor '{name}'"
+        a2a_ragged = (e0.type == RequestType.ALLTOALL
+                      and e0.splits is not None)
         if e0.type in (RequestType.ALLREDUCE, RequestType.ADASUM,
-                       RequestType.BROADCAST, RequestType.ALLTOALL):
+                       RequestType.BROADCAST) or (
+                e0.type == RequestType.ALLTOALL and not a2a_ragged):
             if any(m.shape != e0.shape for m in metas):
                 return f"Mismatched tensor shapes for '{name}'"
         if e0.type == RequestType.ALLGATHER:
@@ -120,10 +125,41 @@ class PyController:
             return (f"Adasum requires a power-of-2 number of ranks; got "
                     f"{self._world}.")
         if e0.type == RequestType.ALLTOALL:
-            d0 = e0.shape[0] if e0.shape else 0
-            if not e0.shape or d0 % self._world != 0:
-                return (f"Alltoall tensor '{name}' first dimension ({d0}) "
-                        f"must be divisible by world size {self._world}.")
+            if any((m.splits is None) != (e0.splits is None) for m in metas):
+                return (f"Mismatched alltoall splits usage for tensor "
+                        f"'{name}': some ranks passed splits, others did "
+                        "not.")
+            if a2a_ragged:
+                if self._local_only and self._world > 1:
+                    return ("Ragged alltoall is not supported in "
+                            "multiprocess mode without the cross-process "
+                            "control plane (launch via hvdrun so ranks "
+                            "share a coordinator address channel).")
+                for m in metas:
+                    if not m.shape:
+                        return (f"Alltoall of scalar tensor '{name}' is "
+                                "not supported.")
+                    if len(m.splits) != self._world:
+                        return (f"Alltoall splits for tensor '{name}' on "
+                                f"rank {m.rank} has {len(m.splits)} "
+                                f"entries; expected world size "
+                                f"{self._world}.")
+                    if any(s < 0 for s in m.splits):
+                        return (f"Alltoall splits for tensor '{name}' on "
+                                f"rank {m.rank} contains a negative entry.")
+                    if sum(m.splits) != m.shape[0]:
+                        return (f"Alltoall splits for tensor '{name}' on "
+                                f"rank {m.rank} sum to {sum(m.splits)} but "
+                                f"dim 0 is {m.shape[0]}.")
+                    if m.shape[1:] != e0.shape[1:]:
+                        return ("Mismatched alltoall tensor shapes beyond "
+                                f"first dimension for '{name}'")
+            else:
+                d0 = e0.shape[0] if e0.shape else 0
+                if not e0.shape or d0 % self._world != 0:
+                    return (f"Alltoall tensor '{name}' first dimension "
+                            f"({d0}) must be divisible by world size "
+                            f"{self._world}.")
         if e0.type == RequestType.BROADCAST:
             if any(m.root_rank != e0.root_rank for m in metas):
                 return f"Mismatched root ranks for broadcast '{name}'"

@@ -145,12 +145,12 @@ class ReqMeta:
     (message.h Request)."""
 
     __slots__ = ("name", "rtype", "dtype", "shape", "root_rank", "average",
-                 "prescale", "postscale")
+                 "prescale", "postscale", "splits")
 
     def __init__(self, name: str, rtype: int, dtype: str,
                  shape: Tuple[int, ...], root_rank: int = -1,
                  average: bool = False, prescale: float = 1.0,
-                 postscale: float = 1.0):
+                 postscale: float = 1.0, splits=None):
         self.name = name
         self.rtype = rtype
         self.dtype = dtype
@@ -159,12 +159,17 @@ class ReqMeta:
         self.average = average
         self.prescale = prescale
         self.postscale = postscale
+        # ragged alltoall: rows of dim 0 this rank sends to each peer
+        # (later-horovod `alltoall(tensor, splits)`); None = equal split
+        self.splits = None if splits is None else tuple(int(s)
+                                                        for s in splits)
 
     def sig(self) -> Tuple:
         """Cache signature: everything negotiation depends on
         (`response_cache.h:45-97` keys entries the same way)."""
         return (self.name, self.rtype, self.dtype, self.shape,
-                self.root_rank, self.average, self.prescale, self.postscale)
+                self.root_rank, self.average, self.prescale, self.postscale,
+                self.splits)
 
 
 # RequestList flags
@@ -199,6 +204,13 @@ def encode_request_list(flags: int, cached_ids: List[int],
         w.u8(int(m.average))
         w.f64(m.prescale)
         w.f64(m.postscale)
+        if m.splits is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            w.u32(len(m.splits))
+            for s in m.splits:
+                w.i64(s)
     w.u8(0 if score is None else 1)
     if score is not None:
         w.i64(int(score[0]))
@@ -221,7 +233,11 @@ def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta],
         avg = rd.u8() != 0
         pre = rd.f64()
         post = rd.f64()
-        reqs.append(ReqMeta(name, rtype, dtype, shape, root, avg, pre, post))
+        splits = None
+        if rd.u8():
+            splits = tuple(rd.i64() for _ in range(rd.u32()))
+        reqs.append(ReqMeta(name, rtype, dtype, shape, root, avg, pre, post,
+                            splits=splits))
     score = None
     if rd.remaining() and rd.u8():
         score = (rd.i64(), rd.f64())

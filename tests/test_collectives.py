@@ -242,3 +242,103 @@ def test_adasum_non_power_of_2_clear_error():
         return True
 
     assert all(testing.run_cluster(fn, np=3))
+
+
+def test_alltoall_ragged_splits():
+    """VERDICT r4 #4: alltoallv — per-rank splits negotiated through the
+    control plane, checked against numpy ground truth (later-horovod
+    `alltoall(tensor, splits)` API shape)."""
+    def fn():
+        r = hvd.rank()
+        w = hvd.size()
+        splits = [r + d + 1 for d in range(w)]  # uneven, rank-dependent
+        rows = []
+        for d in range(w):
+            rows += [[100 * r + d, 200 * r + d]] * splits[d]
+        x = np.asarray(rows, np.float32)
+        out = np.asarray(hvd.alltoall(x, splits=splits, name="a2av"))
+        exp = []
+        for src in range(w):
+            exp += [[100 * src + r, 200 * src + r]] * (src + r + 1)
+        np.testing.assert_allclose(out, np.asarray(exp, np.float32))
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_alltoall_ragged_zero_rows():
+    """Zero splits are legal: a rank can send nothing to some peers."""
+    def fn():
+        r = hvd.rank()
+        w = hvd.size()
+        # only rank 0 sends, 3 rows to each peer; everyone else sends nothing
+        splits = [3] * w if r == 0 else [0] * w
+        x = (np.arange(3 * w * 2, dtype=np.float32).reshape(3 * w, 2)
+             if r == 0 else np.zeros((0, 2), np.float32))
+        out = np.asarray(hvd.alltoall(x, splits=splits, name="a2av0"))
+        exp = (np.arange(3 * w * 2, dtype=np.float32)
+               .reshape(3 * w, 2)[3 * r:3 * (r + 1)])
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out, exp)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_ragged_equal_fast_path_preserved():
+    """splits=None keeps the splits-free equal program (no negotiation of a
+    send matrix; the compiled-collective cache key is the equal-split one)."""
+    def fn():
+        r = hvd.rank()
+        x = np.concatenate([np.full((2,), r * 10 + dst, np.float32)
+                            for dst in range(2)])
+        out = np.asarray(hvd.alltoall(x, name="a2a_eq"))
+        expected = np.concatenate([np.full((2,), src * 10 + r, np.float32)
+                                   for src in range(2)])
+        np.testing.assert_allclose(out, expected)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_splits_validation_errors():
+    def fn():
+        # local validation: wrong length / negative / bad sum raise before
+        # ever reaching the engine
+        with pytest.raises(ValueError, match="one entry per rank"):
+            hvd.alltoall(np.ones((4, 2), np.float32), splits=[4],
+                         name="a2av_len")
+        with pytest.raises(ValueError, match="non-negative"):
+            hvd.alltoall(np.ones((4, 2), np.float32), splits=[5, -1],
+                         name="a2av_neg")
+        with pytest.raises(ValueError, match="sum to"):
+            hvd.alltoall(np.ones((4, 2), np.float32), splits=[1, 1],
+                         name="a2av_sum")
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_mixed_splits_usage_errors():
+    """One rank ragged, the other equal-split -> coordinator ERROR response
+    naming the mismatch (ConstructResponse error matrix parity)."""
+    def fn():
+        kw = {"splits": [2, 2]} if hvd.rank() == 0 else {}
+        with pytest.raises(hvd.HorovodInternalError, match="splits usage"):
+            hvd.alltoall(np.ones((4, 2), np.float32), name="a2av_mix", **kw)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_alltoall_ragged_tail_mismatch_errors():
+    """Ragged alltoall still validates trailing dims across ranks."""
+    def fn():
+        shape = (4, 2) if hvd.rank() == 0 else (4, 3)
+        with pytest.raises(hvd.HorovodInternalError,
+                           match="beyond first dimension"):
+            hvd.alltoall(np.ones(shape, np.float32), splits=[2, 2],
+                         name="a2av_tail")
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))

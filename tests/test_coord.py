@@ -489,6 +489,51 @@ def test_mp_coordinated_autotune():
             f"{untuned:.1f} ops/s")
 
 
+def _worker_ragged_alltoall():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    r = hvd.rank()
+    w = hvd.size()
+    # uneven, rank-dependent splits: rank r sends r+d+1 rows to rank d
+    splits = [r + d + 1 for d in range(w)]
+    rows = []
+    for d in range(w):
+        rows += [[100.0 * r + d]] * splits[d]
+    out = np.asarray(hvd.alltoall(np.asarray(rows, np.float32),
+                                  splits=splits, name="a2av_mp"))
+    exp = []
+    for src in range(w):
+        exp += [[100.0 * src + r]] * (src + r + 1)
+    np.testing.assert_allclose(out, np.asarray(exp, np.float32))
+    # mixed usage: this rank ragged, peer equal -> coordinator error
+    import pytest as _pytest
+    kw = {"splits": [1, 1]} if r == 0 else {}
+    with _pytest.raises(hvd.HorovodInternalError, match="splits usage"):
+        hvd.alltoall(np.ones((2, 1), np.float32), name="a2av_mixed", **kw)
+    return (r, True)
+
+
+@pytest.mark.integration
+def test_mp_ragged_alltoall():
+    """VERDICT r4 #4 'done' criterion: cross-process ragged alltoall with
+    uneven splits against numpy ground truth — split metadata negotiated
+    through the coordinator (Response.tensor_sizes send matrix), plus the
+    mixed-usage error path."""
+    from horovod_tpu.run.api import run
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.pathsep.join([os.path.dirname(here), here]),
+    }
+    res = run(_worker_ragged_alltoall, np=2, env=env, start_timeout=240)
+    assert sorted(res) == [(0, True), (1, True)]
+
+
 def _worker_autotune_knob_cadence():
     import numpy as np
 

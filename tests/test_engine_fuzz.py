@@ -43,6 +43,11 @@ def _gen_ops(seed, n_ops, world=WORLD):
     return ops
 
 
+def _a2av_splits(i, rank, world):
+    """Deterministic uneven (incl. zero) splits for fuzz op i on `rank`."""
+    return [(i + rank + d) % 3 for d in range(world)]
+
+
 def _expected(ops, world):
     """Numpy ground truth for rank-dependent inputs full(shape, r+1+i)."""
     out = {}
@@ -61,11 +66,20 @@ def _expected(ops, world):
                     for r in range(world)]
             out[i] = np.concatenate(rows, axis=0)
         elif kind == "alltoall":
-            # each dst receives src's dst-th segment, concatenated by src
-            seg = shape[0] // world
-            out[i] = {dst: np.concatenate(
-                [vals[src][dst * seg:(dst + 1) * seg] for src in range(world)],
-                axis=0) for dst in range(world)}
+            if ragged:
+                # alltoallv: src sends _a2av_splits(i, src)[dst] rows to dst
+                out[i] = {dst: np.concatenate(
+                    [np.full((_a2av_splits(i, src, world)[dst],)
+                             + shape[1:], src + 1 + i, dtype)
+                     for src in range(world)], axis=0)
+                    for dst in range(world)}
+            else:
+                # each dst receives src's dst-th segment, concatenated by src
+                seg = shape[0] // world
+                out[i] = {dst: np.concatenate(
+                    [vals[src][dst * seg:(dst + 1) * seg]
+                     for src in range(world)], axis=0)
+                    for dst in range(world)}
         else:
             out[i] = vals[root]
     return out
@@ -89,7 +103,13 @@ def _worker(seed, n_ops, world=WORLD):
                            + shape[1:], r + 1 + i, dtype)
             handles[i] = C.allgather_async(rows, name=f"fz{i}")
         elif kind == "alltoall":
-            handles[i] = C.alltoall_async(x, name=f"fz{i}")
+            if ragged:
+                splits = _a2av_splits(i, r, world)
+                xr = np.full((sum(splits),) + shape[1:], r + 1 + i, dtype)
+                handles[i] = C.alltoall_async(xr, splits=splits,
+                                              name=f"fz{i}")
+            else:
+                handles[i] = C.alltoall_async(x, name=f"fz{i}")
         else:
             handles[i] = C.broadcast_async(x, root, name=f"fz{i}")
         # randomly drain a pending handle mid-stream (its result is

@@ -149,7 +149,10 @@ def test_wire_request_response_roundtrip_randomized():
                 root_rank=int(rng.randint(-1, 8)),
                 average=bool(rng.randint(2)),
                 prescale=float(rng.choice([1.0, 1e-30, 1e30, -2.5])),
-                postscale=float(rng.choice([1.0, 0.5]))))
+                postscale=float(rng.choice([1.0, 0.5])),
+                splits=(tuple(int(x) for x in
+                              rng.randint(0, 2 ** 33, rng.randint(0, 6)))
+                        if rng.randint(2) else None)))
         score = ((int(rng.randint(0, 2 ** 48)), float(rng.rand()))
                  if rng.randint(2) else None)
         buf = wire.encode_request_list(flags, cached, reqs, score=score)
