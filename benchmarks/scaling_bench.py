@@ -143,8 +143,30 @@ def main(argv=None):
                     help="comma-separated; default 1,2,4,... up to all devices")
     args = ap.parse_args(argv)
 
+    # under hvdrun (HVD_COORDINATOR_ADDR set) this wires
+    # jax.distributed.initialize so jax.devices() spans the whole pod;
+    # standalone it is a no-op single-rank init — the SAME command line
+    # works on one chip and on a multi-host slice (pod-day contract,
+    # docs/running.md)
+    import horovod_tpu as hvd
+    hvd.init()
+
     import jax
     on_tpu = jax.default_backend() == "tpu"
+    if hvd.size() > 1:
+        # multi-controller: every process must participate in every jitted
+        # program, so a sub-world mesh (devices[:n] for n < all) is invalid
+        # — the pod-day ladder runs one hvdrun per world size instead
+        # (docs/running.md)
+        ndev_all = len(jax.devices())
+        sub = [int(s) for s in (args.world_sizes or "").split(",")
+               if s and int(s) != ndev_all]
+        if args.world_sizes is None or sub:
+            raise SystemExit(
+                f"under hvdrun, --world-sizes must equal the full device "
+                f"count ({ndev_all}); launch one hvdrun per ladder rung "
+                f"(got {args.world_sizes!r} — see docs/running.md pod-day "
+                "recipe)")
     ndev = len(jax.devices())
     bpd = args.batch_per_device or (128 if on_tpu else 4)
     img = args.image_size or (224 if on_tpu else 32)

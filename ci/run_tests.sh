@@ -30,6 +30,9 @@ stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # when pyspark is absent from the image)
 python -m pytest tests/ -q -m integration
 
+stage "pod-day smoke: multi-host command lines from docs/running.md"
+python ci/pod_smoke.py
+
 stage "launcher smoke: 2-process training job under hvdrun"
 cat > /tmp/ci_smoke_worker.py <<'EOF'
 import os, sys

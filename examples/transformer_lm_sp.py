@@ -45,6 +45,12 @@ def main():
     from horovod_tpu.parallel import (
         make_dp_sp_mesh, make_sp_train_step, replicate_to_mesh, sp_model)
 
+    # under hvdrun this wires jax.distributed so jax.devices() spans all
+    # hosts; standalone it is a no-op single-rank init (pod-day contract,
+    # docs/running.md)
+    import horovod_tpu as hvd
+    hvd.init()
+
     n_dev = len(jax.devices())
     sp = args.sp or n_dev // args.dp
     batch = args.batch or 2 * args.dp

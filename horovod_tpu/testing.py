@@ -113,3 +113,63 @@ def eager_dryrun_worker():
         outs["post"] = float(np.asarray(C.synchronize(h))[0])
     outs["last"] = C.join()
     return (r, outs)
+
+
+def hier_dryrun_worker():
+    """Driver-gate leg body: fused allreduce, ragged allgather and ragged
+    alltoall through the coordinated engine — run once on the flat rank
+    mesh and once over the 2x2 two-level ("dcn","ici") mesh
+    (HOROVOD_HIERARCHICAL_* legs of ``dryrun_multichip``); results must be
+    identical (small-integer inputs: exact in any association order)."""
+    import numpy as np
+
+    from . import basics
+    from .ops import collective_ops as C
+
+    r = basics.rank()
+    w = basics.size()
+    outs = {}
+    hs = [C.allreduce_async(np.arange(17, dtype=np.float32) + r + i,
+                            name=f"hd{i}", op=basics.Sum) for i in range(3)]
+    outs["ar"] = [np.asarray(C.synchronize(h)).tolist() for h in hs]
+    g = C.allgather_async(np.full((r + 1, 2), float(r), np.float32),
+                          name="hdg")
+    outs["ag"] = np.asarray(C.synchronize(g)).tolist()
+    splits = [(r + d) % 2 + 1 for d in range(w)]
+    rows = [[10.0 * r + d] for d in range(w) for _ in range(splits[d])]
+    outs["a2av"] = np.asarray(
+        C.alltoall(np.asarray(rows, np.float32), splits=splits,
+                   name="hdv")).tolist()
+    # report whether the executor REALLY took the two-level path, so the
+    # gate can reject a vacuous flat-vs-flat comparison
+    ex = basics._engine()._executor
+    two_level = bool(ex._mesh2 is not None and ex._hier_allreduce
+                     and ex._hier_allgather)
+    return (r, two_level, outs)
+
+
+def autotune_dryrun_worker():
+    """Driver-gate leg body: the HOROVOD_AUTOTUNE leg — same collectives
+    under GP/EI tuning started at a 1-byte fusion threshold with tight
+    cadence knobs; returns the results plus (start, end) threshold so the
+    gate can assert the tuned parameters moved."""
+    import numpy as np
+
+    from . import basics
+    from .ops import collective_ops as C
+
+    eng = basics._engine()
+    start = eng.controller.fusion_threshold()
+    data = [np.full((4096,), float(basics.rank() + i), np.float32)
+            for i in range(6)]
+
+    def round_(t):
+        hs = [C.allreduce_async(d, name=f"at{i}", op=basics.Sum)
+              for i, d in enumerate(data)]
+        return [float(np.asarray(C.synchronize(h))[0]) for h in hs]
+
+    round_(0)  # first execution pays compile; not scored
+    outs = None
+    for t in range(10):
+        outs = round_(t)
+    return (basics.rank(), outs, start, eng.controller.fusion_threshold())

@@ -246,3 +246,62 @@ def test_chunked_loss_indivisible_falls_back():
     got = float(lm_loss_chunked(hid, emb, tg, chunk_tokens=7))
     want = float(lm_loss(hid @ emb.T, tg))
     np.testing.assert_allclose(got, want, rtol=2e-2)
+
+
+@pytest.mark.integration
+def test_sp_seq16384_long_context(monkeypatch):
+    """VERDICT r3 #6: the sequence-parallel path actually runs at seq 16384
+    — the length docs/benchmarks.md shows OOMs a single chip (17.96 GB for
+    GPT-2-medium + fp32 AdamW) — over 4 virtual devices with a REAL
+    16384-token sequence (tiny model dims; the sequence axis is the claim
+    under test). Runs the Pallas ring-step kernels in interpret mode so the
+    measured per-device memory reflects the TPU path (FA2 backward, O(T)
+    residuals), not the quadratic jnp fallback. Records compiled per-device
+    memory so the docs note is a measurement, not an extrapolation."""
+    from functools import partial
+
+    monkeypatch.setenv("HVD_PALLAS", "interpret")
+
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.parallel import sp_model as _sp_model
+
+    seq = 16384
+    mesh = make_dp_sp_mesh(dp=1, sp=4)
+    # head dim 64 (the kernel's minimum lane-aligned width) so the Pallas
+    # ring step actually engages rather than the quadratic jnp fallback
+    model_cls = partial(TransformerLM, num_layers=1, num_heads=1,
+                        d_model=64, max_seq_len=seq)
+    rng = np.random.RandomState(11)
+    tokens, targets = _data(rng, 1, seq)
+
+    model = _sp_model(model_cls, vocab_size=VOCAB, dtype=jnp.float32)
+    params = model_cls(vocab_size=VOCAB, dtype=jnp.float32).init(
+        jax.random.PRNGKey(11), tokens[:, :64])["params"]
+    tx = optax.sgd(1e-2)
+    opt_state = tx.init(params)
+    step = make_sp_train_step(model, tx, mesh)
+
+    params = replicate_to_mesh(params, mesh)
+    opt_state = replicate_to_mesh(opt_state, mesh)
+    compiled = step.lower(params, opt_state, tokens, targets).compile()
+    mem = compiled.memory_analysis()
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    assert np.isfinite(float(loss)), float(loss)
+    # ring attention keeps per-device temporaries linear in LOCAL seq: the
+    # activation working set must stay far below the quadratic [T, T]
+    # score tensor a naive global attention would allocate (16384^2 f32 =
+    # 1 GiB per batch x head). docs/benchmarks.md cites this number — if
+    # the measurement becomes unavailable, skip LOUDLY rather than letting
+    # the claim ride an assert that never ran.
+    if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+        pytest.skip("compiled.memory_analysis() unavailable on this jax — "
+                    "the docs/benchmarks.md 35 MiB figure is unverified "
+                    "here")
+    temp = int(mem.temp_size_in_bytes)
+    assert temp < 256 * 2 ** 20, (
+        f"per-device temp {temp/2**20:.0f} MiB at seq {seq} — the "
+        "sp path should be linear in local sequence length (the quadratic "
+        "fallback measures ~1495 MiB)")
+    print(f"seq16384 per-device: temp {temp/2**20:.1f} MiB, "
+          f"args {mem.argument_size_in_bytes/2**20:.1f} MiB, "
+          f"output {mem.output_size_in_bytes/2**20:.1f} MiB")
