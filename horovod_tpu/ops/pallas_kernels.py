@@ -674,7 +674,7 @@ def _flash_bwd_dkv_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
 
 
 def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
-                            do_ref, dq_ref, dk_ref, dv_ref, dq_acc, *,
+                            do_ref, dq_ref, dk_ref, dv_ref, *maybe_acc,
                             causal, scale):
     """ONE-pass FlashAttention-2 backward: grid (bh, k tiles, q tiles) with
     q innermost; each cell recomputes p ONCE and emits all three gradient
@@ -689,16 +689,23 @@ def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
     through the dq output block every visit — tile i's bytes are final
     from its last live k sweep onward, and later sweeps rewrite the same
     final bytes (last-write-wins), so the output is correct for causal
-    and non-causal alike at the cost of nk-1 redundant tile writes."""
+    and non-causal alike at the cost of nk-1 redundant tile writes.
+
+    Single-k-sweep fast path (nk == 1, e.g. the seq-1024 headline config):
+    dq completes within one cell, so the dispatch allocates NO scratch
+    (``maybe_acc`` empty) and the kernel writes dq directly — skipping a
+    read-modify-write plus a flush copy of the tile per cell."""
+    dq_acc = maybe_acc[0] if maybe_acc else None
     jk, iq = pl.program_id(1), pl.program_id(2)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
     in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
     q_off = offs_ref[0] + iq * bq
     k_off = offs_ref[1] + jk * bk
 
-    @pl.when(jnp.logical_and(jk == 0, iq == 0))
-    def _():
-        dq_acc[...] = jnp.zeros_like(dq_acc)
+    if dq_acc is not None:
+        @pl.when(jnp.logical_and(jk == 0, iq == 0))
+        def _():
+            dq_acc[...] = jnp.zeros_like(dq_acc)
 
     @pl.when(iq == 0)
     def _():
@@ -706,6 +713,12 @@ def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
         dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
     live = (q_off + bq - 1 >= k_off) if causal else True
+
+    if dq_acc is None and causal:
+        # a fully-masked cell contributes nothing: its dq tile is zero
+        @pl.when(jnp.logical_not(live))
+        def _():
+            dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
     @pl.when(live)
     def _():
@@ -731,11 +744,15 @@ def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
         ds = (p * (dp - dd) * scale).astype(in_dt)
         dk_ref[0] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
-        dq_acc[pl.ds(iq * bq, bq), :] += lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_contrib = lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        if dq_acc is None:
+            dq_ref[0] = dq_contrib
+        else:
+            dq_acc[pl.ds(iq * bq, bq), :] += dq_contrib
 
-    dq_ref[0] = dq_acc[pl.ds(iq * bq, bq), :]
+    if dq_acc is not None:
+        dq_ref[0] = dq_acc[pl.ds(iq * bq, bq), :]
 
 
 def _flash_bwd_fused(qt, kt, vt, dot, lset, ddt, offs, d, *, causal, scale,
@@ -766,7 +783,9 @@ def _flash_bwd_fused(qt, kt, vt, dot, lset, ddt, offs, d, *, causal, scale,
                 pl.BlockSpec((1, block_q, d), lambda i, j, n, offs: (i, n, 0)),
                 ktile, ktile,
             ],
-            scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
+            # single k sweep: dq finishes inside its cell — no scratch
+            scratch_shapes=([] if tk // block_k == 1
+                            else [pltpu.VMEM((tq, d), jnp.float32)]),
         ),
         out_shape=[
             _struct((bh, tq, d), jnp.float32, qt, kt, offs),

@@ -556,3 +556,24 @@ def test_fused_adamw_schedule(monkeypatch):
     np.testing.assert_allclose(np.asarray(params["w"]),
                                np.asarray(rparams["w"]),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_fused_multi_ksweep(causal, monkeypatch):
+    """The fused backward's SCRATCH path (nk > 1: dq accumulates across k
+    sweeps in the persistent VMEM scratch) — small test shapes otherwise
+    take the single-sweep fast path that skips the scratch entirely."""
+    monkeypatch.setenv("HVD_PALLAS_BLOCK_BWD_K", "64")   # 256/64 -> nk=4
+    monkeypatch.setenv("HVD_PALLAS_BLOCK_BWD_Q", "64")
+    q, k, v = _rand_qkv(jax.random.PRNGKey(31), 1, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(32), q.shape, q.dtype)
+
+    g_pk = jax.grad(
+        lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=causal)
+                                * w), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pk, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
