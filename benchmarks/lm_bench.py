@@ -85,8 +85,15 @@ def main():
                     v.transpose(0, 2, 1, 3), causal=True,
                     sm_scale=1.0 / float(np.sqrt(d)))
             return o.transpose(0, 2, 1, 3)
+    elif attn == "linear":
+        # attribution probe, NOT a model: v passes through untouched (wrong
+        # math, zero attention FLOPs/DMA) — the measured rate is the step's
+        # non-attention ceiling, so (1/rate - 1/linear_rate) is the
+        # attention bucket's share of step time
+        attn_fn = lambda q, k, v: v
     elif attn != "pallas":
-        raise ValueError(f"LM_ATTN={attn!r}: expected pallas|xla|upstream")
+        raise ValueError(
+            f"LM_ATTN={attn!r}: expected pallas|xla|upstream|linear")
 
     model = TransformerLM(
         vocab_size=vocab, num_layers=cfg["num_layers"],
@@ -177,6 +184,15 @@ def main():
     for _ in range(cfg["warmup"]):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
     float(loss)
+
+    if os.environ.get("LM_PROFILE"):
+        # capture a few steady-state steps; summarize with
+        # benchmarks/xplane_summary.py <dir>
+        with jax.profiler.trace(os.environ["LM_PROFILE"]):
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state, tokens,
+                                               targets)
+            float(loss)
 
     t0 = time.perf_counter()
     for _ in range(cfg["rounds"] * cfg["iters"]):

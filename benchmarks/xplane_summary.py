@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace (xplane.pb) into a per-op time table.
+
+The image has no tensorboard profile plugin; this reads the XSpace proto
+directly (tensorflow.tsl ships the schema) and aggregates event durations
+on the device planes — the "xplane op breakdown" the perf docs cite.
+
+    LM_PROFILE=/tmp/lmprof python benchmarks/lm_bench.py
+    python benchmarks/xplane_summary.py /tmp/lmprof [top_n]
+"""
+
+import glob
+import os
+import sys
+from collections import defaultdict
+
+
+def load_xspaces(root):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(root, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise SystemExit(f"no .xplane.pb under {root}")
+    spaces = []
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xs.ParseFromString(f.read())
+        spaces.append((p, xs))
+    return spaces
+
+
+def summarize(root, top_n=25):
+    agg = defaultdict(float)          # op name -> total ms
+    plane_totals = defaultdict(float)
+    for _, xs in load_xspaces(root):
+        for plane in xs.planes:
+            # device planes carry the op timeline; host/python planes are
+            # trace noise for this purpose
+            if not ("tpu" in plane.name.lower()
+                    or "device" in plane.name.lower()):
+                continue
+            emeta = {k: v.name for k, v in plane.event_metadata.items()}
+            for line in plane.lines:
+                # derived lines (module/step containers) span whole
+                # executions and would double-count every op under them
+                if any(s in line.name.lower() for s in ("module", "step")):
+                    continue
+                for ev in line.events:
+                    nm = emeta.get(ev.metadata_id, f"#{ev.metadata_id}")
+                    if nm.startswith("jit_"):  # whole-program container
+                        continue
+                    ms = ev.duration_ps / 1e9
+                    agg[nm] += ms
+                    plane_totals[plane.name] += ms
+    total = sum(agg.values())
+    print(f"planes: {dict(plane_totals)}")
+    print(f"{'op':<72} {'ms':>10} {'%':>6}")
+    for nm, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"{nm[:72]:<72} {ms:>10.2f} {100 * ms / total:>5.1f}%")
+    print(f"{'TOTAL (sum of events; includes nesting overlap)':<72} "
+          f"{total:>10.2f}")
+
+
+if __name__ == "__main__":
+    summarize(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
