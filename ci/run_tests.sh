@@ -57,6 +57,9 @@ python bin/hvdrun -np 2 --no-nic-discovery python /tmp/ci_smoke_worker.py
 stage "launcher smoke: run() func API across 2 processes"
 python examples/interactive_run.py
 
+stage "launcher smoke: ragged alltoall routing across 4 processes"
+python examples/alltoallv_routing.py
+
 if [ "$QUICK" != "quick" ]; then
   stage "benchmarks: scaling + allreduce microbench (virtual 8-device mesh)"
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
