@@ -577,3 +577,53 @@ def test_flash_bwd_fused_multi_ksweep(causal, monkeypatch):
     for a, b in zip(g_pk, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+def test_flash_bwd_fused_vs_legacy_differential(monkeypatch):
+    """Differential check across random configurations: the ONE-pass fused
+    backward matches BOTH legacy layouts (whole-resident, and streaming —
+    forced for half the trials via the resident cap) through the production
+    `_flash_bwd` packing, at f32 rtol. Offsets are drawn so the q and k
+    blocks OVERLAP, keeping causal trials on a real mask boundary instead
+    of degenerate all-masked/all-unmasked corners. f32-only by design:
+    shared-math bugs are covered by the reference-attention comparisons in
+    the tests above; this test's job is fused-vs-legacy divergence."""
+    from horovod_tpu.ops.pallas_kernels import _flash_bwd
+
+    rng = np.random.RandomState(17)
+    for trial in range(6):
+        tq = int(rng.choice([64, 128, 256]))
+        tk = int(rng.choice([64, 128, 256]))
+        causal = bool(rng.randint(2))
+        # overlapping ring-style block origins: k block starts inside
+        # [q_off, q_off + tq) so a causal mask boundary crosses the tiles
+        q_off = int(rng.choice([0, 64]))
+        k_off = q_off + int(rng.randint(0, tq // 64)) * 64
+        force_streaming = bool(trial % 2)
+        b, h, d = 1, 2, 64
+        keys = jax.random.split(jax.random.PRNGKey(trial), 4)
+        q = jax.random.normal(keys[0], (b, tq, h, d), jnp.float32)
+        k = jax.random.normal(keys[1], (b, tk, h, d), jnp.float32)
+        v = jax.random.normal(keys[2], (b, tk, h, d), jnp.float32)
+        dout = jax.random.normal(keys[3], (b, tq, h, d), jnp.float32)
+        # forward statistics from the step kernel (what ring hops carry)
+        m = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+        l = jnp.zeros((b, h, tq), jnp.float32)
+        o = jnp.zeros((b, tq, h, d), jnp.float32)
+        m, l, o = pk.flash_attention_step(q, k, v, m, l, o, q_off, k_off,
+                                          causal=causal, scale=d ** -0.5)
+        out, lse = pk.finalize_attention_stats(m, l, o, jnp.float32)
+
+        def run(fused):
+            monkeypatch.setenv("HVD_PALLAS_FUSED_BWD",
+                               "1" if fused else "0")
+            monkeypatch.setattr(pk, "_BWD_RESIDENT_CAP",
+                                1 if force_streaming else 256 * 2 ** 10)
+            return _flash_bwd(q, k, v, out, lse, dout, q_off, k_off,
+                              causal=causal, scale=d ** -0.5)
+
+        for a, b_, nm in zip(run(True), run(False), ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-5,
+                err_msg=f"trial {trial} ({tq=}, {tk=}, {causal=}, "
+                        f"{q_off=}, {k_off=}, {force_streaming=}) "
+                        f"{nm} fused != legacy")
