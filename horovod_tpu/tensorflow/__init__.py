@@ -156,16 +156,25 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
                                               name=name)), tensor)
 
 
-def alltoall(tensor, name: Optional[str] = None):
-    """Equal-split alltoall (engine extension beyond the 0.18.2 op set —
-    the reference gained tf alltoall in 0.20): dim 0 divisible by world
-    size; rank r receives segment r from every rank."""
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Alltoall (engine extension beyond the 0.18.2 op set — the reference
+    gained tf alltoall in 0.20). Without ``splits``: equal split, dim 0
+    divisible by world size, rank r receives segment r from every rank.
+    With ``splits`` (length-world, summing to dim 0): ragged alltoallv
+    (eager only — a graph-mode alltoallv would need a dynamic output
+    shape through tf.py_function, which tf.function cannot carry)."""
     t = _require_tf()
     if not t.executing_eagerly():
+        if splits is not None:
+            raise NotImplementedError(
+                "alltoall(splits=...) is eager-only on the TF surface: "
+                "the ragged output shape cannot cross a tf.function "
+                "py_function boundary.")
         from . import graph as _graph
         return _graph.alltoall(tensor, name=name)
     return _from_result(
-        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor), name=name)),
+        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
+                                             splits=splits, name=name)),
         tensor)
 
 

@@ -161,9 +161,14 @@ def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
     return synchronize(broadcast_async_(tensor, root_rank, name=name))
 
 
-def alltoall(tensor, name: Optional[str] = None):
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Alltoall; with ``splits`` (length-world, summing to dim 0) the
+    ragged alltoallv form — the later-horovod torch surface shape. Any
+    int iterable works (torch tensor, numpy array, list); the engine
+    normalizes."""
     return _from_result(
-        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor), name=name)),
+        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
+                                             splits=splits, name=name)),
         tensor)
 
 

@@ -99,3 +99,31 @@ def test_tf_tape_none_gradient_passthrough():
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_alltoall_ragged_eager_and_graph_gate():
+    """TF-surface alltoall with splits: eager routes through the engine;
+    graph mode rejects splits with an actionable error (the ragged output
+    shape cannot cross a tf.function py_function boundary)."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        splits = [r + d + 1 for d in range(w)]
+        rows = []
+        for d in range(w):
+            rows += [[100.0 * r + d]] * splits[d]
+        out = hvd.alltoall(tf.constant(rows), splits=np.asarray(splits),
+                           name="tf_a2av")
+        exp = []
+        for src in range(w):
+            exp += [[100.0 * src + r]] * (src + r + 1)
+        np.testing.assert_allclose(out.numpy(), np.asarray(exp, np.float32))
+
+        @tf.function
+        def graph_a2av(x):
+            return hvd.alltoall(x, splits=[2, 2], name="tf_a2av_g")
+
+        with pytest.raises(Exception, match="eager-only"):
+            graph_a2av(tf.zeros((4, 1)))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))

@@ -392,3 +392,24 @@ def test_inplace_through_temporary_data_wrapper():
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_alltoall_ragged():
+    """Torch-surface alltoall with splits (later-horovod signature): torch
+    tensors in, per-rank uneven routing, torch tensor out."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        splits = [r + d + 1 for d in range(w)]
+        rows = []
+        for d in range(w):
+            rows += [[100.0 * r + d]] * splits[d]
+        out = hvd.alltoall(torch.tensor(rows), splits=torch.tensor(splits),
+                           name="t_a2av")
+        exp = []
+        for src in range(w):
+            exp += [[100.0 * src + r]] * (src + r + 1)
+        assert isinstance(out, torch.Tensor)
+        assert torch.allclose(out, torch.tensor(exp))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
