@@ -501,12 +501,15 @@ def _worker_ragged_alltoall():
     rows = []
     for d in range(w):
         rows += [[100.0 * r + d]] * splits[d]
-    out = np.asarray(hvd.alltoall(np.asarray(rows, np.float32),
-                                  splits=splits, name="a2av_mp"))
     exp = []
     for src in range(w):
         exp += [[100.0 * src + r]] * (src + r + 1)
-    np.testing.assert_allclose(out, np.asarray(exp, np.float32))
+    # second call with the same name: the coordinated response-cache id
+    # fast path must rebuild the identical send matrix
+    for _ in range(2):
+        out = np.asarray(hvd.alltoall(np.asarray(rows, np.float32),
+                                      splits=splits, name="a2av_mp"))
+        np.testing.assert_allclose(out, np.asarray(exp, np.float32))
     # mixed usage: this rank ragged, peer equal -> coordinator error
     import pytest as _pytest
     kw = {"splits": [1, 1]} if r == 0 else {}
