@@ -179,6 +179,19 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
                                               name=name)), tensor)
 
 
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             priority: int = 0):
+    """Alltoall; with ``splits`` (length-world, summing to dim 0) the
+    ragged alltoallv form. Out-of-place, like ``allgather`` (the output
+    shape differs from the input's) — out-of-place ops always execute
+    inline (module docstring), so ``priority`` is accepted purely for
+    surface symmetry and never reorders anything."""
+    return _from_result(
+        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
+                                             splits=splits, name=name)),
+        tensor)
+
+
 def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
                priority: int = 0):
     queue = _defer_queue()

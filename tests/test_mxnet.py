@@ -179,3 +179,24 @@ def test_mx_deferred_execution_does_not_nest(hvd_mx):
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_mx_alltoall_ragged(hvd_mx):
+    from fake_mxnet import NDArray
+
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        splits = [r + d + 1 for d in range(w)]
+        rows = []
+        for d in range(w):
+            rows += [[10.0 * r + d]] * splits[d]
+        out = hvd_mx.alltoall(NDArray(np.asarray(rows, np.float32)),
+                              splits=splits, name="mx_a2av")
+        exp = []
+        for src in range(w):
+            exp += [[10.0 * src + r]] * (src + r + 1)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.asarray(exp, np.float32))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
