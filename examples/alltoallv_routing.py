@@ -33,13 +33,18 @@ def worker():
         [np.full((splits[d], 8), 100.0 * r + d, np.float32)
          for d in range(w)])
 
-    routed = np.asarray(hvd.alltoall(tokens, splits=splits, name="route"))
+    routed, received = hvd.alltoall(tokens, splits=splits, name="route")
+    routed = np.asarray(routed)
 
     # verify VALUES, not just counts: rank r receives splits_src[r] rows
-    # from each src in source-rank order, stamped 100*src + r
+    # from each src in source-rank order, stamped 100*src + r — and the
+    # negotiated received_splits report exactly those per-source counts
+    src_counts = [int(np.random.RandomState(src).randint(0, 5, w)[r])
+                  for src in range(w)]
+    np.testing.assert_array_equal(np.asarray(received), src_counts)
     expected = np.concatenate(
-        [np.full((int(np.random.RandomState(src).randint(0, 5, w)[r]), 8),
-                 100.0 * src + r, np.float32) for src in range(w)])
+        [np.full((src_counts[src], 8), 100.0 * src + r, np.float32)
+         for src in range(w)])
     np.testing.assert_array_equal(routed, expected)
     print(f"rank {r}: sent {splits} -> received {routed.shape[0]} tokens")
     return routed.shape[0]

@@ -182,14 +182,21 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              priority: int = 0):
     """Alltoall; with ``splits`` (length-world, summing to dim 0) the
-    ragged alltoallv form. Out-of-place, like ``allgather`` (the output
-    shape differs from the input's) — out-of-place ops always execute
-    inline (module docstring), so ``priority`` is accepted purely for
-    surface symmetry and never reorders anything."""
-    return _from_result(
-        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
-                                             splits=splits, name=name)),
-        tensor)
+    ragged alltoallv form, returning ``(output, received_splits)``
+    (later-horovod's API shape — received_splits[src] counts the output
+    rows that came from rank ``src``). Out-of-place, like ``allgather``
+    (the output shape differs from the input's) — out-of-place ops always
+    execute inline (module docstring), so ``priority`` is accepted purely
+    for surface symmetry and never reorders anything."""
+    res = _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
+                                               splits=splits, name=name))
+    from ..runtime.messages import AlltoallvResult
+
+    if isinstance(res, AlltoallvResult):
+        m = _require_mx()
+        return (_from_result(res.output, tensor),
+                m.nd.array(np.asarray(res.received_splits), dtype="int32"))
+    return _from_result(res, tensor)
 
 
 def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from .. import basics
 from ..basics import Adasum, Average, Sum
-from ..runtime.messages import RequestType, TensorTableEntry
+from ..runtime.messages import AlltoallvResult, RequestType, TensorTableEntry
 from .compression import Compression
 
 _auto_counter = {}
@@ -37,6 +37,17 @@ def _auto_name(prefix: str, name: Optional[str]) -> str:
     n = _auto_counter.get(key, 0)
     _auto_counter[key] = n + 1
     return f"{prefix}.noname.{n}"
+
+
+def _reset_auto_names() -> None:
+    """Counters restart with the engine: a shutdown/re-init cycle must not
+    carry auto-name positions into the next session — ranks whose previous
+    session advanced their counters unevenly (asymmetric branches, error
+    paths) would otherwise submit mismatched names forever after."""
+    _auto_counter.clear()
+
+
+basics.register_shutdown_hook(_reset_auto_names)
 
 
 def _commit(tensor, rank: int):
@@ -158,7 +169,15 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):
-    return synchronize(alltoall_async(tensor, splits=splits, name=name))
+    """Without ``splits``: returns the exchanged tensor. With ``splits``:
+    returns ``(output, received_splits)`` — received_splits[src] is how many
+    dim-0 rows of the output came from rank ``src`` (later-horovod's
+    alltoallv return shape; the counts are column ``rank()`` of the
+    negotiated send matrix)."""
+    res = synchronize(alltoall_async(tensor, splits=splits, name=name))
+    if isinstance(res, AlltoallvResult):
+        return res.output, jnp.asarray(res.received_splits, dtype=jnp.int32)
+    return res
 
 
 # ------------------------------------------------------------- join / handles

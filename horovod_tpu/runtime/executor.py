@@ -26,7 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.env import env_on as _env_on
-from .messages import RequestType, Response, ResponseType, TensorTableEntry
+from .messages import (AlltoallvResult, RequestType, Response, ResponseType,
+                       TensorTableEntry)
 
 MESH_AXIS = "hvd"
 
@@ -644,7 +645,9 @@ class Executor:
             matrix = [list(entries_by_rank[r][0].splits) for r in ranks]
 
         if world == 1:
-            return {ranks[0]: [e.array for e in template]}
+            return {ranks[0]: [AlltoallvResult(e.array,
+                                               (int(e.array.shape[0]),))
+                               for e in template]}
 
         maxc = max(1, max(max(row) for row in matrix))
         rowlen = world * maxc * elem
@@ -662,6 +665,10 @@ class Executor:
         for r in ranks:
             counts = tuple(matrix[src][r] for src in range(world))
             row = rows[r].reshape(-1)
-            res[r] = [self._a2av_unpack_fn(counts, tail, maxc, elem,
-                                           dtype)(row)]
+            out_r = self._a2av_unpack_fn(counts, tail, maxc, elem,
+                                         dtype)(row)
+            # received splits ride the result (later-horovod's
+            # ``(output, received_splits)`` API shape) — they are column r
+            # of the negotiated send matrix, already in hand here
+            res[r] = [AlltoallvResult(out_r, counts)]
         return res

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 
 class RequestType(enum.IntEnum):
@@ -34,6 +34,16 @@ class ResponseType(enum.IntEnum):
     ADASUM = 4
     ALLTOALL = 5
     ERROR = 6
+
+
+class AlltoallvResult(NamedTuple):
+    """Result of a ragged ``alltoall(tensor, splits)``: the gathered output
+    plus the negotiated per-source row counts (later-horovod's
+    ``(output, received_splits)`` return shape). Produced by the executor,
+    carried through the handle manager; framework surfaces unwrap it."""
+
+    output: Any
+    received_splits: Tuple[int, ...]
 
 
 @dataclass

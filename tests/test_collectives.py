@@ -262,8 +262,12 @@ def test_alltoall_ragged_splits():
         # iteration 2+ reuses the name: the negotiation rides the response
         # cache's id fast path, which must reconstruct the same send matrix
         for _ in range(3):
-            out = np.asarray(hvd.alltoall(x, splits=splits, name="a2av"))
-            np.testing.assert_allclose(out, np.asarray(exp, np.float32))
+            out, rsplits = hvd.alltoall(x, splits=splits, name="a2av")
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(exp, np.float32))
+            # received_splits = column r of the send matrix
+            assert list(np.asarray(rsplits)) == \
+                [src + r + 1 for src in range(w)]
         return True
 
     assert all(testing.run_cluster(fn, np=4))
@@ -278,11 +282,13 @@ def test_alltoall_ragged_zero_rows():
         splits = [3] * w if r == 0 else [0] * w
         x = (np.arange(3 * w * 2, dtype=np.float32).reshape(3 * w, 2)
              if r == 0 else np.zeros((0, 2), np.float32))
-        out = np.asarray(hvd.alltoall(x, splits=splits, name="a2av0"))
+        out, rsplits = hvd.alltoall(x, splits=splits, name="a2av0")
+        out = np.asarray(out)
         exp = (np.arange(3 * w * 2, dtype=np.float32)
                .reshape(3 * w, 2)[3 * r:3 * (r + 1)])
         assert out.shape == (3, 2)
         np.testing.assert_allclose(out, exp)
+        assert list(np.asarray(rsplits)) == [3] + [0] * (w - 1)
         return True
 
     assert all(testing.run_cluster(fn, np=2))

@@ -507,9 +507,11 @@ def _worker_ragged_alltoall():
     # second call with the same name: the coordinated response-cache id
     # fast path must rebuild the identical send matrix
     for _ in range(2):
-        out = np.asarray(hvd.alltoall(np.asarray(rows, np.float32),
-                                      splits=splits, name="a2av_mp"))
-        np.testing.assert_allclose(out, np.asarray(exp, np.float32))
+        out, rsplits = hvd.alltoall(np.asarray(rows, np.float32),
+                                    splits=splits, name="a2av_mp")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(exp, np.float32))
+        assert list(np.asarray(rsplits)) == [src + r + 1 for src in range(w)]
     # mixed usage: this rank ragged, peer equal -> coordinator error
     import pytest as _pytest
     kw = {"splits": [1, 1]} if r == 0 else {}

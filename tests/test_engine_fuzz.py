@@ -116,11 +116,24 @@ def _worker(seed, n_ops, world=WORLD):
         # validated like the rest)
         if handles and delays.rand() < 0.3:
             j = sorted(handles)[0]
-            results[j] = np.asarray(C.synchronize(handles.pop(j)))
+            results[j] = _drain(C.synchronize(handles.pop(j)), j, r, world)
             checked += 1
     for i, h in handles.items():
-        results[i] = np.asarray(C.synchronize(h))
+        results[i] = _drain(C.synchronize(h), i, r, world)
     return (r, results, checked)
+
+
+def _drain(res, i, r, world=WORLD):
+    """Unwrap ragged alltoall results, asserting the negotiated
+    received_splits are column r of the send matrix."""
+    from horovod_tpu.runtime.messages import AlltoallvResult
+
+    if isinstance(res, AlltoallvResult):
+        assert list(res.received_splits) == \
+            [_a2av_splits(i, src, world)[r] for src in range(world)], \
+            f"op {i} rank {r}: wrong received_splits"
+        return np.asarray(res.output)
+    return np.asarray(res)
 
 
 @pytest.mark.parametrize("seed", [7, 23, 91])

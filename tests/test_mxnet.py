@@ -190,13 +190,15 @@ def test_mx_alltoall_ragged(hvd_mx):
         rows = []
         for d in range(w):
             rows += [[10.0 * r + d]] * splits[d]
-        out = hvd_mx.alltoall(NDArray(np.asarray(rows, np.float32)),
-                              splits=splits, name="mx_a2av")
+        out, rsplits = hvd_mx.alltoall(NDArray(np.asarray(rows, np.float32)),
+                                       splits=splits, name="mx_a2av")
         exp = []
         for src in range(w):
             exp += [[10.0 * src + r]] * (src + r + 1)
         np.testing.assert_allclose(out.asnumpy(),
                                    np.asarray(exp, np.float32))
+        assert list(np.asarray(rsplits.asnumpy())) == \
+            [src + r + 1 for src in range(w)]
         return True
 
     assert all(testing.run_cluster(fn, np=2))
