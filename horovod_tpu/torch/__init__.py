@@ -96,14 +96,48 @@ def allreduce_async(tensor, average: Optional[bool] = None,
     return h
 
 
+class _HorovodAllreduce:
+    """Differentiable allreduce (`torch/mpi_ops.py:159-171` HorovodAllreduce):
+    the adjoint of a sum/average over ranks is the same reduction of the
+    incoming gradient (each rank's output feeds every rank's loss). Defined
+    lazily because torch is an optional dependency of this package."""
+
+    _cls = None
+
+    @classmethod
+    def apply(cls, tensor, op, name):
+        if cls._cls is None:
+            torch = _require_torch()
+
+            class Fn(torch.autograd.Function):
+                @staticmethod
+                def forward(ctx, x, op_, name_):
+                    ctx.op = op_
+                    return synchronize(allreduce_async(x, name=name_,
+                                                       op=op_))
+
+                @staticmethod
+                def backward(ctx, dy):
+                    # Adasum keeps the reference's registered sum-allreduce
+                    # gradient (its combine rule has no closed-form adjoint)
+                    op_ = ctx.op if ctx.op in (Sum, Average) else Sum
+                    return allreduce(dy, op=op_), None, None
+
+            cls._cls = Fn
+        return cls._cls.apply(tensor, op, name)
+
+
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None, compression=Compression.none,
               op: Optional[int] = None):
     """Returns a NEW tensor with the averaged/summed value
-    (`torch/mpi_ops.py:133-168`)."""
+    (`torch/mpi_ops.py:133-168`). Differentiable: an input that requires
+    grad yields the reference-formula gradient (allreduce of the incoming
+    gradient with the same op); compression casts are torch ops, so the
+    gradient flows through them too."""
     op_ = _resolve_op(average, op)
     comp, ctx = compression.compress(tensor)
-    out = synchronize(allreduce_async(comp, name=name, op=op_))
+    out = _HorovodAllreduce.apply(comp, op_, name)
     return compression.decompress(out, ctx)
 
 
@@ -134,8 +168,43 @@ def allgather_async(tensor, name: Optional[str] = None) -> int:
     return h
 
 
+class _HorovodAllgather:
+    """Differentiable allgather (`torch/mpi_ops.py:290-309`): the adjoint of
+    concatenation over ranks is sum-allreduce of the incoming gradient, then
+    slicing out this rank's segment at the offset given by the gathered
+    per-rank dim0s (ragged inputs allowed — the dims are allgathered too)."""
+
+    _cls = None
+
+    @classmethod
+    def apply(cls, tensor, name):
+        if cls._cls is None:
+            torch = _require_torch()
+
+            class Fn(torch.autograd.Function):
+                @staticmethod
+                def forward(ctx, x, name_):
+                    ctx.dim0 = int(x.shape[0]) if x.dim() else 1
+                    return synchronize(allgather_async(x, name=name_))
+
+                @staticmethod
+                def backward(ctx, dy):
+                    torch = _require_torch()
+                    g = allreduce(dy, op=Sum)
+                    dims = allgather(torch.tensor([ctx.dim0],
+                                                  dtype=torch.int64))
+                    r = rank()
+                    offset = int(dims[:r].sum().item()) if r else 0
+                    return g.narrow(0, offset, ctx.dim0), None
+
+            cls._cls = Fn
+        return cls._cls.apply(tensor, name)
+
+
 def allgather(tensor, name: Optional[str] = None):
-    return synchronize(allgather_async(tensor, name=name))
+    """Concatenates over ranks along dim 0; differentiable
+    (`torch/mpi_ops.py:312-336`)."""
+    return _HorovodAllgather.apply(tensor, name)
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
@@ -144,8 +213,38 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
     return h
 
 
+class _HorovodBroadcast:
+    """Differentiable broadcast (`torch/mpi_ops.py:372-386`): every rank's
+    output is root's input, so root's gradient is the sum of all ranks'
+    incoming gradients and non-root inputs get zero."""
+
+    _cls = None
+
+    @classmethod
+    def apply(cls, tensor, root_rank, name):
+        if cls._cls is None:
+            torch = _require_torch()
+
+            class Fn(torch.autograd.Function):
+                @staticmethod
+                def forward(ctx, x, root_, name_):
+                    ctx.root_rank = root_
+                    return synchronize(broadcast_async(x, root_, name=name_))
+
+                @staticmethod
+                def backward(ctx, dy):
+                    g = allreduce(dy, op=Sum)
+                    if rank() != ctx.root_rank:
+                        g = g * 0
+                    return g, None, None
+
+            cls._cls = Fn
+        return cls._cls.apply(tensor, root_rank, name)
+
+
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
-    return synchronize(broadcast_async(tensor, root_rank, name=name))
+    """Out-of-place broadcast; differentiable (`torch/mpi_ops.py:389-412`)."""
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
 
 
 def broadcast_async_(tensor, root_rank: int,
@@ -161,15 +260,59 @@ def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
     return synchronize(broadcast_async_(tensor, root_rank, name=name))
 
 
+class _HorovodAlltoall:
+    """Differentiable alltoall. Equal-split alltoall is self-adjoint (the
+    exchange is a permutation of blocks); the ragged form's adjoint is an
+    alltoall of the gradient with splits = the forward's received splits,
+    which routes each gradient chunk back to the rank that sent the
+    corresponding rows (later-horovod HorovodAlltoall)."""
+
+    _cls = None
+
+    @classmethod
+    def apply(cls, tensor, splits, name):
+        if cls._cls is None:
+            torch = _require_torch()
+
+            class Fn(torch.autograd.Function):
+                @staticmethod
+                def forward(ctx, x, splits_, name_):
+                    res = _ops.synchronize(
+                        _ops.alltoall_async(_to_numpy(x), splits=splits_,
+                                            name=name_))
+                    from ..runtime.messages import AlltoallvResult
+
+                    if isinstance(res, AlltoallvResult):
+                        ctx.recv_splits = tuple(
+                            int(s) for s in res.received_splits)
+                        out = _from_result(res.output, x)
+                        rs = torch.tensor(ctx.recv_splits,
+                                          dtype=torch.int32)
+                        ctx.mark_non_differentiable(rs)
+                        return out, rs
+                    ctx.recv_splits = None
+                    return _from_result(res, x)
+
+                @staticmethod
+                def backward(ctx, dy, *unused_rs_grad):
+                    if ctx.recv_splits is not None:
+                        dx, _ = alltoall(dy, splits=ctx.recv_splits)
+                        return dx, None, None
+                    return alltoall(dy), None, None
+
+            cls._cls = Fn
+        return cls._cls.apply(tensor, splits, name)
+
+
 def alltoall(tensor, splits=None, name: Optional[str] = None):
     """Alltoall; with ``splits`` (length-world, summing to dim 0) the
-    ragged alltoallv form — the later-horovod torch surface shape. Any
-    int iterable works (torch tensor, numpy array, list); the engine
-    normalizes."""
-    return _from_result(
-        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
-                                             splits=splits, name=name)),
-        tensor)
+    ragged alltoallv form — the later-horovod torch surface shape,
+    returning ``(output, received_splits)``. Any int iterable works
+    (torch tensor, numpy array, list); the engine normalizes.
+    Differentiable in both forms."""
+    if splits is not None:
+        splits = tuple(int(s) for s in splits)
+    return _HorovodAlltoall.apply(tensor, splits, name)
 
 
 # Per-handle metadata. The in-place copy-back happens in the engine's

@@ -396,20 +396,151 @@ def test_inplace_through_temporary_data_wrapper():
 
 def test_torch_alltoall_ragged():
     """Torch-surface alltoall with splits (later-horovod signature): torch
-    tensors in, per-rank uneven routing, torch tensor out."""
+    tensors in, per-rank uneven routing, ``(output, received_splits)`` out."""
     def fn():
         r, w = hvd.rank(), hvd.size()
         splits = [r + d + 1 for d in range(w)]
         rows = []
         for d in range(w):
             rows += [[100.0 * r + d]] * splits[d]
-        out = hvd.alltoall(torch.tensor(rows), splits=torch.tensor(splits),
-                           name="t_a2av")
+        out, rsplits = hvd.alltoall(torch.tensor(rows),
+                                    splits=torch.tensor(splits),
+                                    name="t_a2av")
         exp = []
         for src in range(w):
             exp += [[100.0 * src + r]] * (src + r + 1)
         assert isinstance(out, torch.Tensor)
         assert torch.allclose(out, torch.tensor(exp))
+        # received_splits[src] = rows that came from src = src's splits[r]
+        assert rsplits.tolist() == [src + r + 1 for src in range(w)]
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_allreduce_grad():
+    """Reference `test/test_torch.py:415-443` (test_horovod_allreduce_grad):
+    d(sum-allreduce)/dx = ones * world for a mid-graph collective — the
+    silent-detach regression this guards against returned zeros."""
+    def fn():
+        w = hvd.size()
+        for dim in (1, 2, 3):
+            torch.manual_seed(1234)
+            t = torch.rand(*([5] * dim), dtype=torch.float64)
+            t.requires_grad_()
+            summed = hvd.allreduce(t, name=f"g_ar{dim}", op=hvd.Sum)
+            summed.backward(torch.ones([5] * dim, dtype=torch.float64))
+            expected = np.ones([5] * dim) * w
+            assert np.allclose(t.grad.numpy(), expected), t.grad
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_allreduce_grad_average():
+    """Reference test_horovod_allreduce_grad_average: averaged collective
+    back-propagates ones (N ranks each contribute dy/N)."""
+    def fn():
+        t = torch.rand(4, 3, dtype=torch.float64, requires_grad=True)
+        avg = hvd.allreduce(t, name="g_ar_avg", op=hvd.Average)
+        avg.backward(torch.ones(4, 3, dtype=torch.float64))
+        assert np.allclose(t.grad.numpy(), np.ones((4, 3))), t.grad
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_allreduce_grad_midgraph():
+    """A collective INSIDE the forward (the reference's tested contract):
+    loss = sum(allreduce(x * 2)); dloss/dx = 2 * world on every rank."""
+    def fn():
+        w = hvd.size()
+        x = torch.rand(3, 3, dtype=torch.float64, requires_grad=True)
+        y = hvd.allreduce(x * 2, name="g_ar_mid", op=hvd.Sum)
+        y.sum().backward()
+        assert np.allclose(x.grad.numpy(), np.full((3, 3), 2.0 * w)), x.grad
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_allgather_grad():
+    """Reference test_horovod_allgather_grad: ragged per-rank dim0; each
+    rank's gradient is the slice of the summed incoming gradient at its own
+    offset."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        d0 = r + 2  # ragged
+        t = torch.rand(d0, 3, dtype=torch.float64, requires_grad=True)
+        g = hvd.allgather(t, name="g_ag")
+        assert g.shape[0] == sum(src + 2 for src in range(w))
+        # upstream gradient = source-rank index per row
+        dy = torch.cat([torch.full((src + 2, 3), float(src + 1),
+                                   dtype=torch.float64)
+                        for src in range(w)])
+        g.backward(dy)
+        # every rank applies the same dy, so the sum-allreduce multiplies
+        # this rank's slice by world
+        assert np.allclose(t.grad.numpy(),
+                           np.full((d0, 3), float(r + 1) * w)), t.grad
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_broadcast_grad():
+    """Reference test_horovod_broadcast_grad: root accumulates every rank's
+    gradient; non-root gets zeros."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        root = 0
+        t = torch.rand(3, 2, dtype=torch.float64, requires_grad=True)
+        b = hvd.broadcast(t, root_rank=root, name="g_bc")
+        b.backward(torch.ones(3, 2, dtype=torch.float64))
+        expected = np.full((3, 2), float(w)) if r == root else np.zeros((3, 2))
+        assert np.allclose(t.grad.numpy(), expected), t.grad
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_alltoall_grad():
+    """Equal-split alltoall is self-adjoint: backward routes each gradient
+    block back to its source, so grad == dy blocks re-exchanged."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        t = torch.rand(2 * w, 3, dtype=torch.float64, requires_grad=True)
+        out = hvd.alltoall(t, name="g_a2a")
+        # dy rows all carry this rank's id; the adjoint exchange returns
+        # each block to its sender, so grad block d carries rank d's id
+        dy = torch.cat([torch.full((2, 3), float(r), dtype=torch.float64)
+                        for _ in range(w)])
+        out.backward(dy)
+        exp = np.concatenate([np.full((2, 3), float(d)) for d in range(w)])
+        assert np.allclose(t.grad.numpy(), exp), t.grad
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_alltoallv_grad():
+    """Ragged alltoall gradient: the adjoint exchange uses received_splits,
+    so each rank recovers a gradient shaped like its input."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        splits = [r + d + 1 for d in range(w)]
+        n = sum(splits)
+        t = torch.rand(n, 2, dtype=torch.float64, requires_grad=True)
+        out, rsplits = hvd.alltoall(t, splits=splits, name="g_a2av")
+        assert rsplits.tolist() == [src + r + 1 for src in range(w)]
+        # dy rows all carry this rank's id; the adjoint returns each chunk
+        # to its sender, so grad chunk d (splits[d] rows) carries value d
+        out.backward(torch.full(tuple(out.shape), float(r),
+                                dtype=torch.float64))
+        exp = np.concatenate([np.full((splits[d], 2), float(d))
+                              for d in range(w)])
+        assert t.grad.shape == t.shape
+        assert np.allclose(t.grad.numpy(), exp), t.grad
         return True
 
     assert all(testing.run_cluster(fn, np=2))
