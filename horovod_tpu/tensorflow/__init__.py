@@ -97,12 +97,117 @@ def _from_result(result, like):
     return t.convert_to_tensor(np.asarray(result), dtype=like.dtype)
 
 
+def _eager_allreduce(tensor, op, name):
+    """Differentiable eager engine allreduce: ``tf.custom_gradient`` attaches
+    the shared reference-formula gradient (`_grads.allreduce_grad`,
+    reference `tensorflow/mpi_ops.py:107-118`) so eager ``tf.GradientTape``
+    through a mid-graph collective matches the reference."""
+    t = _require_tf()
+    from . import _grads
+
+    @t.custom_gradient
+    def fwd(x):
+        y = _from_result(
+            _ops.synchronize(_ops.allreduce_async(_to_numpy(x), name=name,
+                                                  op=op)), x)
+
+        def grad(dy):
+            return _grads.allreduce_grad(
+                dy, op, lambda d, o: _eager_allreduce(d, o, None))
+
+        return y, grad
+
+    return fwd(tensor)
+
+
+def _eager_allgather(tensor, name):
+    t = _require_tf()
+    from . import _grads
+
+    @t.custom_gradient
+    def fwd(x):
+        y = _from_result(
+            _ops.synchronize(_ops.allgather_async(_to_numpy(x), name=name)),
+            x)
+
+        def grad(dy):
+            return _grads.allgather_grad(
+                dy, x, rank(),
+                lambda d, o: _eager_allreduce(d, o, None),
+                lambda d: _from_result(
+                    _ops.synchronize(_ops.allgather_async(_to_numpy(d))), d))
+
+        return y, grad
+
+    return fwd(tensor)
+
+
+def _eager_broadcast(tensor, root_rank, name):
+    t = _require_tf()
+    from . import _grads
+
+    @t.custom_gradient
+    def fwd(x):
+        y = _from_result(
+            _ops.synchronize(_ops.broadcast_async(_to_numpy(x), root_rank,
+                                                  name=name)), x)
+
+        def grad(dy):
+            return _grads.broadcast_grad(
+                dy, root_rank, rank(),
+                lambda d, o: _eager_allreduce(d, o, None))
+
+        return y, grad
+
+    return fwd(tensor)
+
+
+def _eager_alltoall(tensor, splits, name):
+    t = _require_tf()
+    from . import _grads
+
+    if splits is None:
+        @t.custom_gradient
+        def fwd(x):
+            y = _from_result(
+                _ops.synchronize(_ops.alltoall_async(_to_numpy(x),
+                                                     name=name)), x)
+
+            def grad(dy):
+                return _grads.alltoall_grad(
+                    dy, lambda d: _eager_alltoall(d, None, None))
+
+            return y, grad
+
+        return fwd(tensor)
+
+    sp = tuple(int(s) for s in np.asarray(splits).reshape(-1))
+
+    @t.custom_gradient
+    def fwdv(x):
+        res = _ops.synchronize(
+            _ops.alltoall_async(_to_numpy(x), splits=sp, name=name))
+        y = _from_result(res.output, x)
+        rs = t.constant(res.received_splits, dtype=t.int32)
+
+        def grad(dy, unused_drs):
+            return _grads.alltoallv_grad(
+                dy, rs, lambda d, s: _eager_alltoall(d, s, None))
+
+        return (y, rs), grad
+
+    return fwdv(tensor)
+
+
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None, compression=Compression.none,
               op: Optional[int] = None):
     """Eager allreduce (`tensorflow/__init__.py:44-118`): compress → engine →
     decompress; Average division happens in-framework (:117). Passing both
     ``average`` and ``op`` is rejected, as in the reference (:51-55).
+    Differentiable under eager ``tf.GradientTape`` with the reference's
+    registered gradient (`tensorflow/mpi_ops.py:107-118`); the compression
+    casts are tf ops, so the gradient flows through them too.
 
     A ``tf.IndexedSlices`` input takes the sparse path (:75-91): two
     allgathers (values + indices) instead of a dense reduce, Average divides
@@ -130,52 +235,44 @@ def allreduce(tensor, average: Optional[bool] = None,
             *_start_grad(tensor, name, compression, op_, False),
             compression, op_)
     comp, ctx = compression.compress(tensor)
-    out = _from_result(
-        _ops.synchronize(_ops.allreduce_async(_to_numpy(comp), name=name,
-                                              op=op_)), comp)
+    out = _eager_allreduce(comp, op_, name)
     return compression.decompress(out, ctx)
 
 
 def allgather(tensor, name: Optional[str] = None):
+    """Differentiable allgather (`tensorflow/mpi_ops.py:140-163` gradient)."""
     t = _require_tf()
     if not t.executing_eagerly():
         from . import graph as _graph
         return _graph.allgather(tensor, name=name)
-    return _from_result(
-        _ops.synchronize(_ops.allgather_async(_to_numpy(tensor), name=name)),
-        tensor)
+    return _eager_allgather(tensor, name)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    """Differentiable broadcast (`tensorflow/mpi_ops.py:183-198` gradient)."""
     t = _require_tf()
     if not t.executing_eagerly():
         from . import graph as _graph
         return _graph.broadcast(tensor, root_rank, name=name)
-    return _from_result(
-        _ops.synchronize(_ops.broadcast_async(_to_numpy(tensor), root_rank,
-                                              name=name)), tensor)
+    return _eager_broadcast(tensor, root_rank, name)
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):
     """Alltoall (engine extension beyond the 0.18.2 op set — the reference
     gained tf alltoall in 0.20). Without ``splits``: equal split, dim 0
     divisible by world size, rank r receives segment r from every rank.
-    With ``splits`` (length-world, summing to dim 0): ragged alltoallv
-    (eager only — a graph-mode alltoallv would need a dynamic output
-    shape through tf.py_function, which tf.function cannot carry)."""
+    With ``splits`` (length-world, summing to dim 0): ragged alltoallv,
+    returning ``(output, received_splits)`` (later-horovod's API shape).
+    Works in both eager and graph mode — graph mode negotiates the recv
+    splits through the coordinator's send matrix, so the traced output has
+    a dynamic dim 0 and a concrete ``received_splits`` tensor.
+    Differentiable in both forms (the ragged adjoint re-exchanges with
+    ``received_splits``)."""
     t = _require_tf()
     if not t.executing_eagerly():
-        if splits is not None:
-            raise NotImplementedError(
-                "alltoall(splits=...) is eager-only on the TF surface: "
-                "the ragged output shape cannot cross a tf.function "
-                "py_function boundary.")
         from . import graph as _graph
-        return _graph.alltoall(tensor, name=name)
-    return _from_result(
-        _ops.synchronize(_ops.alltoall_async(_to_numpy(tensor),
-                                             splits=splits, name=name)),
-        tensor)
+        return _graph.alltoall(tensor, splits=splits, name=name)
+    return _eager_alltoall(tensor, splits, name)
 
 
 def join() -> int:

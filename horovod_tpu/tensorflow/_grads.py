@@ -1,0 +1,67 @@
+"""Shared collective gradient formulas — ONE implementation for both the
+eager surface (`tensorflow/__init__.py`, wrapped in ``tf.custom_gradient``)
+and the graph surface (`tensorflow/graph.py`).
+
+Reference parity: the gradient registrations in
+`horovod/tensorflow/mpi_ops.py:107-198` —
+  allreduce  → allreduce of the upstream gradient with the same op (:107-118)
+  allgather  → sum-allreduce of the upstream gradient, then slice this rank's
+               segment at the offset given by the gathered per-rank dim0
+               sizes (:140-163)
+  broadcast  → sum-allreduce, zeroed on non-root ranks (:183-198)
+  alltoall   → alltoall of the upstream gradient (self-adjoint equal-split;
+               the ragged form re-exchanges with splits = received_splits)
+
+Each formula takes the collective *callables* to use — the eager caller
+passes its engine-bridge functions, the graph caller passes its py_function
+node builders — so the math lives in exactly one place while each mode keeps
+its own transport.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from ..basics import Average, Sum
+
+
+def allreduce_grad(dy, op, allreduce_fn):
+    """d(allreduce_op(x))/dx applied to dy: the same reduction of dy.
+    Adasum keeps the reference's registered sum-allreduce gradient (its
+    combine rule has no closed-form adjoint)."""
+    return allreduce_fn(dy, op if op in (Sum, Average) else Sum)
+
+
+def allgather_grad(dy, x, rank, allreduce_fn, allgather_fn):
+    """d(allgather(x))/dx applied to dy: sum-allreduce dy, slice this rank's
+    rows back out. ``x`` is the forward input (its dim0 sets the slice
+    length; per-rank dim0s may be ragged, so they are allgathered)."""
+    g = allreduce_fn(dy, Sum)
+    d0 = tf.shape(x)[0]
+    sizes = tf.stop_gradient(allgather_fn(tf.reshape(d0, [1])))
+    offset = tf.reduce_sum(sizes[:rank])
+    begin = tf.concat([[offset], tf.zeros([tf.rank(x) - 1], tf.int32)],
+                      axis=0)
+    return tf.slice(g, begin, tf.shape(x))
+
+
+def broadcast_grad(dy, root_rank, rank, allreduce_fn):
+    """d(broadcast(x, root))/dx applied to dy: every rank's output is root's
+    input, so root receives the cross-rank gradient sum and everyone else
+    zero."""
+    g = allreduce_fn(dy, Sum)
+    return g if rank == root_rank else g * 0
+
+
+def alltoall_grad(dy, alltoall_fn):
+    """Equal-split alltoall is its own adjoint (a permutation of blocks)."""
+    return alltoall_fn(dy)
+
+
+def alltoallv_grad(dy, received_splits, alltoallv_fn):
+    """Ragged adjoint: re-exchange dy with splits = the forward's received
+    splits, returning each gradient chunk to the rank that sent the
+    corresponding rows. ``alltoallv_fn(t, splits)`` must return
+    ``(output, received_splits)``; only the output is the gradient."""
+    dx, _ = alltoallv_fn(dy, received_splits)
+    return dx

@@ -59,6 +59,7 @@ import tensorflow as tf
 from .. import basics
 from ..basics import Adasum, Average, Sum
 from ..ops import collective_ops as _ops
+from . import _grads
 from .compression import Compression
 
 
@@ -135,20 +136,20 @@ def _derived_name(name: str, kind: str) -> str:
     return f"{name}.{kind}.{_next_trace_index()}"
 
 
-def _start(py_start, tensor):
-    """Engine-start node: ``py_start(np_array) -> handle``. Ordered after the
-    previous start in this graph via a control dependency (trace order =
+def _start(py_start, *tensors):
+    """Engine-start node: ``py_start(*np_arrays) -> handle``. Ordered after
+    the previous start in this graph via a control dependency (trace order =
     submission order on every rank)."""
     r = basics.rank()
 
-    def body(x):
+    def body(*xs):
         basics.set_thread_rank(r)
-        return np.int64(py_start(x.numpy()))
+        return np.int64(py_start(*[x.numpy() for x in xs]))
 
     g = tf.compat.v1.get_default_graph()
     prev = getattr(g, "_hvd_tpu_last_start", None)
     with tf.control_dependencies([prev] if prev is not None else []):
-        h = tf.py_function(body, [tensor], Tout=tf.int64)
+        h = tf.py_function(body, list(tensors), Tout=tf.int64)
     g._hvd_tpu_last_start = h
     return h
 
@@ -182,11 +183,13 @@ def _allreduce_raw(tensor, name, op=Sum, prescale=1.0, postscale=1.0):
 
         def grad(dy):
             # adjoint of y = post*reduce(pre*x) is the same scaled reduction
-            # of dy (scalars commute into the sum); Adasum keeps the
-            # reference's registered sum-allreduce gradient
-            return _allreduce_raw(dy, _derived_name(name, "grad"),
-                                  op=op if op in (Sum, Average) else Sum,
-                                  prescale=prescale, postscale=postscale)
+            # of dy (scalars commute into the sum); formula shared with the
+            # eager surface (`_grads.allreduce_grad`)
+            return _grads.allreduce_grad(
+                dy, op,
+                lambda d, o: _allreduce_raw(d, _derived_name(name, "grad"),
+                                            op=o, prescale=prescale,
+                                            postscale=postscale))
 
         return y, grad
 
@@ -241,14 +244,13 @@ def allgather(tensor, name=None):
         y = _sync(h, x.dtype, tf.TensorShape([None]).concatenate(x.shape[1:]))
 
         def grad(dy):
-            g = _allreduce_raw(dy, _derived_name(name, "grad"), op=Sum)
-            d0 = tf.shape(x)[0]
-            sizes = tf.stop_gradient(allgather(
-                tf.reshape(d0, [1]), name=_derived_name(name, "grad_sizes")))
-            offset = tf.reduce_sum(sizes[:basics.rank()])
-            begin = tf.concat(
-                [[offset], tf.zeros([tf.rank(x) - 1], tf.int32)], axis=0)
-            return tf.slice(g, begin, tf.shape(x))
+            # formula shared with the eager surface (`_grads.allgather_grad`)
+            return _grads.allgather_grad(
+                dy, x, basics.rank(),
+                lambda d, o: _allreduce_raw(d, _derived_name(name, "grad"),
+                                            op=o),
+                lambda d: allgather(d,
+                                    name=_derived_name(name, "grad_sizes")))
 
         return y, grad
 
@@ -266,27 +268,77 @@ def broadcast(tensor, root_rank, name=None):
         y = _sync(h, x.dtype, x.shape)
 
         def grad(dy):
-            g = _allreduce_raw(dy, _derived_name(name, "grad"), op=Sum)
-            return g if basics.rank() == root_rank else g * 0
+            # formula shared with the eager surface (`_grads.broadcast_grad`)
+            return _grads.broadcast_grad(
+                dy, root_rank, basics.rank(),
+                lambda d, o: _allreduce_raw(d, _derived_name(name, "grad"),
+                                            op=o))
 
         return y, grad
 
     return fwd(tensor)
 
 
-def alltoall(tensor, name=None):
-    """Graph-mode equal-split alltoall (shape-preserving); its adjoint is
-    itself, so the gradient is an alltoall of dy."""
+def alltoall(tensor, splits=None, name=None):
+    """Graph-mode alltoall. Equal-split (``splits=None``) is
+    shape-preserving and self-adjoint, so the gradient is an alltoall of dy.
+
+    With ``splits`` the ragged alltoallv form works under ``tf.function``
+    too: the coordinator negotiates the full world×world send matrix
+    (`runtime/coordinator.py`), so at RUN time the sync node knows exactly
+    how many rows arrived — the traced output carries a dynamic dim 0 plus
+    a concrete ``received_splits`` tensor (later-horovod's
+    ``(output, received_splits)`` return shape). ``splits`` may be a Python
+    sequence or a traced int tensor; values are consumed host-side inside
+    the start node. Gradient: re-exchange dy with ``received_splits``
+    (`_grads.alltoallv_grad`)."""
     name = _graph_name("alltoall", tensor) if name is None else name
 
+    if splits is None:
+        @tf.custom_gradient
+        def fwd(x):
+            h = _start(lambda a: _ops.alltoall_async(a, name=name), x)
+            y = _sync(h, x.dtype, x.shape)
+
+            def grad(dy):
+                return _grads.alltoall_grad(
+                    dy, lambda d: alltoall(d,
+                                           name=_derived_name(name, "grad")))
+
+            return y, grad
+
+        return fwd(tensor)
+
+    world = basics.size()
+    r = basics.rank()
+
     @tf.custom_gradient
-    def fwd(x):
-        h = _start(lambda a: _ops.alltoall_async(a, name=name), x)
-        y = _sync(h, x.dtype, x.shape)
+    def fwdv(x, sp):
+        h = _start(
+            lambda xx, ss: _ops.alltoall_async(
+                xx, splits=[int(v) for v in ss.reshape(-1)], name=name),
+            x, sp)
 
-        def grad(dy):
-            return alltoall(dy, name=_derived_name(name, "grad"))
+        def sync_body(hh):
+            basics.set_thread_rank(r)
+            res = _ops.synchronize(int(hh.numpy()))
+            return (np.asarray(res.output),
+                    np.asarray(res.received_splits, np.int32))
 
-        return y, grad
+        y, rs = tf.py_function(sync_body, [h], Tout=[x.dtype, tf.int32])
+        y.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
+        rs.set_shape([world])
 
-    return fwd(tensor)
+        def grad(dy, unused_drs):
+            dx = _grads.alltoallv_grad(
+                dy, rs,
+                lambda d, s: alltoall(d, splits=s,
+                                      name=_derived_name(name, "grad")))
+            return dx, None
+
+        return (y, rs), grad
+
+    sp_t = tf.convert_to_tensor(splits)
+    if sp_t.dtype != tf.int32:  # accept int64 splits tensors like the
+        sp_t = tf.cast(sp_t, tf.int32)  # eager/torch surfaces do
+    return fwdv(tensor, sp_t)

@@ -101,29 +101,145 @@ def test_tf_tape_none_gradient_passthrough():
     assert all(testing.run_cluster(fn, np=2))
 
 
-def test_tf_alltoall_ragged_eager_and_graph_gate():
-    """TF-surface alltoall with splits: eager routes through the engine;
-    graph mode rejects splits with an actionable error (the ragged output
-    shape cannot cross a tf.function py_function boundary)."""
+def test_tf_alltoall_ragged_eager_and_graph():
+    """TF-surface alltoall with splits: ``(output, received_splits)`` in
+    BOTH eager and graph mode — the graph path negotiates recv splits
+    through the coordinator's send matrix, so the traced output carries a
+    dynamic dim 0 (VERDICT r4 #4)."""
     def fn():
         r, w = hvd.rank(), hvd.size()
         splits = [r + d + 1 for d in range(w)]
         rows = []
         for d in range(w):
             rows += [[100.0 * r + d]] * splits[d]
-        out = hvd.alltoall(tf.constant(rows), splits=np.asarray(splits),
-                           name="tf_a2av")
+        out, rsplits = hvd.alltoall(tf.constant(rows),
+                                    splits=np.asarray(splits),
+                                    name="tf_a2av")
         exp = []
         for src in range(w):
             exp += [[100.0 * src + r]] * (src + r + 1)
         np.testing.assert_allclose(out.numpy(), np.asarray(exp, np.float32))
+        assert rsplits.numpy().tolist() == [src + r + 1 for src in range(w)]
 
         @tf.function
-        def graph_a2av(x):
-            return hvd.alltoall(x, splits=[2, 2], name="tf_a2av_g")
+        def graph_a2av(x, sp):
+            y, rs = hvd.alltoall(x, splits=sp, name="tf_a2av_g")
+            # the traced output must be usable downstream (dynamic dim 0)
+            return y * 2.0, rs
 
-        with pytest.raises(Exception, match="eager-only"):
-            graph_a2av(tf.zeros((4, 1)))
+        y2, rs2 = graph_a2av(tf.constant(rows, tf.float32),
+                             tf.constant(splits, tf.int32))
+        np.testing.assert_allclose(y2.numpy(),
+                                   2 * np.asarray(exp, np.float32))
+        assert rs2.numpy().tolist() == [src + r + 1 for src in range(w)]
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_eager_allreduce_grad():
+    """Reference `test/test_tensorflow.py:385-459` (test_horovod_allreduce
+    _grad, eager half): d(sum-allreduce)/dx under eager tf.GradientTape is
+    ones * world — the silent numpy-detach regression returned None."""
+    def fn():
+        w = hvd.size()
+        for dim in (1, 2, 3):
+            x = tf.Variable(tf.random.uniform([5] * dim, seed=1234,
+                                              dtype=tf.float64))
+            with tf.GradientTape() as tape:
+                summed = hvd.allreduce(x, op=hvd.Sum, name=f"eg_ar{dim}")
+            grad = tape.gradient(summed, x)
+            assert grad is not None, "allreduce detached from the tape"
+            np.testing.assert_allclose(grad.numpy(),
+                                       np.ones([5] * dim) * w)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_eager_allreduce_grad_average():
+    def fn():
+        x = tf.Variable(tf.random.uniform([4, 3], dtype=tf.float64))
+        with tf.GradientTape() as tape:
+            avg = hvd.allreduce(x, op=hvd.Average, name="eg_ar_avg")
+        grad = tape.gradient(avg, x)
+        np.testing.assert_allclose(grad.numpy(), np.ones([4, 3]))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_eager_allreduce_grad_midgraph():
+    """A collective INSIDE the forward — loss = sum(allreduce(x*2)):
+    dloss/dx = 2 * world."""
+    def fn():
+        w = hvd.size()
+        x = tf.Variable(tf.random.uniform([3, 3], dtype=tf.float64))
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(
+                hvd.allreduce(x * 2.0, op=hvd.Sum, name="eg_ar_mid"))
+        grad = tape.gradient(loss, x)
+        np.testing.assert_allclose(grad.numpy(), np.full([3, 3], 2.0 * w))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_eager_allgather_grad():
+    """Reference `test/test_tensorflow.py:684-801` (allgather grad, eager):
+    ragged per-rank dim0; gradient = this rank's slice of the summed
+    upstream gradient."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        d0 = r + 2
+        x = tf.Variable(tf.random.uniform([d0, 3], dtype=tf.float64))
+        with tf.GradientTape() as tape:
+            g = hvd.allgather(x, name="eg_ag")
+        dy = tf.concat([tf.fill([src + 2, 3],
+                                tf.constant(float(src + 1), tf.float64))
+                        for src in range(w)], axis=0)
+        grad = tape.gradient(g, x, output_gradients=dy)
+        assert grad is not None, "allgather detached from the tape"
+        np.testing.assert_allclose(grad.numpy(),
+                                   np.full([d0, 3], float(r + 1) * w))
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_eager_broadcast_grad():
+    """Reference eager broadcast grad: root sums every rank's gradient,
+    non-root gets zeros."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        x = tf.Variable(tf.random.uniform([3, 2], dtype=tf.float64))
+        with tf.GradientTape() as tape:
+            b = hvd.broadcast(x, root_rank=0, name="eg_bc")
+        grad = tape.gradient(b, x)
+        assert grad is not None, "broadcast detached from the tape"
+        exp = np.full([3, 2], float(w)) if r == 0 else np.zeros([3, 2])
+        np.testing.assert_allclose(grad.numpy(), exp)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_eager_alltoallv_grad():
+    """Ragged alltoall gradient under eager GradientTape: the adjoint
+    re-exchange with received_splits recovers an input-shaped gradient."""
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        splits = [r + d + 1 for d in range(w)]
+        n = sum(splits)
+        x = tf.Variable(tf.random.uniform([n, 2], dtype=tf.float64))
+        with tf.GradientTape() as tape:
+            out, rsplits = hvd.alltoall(x, splits=splits, name="eg_a2av")
+        dy = tf.fill(tf.shape(out), tf.constant(float(r), tf.float64))
+        grad = tape.gradient(out, x, output_gradients=dy)
+        assert grad is not None
+        exp = np.concatenate([np.full((splits[d], 2), float(d))
+                              for d in range(w)])
+        np.testing.assert_allclose(grad.numpy(), exp)
         return True
 
     assert all(testing.run_cluster(fn, np=2))

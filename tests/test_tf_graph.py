@@ -297,6 +297,45 @@ def test_alltoall_eager_and_graph_with_gradient():
     assert all(testing.run_cluster(fn, np=2))
 
 
+def test_graph_alltoallv_gradient_ragged():
+    """Ragged alltoall under tf.function, differentiated: recv splits are
+    negotiated at run time (VERDICT r4 #4), and the adjoint re-exchange
+    with received_splits recovers an input-shaped gradient."""
+
+    def fn():
+        r, w = hvd.rank(), hvd.size()
+        splits = [r + d + 1 for d in range(w)]
+        n = sum(splits)
+        rows = []
+        for d in range(w):
+            rows += [[100.0 * r + d]] * splits[d]
+
+        @tf.function
+        def step(x, sp):
+            with tf.GradientTape() as tape:
+                tape.watch(x)
+                y, rs = hvd.alltoall(x, splits=sp, name="g_a2av")
+                # dy rows all carry this rank's id
+                loss = tf.reduce_sum(y) * float(r)
+            return y, rs, tape.gradient(loss, x)
+
+        y, rs, g = step(tf.constant(rows, tf.float32),
+                        tf.constant(splits, tf.int32))
+        exp = []
+        for src in range(w):
+            exp += [[100.0 * src + r]] * (src + r + 1)
+        np.testing.assert_allclose(y.numpy(), np.asarray(exp, np.float32))
+        assert rs.numpy().tolist() == [src + r + 1 for src in range(w)]
+        # grad chunk d (splits[d] rows) came back from rank d carrying d
+        gexp = np.concatenate([np.full((splits[d], 1), float(d), np.float32)
+                               for d in range(w)])
+        assert g.shape == (n, 1)
+        np.testing.assert_allclose(g.numpy(), gexp)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
 def test_keras_jit_compile_true_fails_fast():
     """jit_compile=True cannot work (host engine ops are not XLA ops); the
     broadcast callback turns the cryptic XLA failure into an early error."""
