@@ -61,6 +61,15 @@ stage "launcher smoke: ragged alltoall routing across 4 processes"
 python examples/alltoallv_routing.py
 
 if [ "$QUICK" != "quick" ]; then
+  # outside quick mode: the 2-process run jit-compiles ResNet-50 on CPU,
+  # the slowest single stage (unit tests already cover the pipeline)
+  stage "real-data input pipeline: rank-sharded image folder across 2 processes"
+  rm -rf /tmp/hvd_ci_imgfolder
+  python bin/hvdrun -np 2 --no-nic-discovery \
+      python examples/imagenet_resnet50_realdata.py \
+      --data-dir /tmp/hvd_ci_imgfolder --synthesize 48 \
+      --image-size 32 --batch-size 4 --epochs 1
+
   stage "benchmarks: scaling + allreduce microbench (virtual 8-device mesh)"
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python benchmarks/scaling_bench.py --world-sizes 1,8 \

@@ -122,7 +122,7 @@ setup(
     extras_require={
         "flax": ["flax", "optax"],
         "torch": ["torch"],
-        "test": ["pytest", "flax", "optax"],
+        "test": ["pytest", "flax", "optax", "Pillow"],
     },
     entry_points={
         "console_scripts": [
