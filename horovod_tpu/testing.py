@@ -137,9 +137,10 @@ def hier_dryrun_worker():
     outs["ag"] = np.asarray(C.synchronize(g)).tolist()
     splits = [(r + d) % 2 + 1 for d in range(w)]
     rows = [[10.0 * r + d] for d in range(w) for _ in range(splits[d])]
-    outs["a2av"] = np.asarray(
-        C.alltoall(np.asarray(rows, np.float32), splits=splits,
-                   name="hdv")).tolist()
+    a2av_out, a2av_rs = C.alltoall(np.asarray(rows, np.float32),
+                                   splits=splits, name="hdv")
+    outs["a2av"] = np.asarray(a2av_out).tolist()
+    outs["a2av_rs"] = np.asarray(a2av_rs).tolist()
     # report whether the executor REALLY took the two-level path, so the
     # gate can reject a vacuous flat-vs-flat comparison
     ex = basics._engine()._executor
@@ -173,3 +174,24 @@ def autotune_dryrun_worker():
     for t in range(10):
         outs = round_(t)
     return (basics.rank(), outs, start, eng.controller.fusion_threshold())
+
+
+def adasum_dryrun_worker():
+    """Driver-gate leg body (BASELINE tracked config 5): eager Adasum
+    allreduce across 2 real processes through the coordinated engine —
+    once plain f32 and once through fp16 wire compression. Returns the
+    inputs and outputs so the gate pins the combine against the NumPy
+    VHDD oracle (`adasum/adasum.h:185-329` semantics)."""
+    import numpy as np
+
+    from . import basics
+    from .ops import collective_ops as C
+    from .ops.compression import Compression
+
+    r = basics.rank()
+    rng = np.random.RandomState(7 + r)
+    x = rng.randn(257).astype(np.float32)
+    plain = np.asarray(C.allreduce(x, name="adsm", op=basics.Adasum))
+    comp = np.asarray(C.allreduce(x, name="adsm16", op=basics.Adasum,
+                                  compression=Compression.fp16))
+    return (r, x.tolist(), plain.tolist(), comp.tolist())
