@@ -136,11 +136,23 @@ def main():
 
     if chunked:
         chunk_tokens = int(os.environ.get("LM_LOSS_CHUNK", "2048"))
+        loss_unroll = int(os.environ.get("LM_LOSS_UNROLL", "1"))
 
         def loss_fn(p, x, y):
             hid = model.apply({"params": p}, x, return_hidden=True)
             return lm_loss_chunked(hid, p["tok_emb"]["embedding"], y,
-                                   chunk_tokens=chunk_tokens)
+                                   chunk_tokens=chunk_tokens,
+                                   unroll=loss_unroll)
+    elif os.environ.get("LM_HEAD_BF16", "0") == "1":
+        # unchunked full-logit loss, but the weight-tied head matmul in
+        # bf16 with f32 accumulation (the MXU-native contraction the
+        # chunked path uses) instead of the model's f32 attend
+        def loss_fn(p, x, y):
+            hid = model.apply({"params": p}, x, return_hidden=True)
+            emb_t = p["tok_emb"]["embedding"].astype(jnp.bfloat16).T
+            logits = jnp.dot(hid.astype(jnp.bfloat16), emb_t,
+                             preferred_element_type=jnp.float32)
+            return lm_loss(logits, y)
     else:
         def loss_fn(p, x, y):
             return lm_loss(model.apply({"params": p}, x), y)

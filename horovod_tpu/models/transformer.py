@@ -205,7 +205,8 @@ def lm_loss(logits, targets):
     return -jnp.mean(ll)
 
 
-def lm_loss_chunked(hidden, emb_table, targets, chunk_tokens=2048):
+def lm_loss_chunked(hidden, emb_table, targets, chunk_tokens=2048,
+                    unroll=1):
     """Weight-tied-head cross entropy WITHOUT materializing [B, T, vocab].
 
     ``hidden``: final hidden states from ``apply(..., return_hidden=True)``;
@@ -248,7 +249,11 @@ def lm_loss_chunked(hidden, emb_table, targets, chunk_tokens=2048):
         ll = jnp.take_along_axis(logp, yc[:, None], axis=-1)[:, 0]
         return acc + jnp.sum(ll * wc), None
 
-    total_ll, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, y, w))
+    # unroll>1 replicates the body inside the loop so XLA can overlap one
+    # chunk's head matmul with the next chunk's operand DMA (the loop
+    # boundary is otherwise a scheduling barrier each iteration)
+    total_ll, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, y, w),
+                               unroll=max(1, min(unroll, n)))
     return -total_ll / total
 
 
