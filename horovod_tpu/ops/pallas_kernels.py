@@ -219,6 +219,46 @@ def _pick_bh_block(bh: int, per_g_bytes: int = 0, cap: int = 0) -> int:
 
 
 # =========================================================== flash attention
+def _flash_accum(q, k_ref, v_ref, g, hi, m, l, o, *, q_off, k_off, causal,
+                 scale, block_k):
+    """Online-softmax accumulation of q against k/v blocks ``[0, hi)`` of
+    slice ``g`` — THE shared inner body of the ring-step and single-shot
+    forward kernels (one copy, so the base-2/masked-row convention cannot
+    drift between them; the backward recompute depends on it). ``m`` is in
+    base-2 units; dot operands stay in the input dtype (bf16 models run
+    the MXU at bf16 rate), accumulation is f32."""
+    bq = q.shape[0]
+    in_dt = q.dtype
+
+    def body(j, carry):
+        m, l, o = carry
+        k = k_ref[g, pl.ds(j * block_k, block_k), :]
+        v = v_ref[g, pl.ds(j * block_k, block_k), :]
+        # [BQ, BK] base-2 logits on the MXU; scale on the f32 result
+        s = (scale * _LOG2E) * lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos = (k_off + j * block_k
+                    + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp2(s - m_safe[:, None])             # exp2(-inf) == 0
+        alpha = jnp.exp2(m - m_safe)                  # m=-inf -> 0
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = lax.dot_general(p.astype(in_dt), v,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        o_new = o * alpha[:, None] + pv
+        return m_new, l_new, o_new
+
+    return lax.fori_loop(0, hi, body, (m, l, o))
+
+
 def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
                        mo_ref, lo_ref, oo_ref, *, causal, scale, block_k):
     """G q-tiles (G = bh-block, statically unrolled) of flash accumulation,
@@ -233,9 +273,6 @@ def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
     iq = pl.program_id(1)
     bq = q_ref.shape[1]
     tk = k_ref.shape[1]
-    # dot operands stay in the INPUT dtype (bf16 models run the MXU at bf16
-    # rate, f32 inputs stay exact); accumulation is always f32
-    in_dt = q_ref.dtype
     q_off = offs_ref[0] + iq * bq
     k_off = offs_ref[1]
 
@@ -253,37 +290,92 @@ def _flash_step_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
         m = m_ref[g, :, 0].astype(jnp.float32) * _LOG2E   # [BQ]
         l = l_ref[g, :, 0].astype(jnp.float32)
         o = o_ref[g].astype(jnp.float32)              # [BQ, D]
-
-        def body(j, carry, q=q):
-            m, l, o = carry
-            k = k_ref[g, pl.ds(j * block_k, block_k), :]
-            v = v_ref[g, pl.ds(j * block_k, block_k), :]
-            # [BQ, BK] base-2 logits on the MXU; scale on the f32 result
-            s = (scale * _LOG2E) * lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            if causal:
-                qpos = q_off + lax.broadcasted_iota(
-                    jnp.int32, (bq, block_k), 0)
-                kpos = (k_off + j * block_k
-                        + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            m_blk = jnp.max(s, axis=-1)
-            m_new = jnp.maximum(m, m_blk)
-            m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
-            p = jnp.exp2(s - m_safe[:, None])         # exp2(-inf) == 0
-            alpha = jnp.exp2(m - m_safe)              # m=-inf -> 0
-            l_new = l * alpha + jnp.sum(p, axis=-1)
-            pv = lax.dot_general(p.astype(in_dt), v,
-                                 (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-            o_new = o * alpha[:, None] + pv
-            return m_new, l_new, o_new
-
-        m, l, o = lax.fori_loop(0, hi, body, (m, l, o))
+        m, l, o = _flash_accum(q, k_ref, v_ref, g, hi, m, l, o,
+                               q_off=q_off, k_off=k_off, causal=causal,
+                               scale=scale, block_k=block_k)
         mo_ref[g, :, 0] = m * _LN2                    # back to natural units
         lo_ref[g, :, 0] = l
         oo_ref[g] = o
+
+
+def _flash_fwd_once_kernel(offs_ref, q_ref, k_ref, v_ref, oo_ref, lse_ref,
+                           *, causal, scale, block_k):
+    """Single-shot forward: the resident step kernel minus the ring-carry
+    plumbing. No (m, l, o) stream in — the statistics initialize in
+    registers — and the output is NORMALIZED in-kernel (FlashAttention-2
+    epilogue) and written in the input dtype beside the f32 row-LSE the
+    backward consumes. Per call this halves HBM traffic vs the step kernel
+    (~65 MB vs ~130 MB at the GPT-2-medium bench shapes: no f32 o in/out,
+    no m/l streams) and retires the separate finalize fusion + zero-init
+    copies (measured breakdown in docs/benchmarks.md round 5)."""
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    q_off = offs_ref[0] + iq * bq
+    k_off = offs_ref[1]
+
+    nk = tk // block_k
+    if causal:
+        hi = jnp.clip((q_off + bq - k_off + block_k - 1) // block_k, 0, nk)
+    else:
+        hi = nk
+
+    for g in range(q_ref.shape[0]):
+        q = q_ref[g]                                  # [BQ, D]
+        m = jnp.full((bq,), NEG_INF, jnp.float32)
+        l = jnp.zeros((bq,), jnp.float32)
+        o = jnp.zeros((bq, q_ref.shape[2]), jnp.float32)
+        m, l, o = _flash_accum(q, k_ref, v_ref, g, hi, m, l, o,
+                               q_off=q_off, k_off=k_off, causal=causal,
+                               scale=scale, block_k=block_k)
+        # the _masked_row_stats convention, fused into the epilogue:
+        # l == 0 -> out 0, lse sentinel log(1) on top of a zeroed m
+        l_safe = jnp.where(l == 0, 1.0, l)
+        oo_ref[g] = (o / l_safe[:, None]).astype(oo_ref.dtype)
+        m_nat = jnp.where(m == NEG_INF, 0.0, m * _LN2)
+        lse_ref[g, :, 0] = m_nat + jnp.log(l_safe)
+
+
+def _flash_fwd_once_call(qt, kt, vt, offs, *, causal, scale, block_q,
+                         block_k, interpret):
+    """Resident-layout dispatch of the single-shot forward.
+    qt: [BH, TQ, D]; kt/vt: [BH, TK, D] → (out [BH, TQ, D] in qt.dtype,
+    lse [BH, TQ, 1] f32). Caller guarantees the resident budget."""
+    bh, tq, d = qt.shape
+    tk = kt.shape[1]
+    it = kt.dtype.itemsize
+    # same footprint model as the step call, minus the carried f32 o tile
+    per_g = (2 * tk * d * it + block_q * block_k * 4
+             + 2 * block_q * d * 4)
+    g = _pick_bh_block(bh, per_g, _BH_VMEM_CAP)
+    grid = (bh // g, tq // block_q)
+    return pl.pallas_call(
+        functools.partial(_flash_fwd_once_kernel, causal=causal,
+                          scale=scale, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, tk, d), lambda i, j, offs: (i, 0, 0)),
+                pl.BlockSpec((g, tk, d), lambda i, j, offs: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((g, block_q, d), lambda i, j, offs: (i, j, 0)),
+                pl.BlockSpec((g, block_q, 1), lambda i, j, offs: (i, j, 0)),
+            ],
+        ),
+        out_shape=[
+            _struct((bh, tq, d), qt.dtype, qt, kt, offs),
+            _struct((bh, tq, 1), jnp.float32, qt, kt, offs),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * tq * tk * d,
+            bytes_accessed=2 * (2 * bh * tq * d + 2 * bh * tk * d),
+            transcendentals=bh * tq * tk),
+        compiler_params=_input_fusion(_sem_par2_res(), 3),
+        interpret=interpret,
+    )(offs, qt, kt, vt)
 
 
 def _flash_step_stream_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
@@ -750,11 +842,21 @@ def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
     and non-causal alike at the cost of nk-1 redundant tile writes.
 
     Single-k-sweep fast path (nk == 1, e.g. the seq-1024 headline config):
-    dq completes within one cell, so the dispatch allocates NO scratch
-    (``maybe_acc`` empty) and the kernel writes dq directly — skipping a
-    read-modify-write plus a flush copy of the tile per cell."""
-    dq_acc = maybe_acc[0] if maybe_acc else None
+    dq completes within one cell, so the dispatch allocates NO dq scratch
+    and the kernel writes dq directly — skipping a read-modify-write plus
+    a flush copy of the tile per cell.
+
+    Gradients leave the kernel in the INPUT dtype: accumulation stays f32
+    (dk/dv in the per-cell VMEM scratch pair, consecutive iq revisits),
+    cast once at the final write — a bf16 model never round-trips 3x f32
+    gradient tensors through HBM plus three XLA cast fusions (measured
+    ladder in docs/benchmarks.md round 5)."""
+    if len(maybe_acc) == 3:
+        dq_acc, dk_acc, dv_acc = maybe_acc
+    else:
+        dq_acc, (dk_acc, dv_acc) = None, maybe_acc
     jk, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
     in_dt = q_ref.dtype  # dot operands in input dtype, f32 accumulation
     q_off = offs_ref[0] + iq * bq
@@ -767,8 +869,8 @@ def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
 
     @pl.when(iq == 0)
     def _():
-        dk_ref[0] = jnp.zeros_like(dk_ref[0])
-        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
     live = (q_off + bq - 1 >= k_off) if causal else True
 
@@ -794,29 +896,38 @@ def _flash_bwd_fused_kernel(offs_ref, lse_ref, dd_ref, q_ref, k_ref, v_ref,
             kpos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.exp2(s - lse)                         # exp2(-inf) == 0
-        dv_ref[0] += lax.dot_general(p.astype(in_dt), do,
-                                     (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        dv_acc[...] += lax.dot_general(p.astype(in_dt), do,
+                                       (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = (p * (dp - dd) * scale).astype(in_dt)
-        dk_ref[0] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        dk_acc[...] += lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
         dq_contrib = lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
         if dq_acc is None:
-            dq_ref[0] = dq_contrib
+            dq_ref[0] = dq_contrib.astype(dq_ref.dtype)
         else:
             dq_acc[pl.ds(iq * bq, bq), :] += dq_contrib
 
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
     if dq_acc is not None:
-        dq_ref[0] = dq_acc[pl.ds(iq * bq, bq), :]
+        dq_ref[0] = dq_acc[pl.ds(iq * bq, bq), :].astype(dq_ref.dtype)
 
 
 def _flash_bwd_fused(qt, kt, vt, dot, lset, ddt, offs, d, *, causal, scale,
-                     block_q, block_k, interpret):
+                     block_q, block_k, interpret, out_dtype=None):
     """Dispatch of the one-pass backward (any length: k/v tiles stream
-    through the grid, dq rides the VMEM scratch)."""
+    through the grid, dq rides the VMEM scratch). ``out_dtype`` picks the
+    gradient output dtype (default f32); the ring path keeps f32 so its
+    cross-hop accumulators never ingest pre-rounded contributions, while
+    the single-device VJP requests the input dtype directly."""
+    out_dtype = jnp.float32 if out_dtype is None else out_dtype
     bh, tq = qt.shape[0], qt.shape[1]
     tk = kt.shape[1]
     _, qmap = _causal_maps(causal, block_q, block_k, tq // block_q)
@@ -841,14 +952,18 @@ def _flash_bwd_fused(qt, kt, vt, dot, lset, ddt, offs, d, *, causal, scale,
                 pl.BlockSpec((1, block_q, d), lambda i, j, n, offs: (i, n, 0)),
                 ktile, ktile,
             ],
-            # single k sweep: dq finishes inside its cell — no scratch
-            scratch_shapes=([] if tk // block_k == 1
-                            else [pltpu.VMEM((tq, d), jnp.float32)]),
+            # single k sweep: dq finishes inside its cell — no dq scratch;
+            # dk/dv always accumulate f32 in the scratch pair and cast on
+            # the final (iq == nq-1) write
+            scratch_shapes=(([] if tk // block_k == 1
+                             else [pltpu.VMEM((tq, d), jnp.float32)])
+                            + [pltpu.VMEM((block_k, d), jnp.float32),
+                               pltpu.VMEM((block_k, d), jnp.float32)]),
         ),
         out_shape=[
-            _struct((bh, tq, d), jnp.float32, qt, kt, offs),
-            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
-            _struct((bh, tk, d), jnp.float32, qt, kt, offs),
+            _struct((bh, tq, d), out_dtype, qt, kt, offs),
+            _struct((bh, tk, d), out_dtype, qt, kt, offs),
+            _struct((bh, tk, d), out_dtype, qt, kt, offs),
         ],
         cost_estimate=pl.CostEstimate(
             flops=10 * bh * tq * tk * d,  # 5 matmuls per tile pair
@@ -967,7 +1082,7 @@ def _flash_bwd(q, k, v, out, lse, dout, q_off=0, k_off=0, *, causal, scale):
 
 
 def _flash_bwd_hm(qt, kt, vt, dot, lset, ddt, q_off=0, k_off=0, *,
-                  causal, scale):
+                  causal, scale, out_dtype=None):
     """Heads-major core of :func:`_flash_bwd`: operands/grads all
     ``[BH, T, D]`` (lse/dd ``[BH, T, 1]``) so a caller that already holds
     heads-major tensors (the full-attention VJP saves its residuals that
@@ -996,7 +1111,8 @@ def _flash_bwd_hm(qt, kt, vt, dot, lset, ddt, q_off=0, k_off=0, *,
             and tq * d * 4 <= _DQ_SCRATCH_CAP):
         return _flash_bwd_fused(
             qt, kt, vt, dot, lset, ddt, offs, d, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k, interpret=interpret)
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            out_dtype=out_dtype)
 
     # Two legacy kernel layouts: whole-resident (one side of the score
     # matrix stays in VMEM; ~20% faster at short T — no tile re-fetch) and
@@ -1122,10 +1238,21 @@ def _flash_fullattn_vjp(causal: bool, scale: float):
         qt = q.transpose(0, 2, 1, 3).reshape(bh, tq, d)
         kt = k.transpose(0, 2, 1, 3).reshape(bh, tk, d)
         vt = v.transpose(0, 2, 1, 3).reshape(bh, tk, d)
+        offs = jnp.zeros((2,), jnp.int32)
+        if (tk * d * kt.dtype.itemsize <= _KV_VMEM_CAP
+                and os.environ.get("HVD_PALLAS_ONESHOT_FWD", "1") != "0"):
+            # resident shapes take the single-shot kernel: no ring-carry
+            # streams, normalized-in-kernel output (measured +6.2% on the
+            # lm_bench step at seq 1024, +4.8% at seq 8192 —
+            # docs/benchmarks.md round 5)
+            out_t, lse_t = _flash_fwd_once_call(
+                qt, kt, vt, offs, causal=causal, scale=scale,
+                block_q=_pick_block(tq, side="q"),
+                block_k=_pick_block(tk, side="k"), interpret=_interpret())
+            return qt, kt, vt, out_t, lse_t
         mt = jnp.full((bh, tq, 1), NEG_INF, jnp.float32)
         lt = jnp.zeros((bh, tq, 1), jnp.float32)
         ot = jnp.zeros((bh, tq, d), jnp.float32)
-        offs = jnp.zeros((2,), jnp.int32)
         mt, lt, ot = _flash_step_call(
             qt, kt, vt, mt, lt, ot, offs, causal=causal, scale=scale,
             block_q=_pick_block(tq, side="q"),
@@ -1156,7 +1283,8 @@ def _flash_fullattn_vjp(causal: bool, scale: float):
         ddt = jnp.sum(dot.astype(jnp.float32) * out_t.astype(jnp.float32),
                       axis=-1, keepdims=True)          # [BH, T, 1]
         dq, dk, dv = _flash_bwd_hm(qt, kt, vt, dot, lse_t, ddt,
-                                   causal=causal, scale=scale)
+                                   causal=causal, scale=scale,
+                                   out_dtype=qt.dtype)
         return (_heads_minor(dq, b, h, tq, d).astype(qt.dtype),
                 _heads_minor(dk, b, h, tk, d).astype(kt.dtype),
                 _heads_minor(dv, b, h, tk, d).astype(vt.dtype))
