@@ -61,11 +61,15 @@ def main():
     batch, seq = cfg["batch"] * hvd.num_replicas(), cfg["seq"]
 
     # perf levers (each delta measured in docs/benchmarks.md):
-    #   remat=full    — recompute block internals in backward; batch 32 fits
+    #   remat=none    — the fused backward keeps only O(T) residuals, so at
+    #                   these batch sizes full recompute is pure waste:
+    #                   none measured +24.8% over full at seq 1024 (round 5);
+    #                   'full' remains the knob for activation-bound shapes
+    #                   (e.g. batch 32, or seq 16k with the full-logit loss)
     #   chunked loss  — never materialize [B,T,vocab] fp32 logits
     #   mu_dtype=bf16 — halve AdamW first-moment HBM
     #   donation      — update params/opt state in place (no double buffer)
-    remat = os.environ.get("LM_REMAT", "full" if on_tpu else "none")
+    remat = os.environ.get("LM_REMAT", "none")
     attn = os.environ.get("LM_ATTN", "pallas")
     # loss path: "auto" takes the full-logit loss while the f32 logit
     # tensor stays under 2 GiB (measured +1.2% at the headline config —
