@@ -665,3 +665,58 @@ def test_vmem_and_fusion_knobs_resolved_per_call(monkeypatch):
     monkeypatch.setenv("HVD_PALLAS_INPUT_FUSION", "0")
     p = pk._input_fusion(pk._sem_par2_res(), 6)
     assert p.allow_input_fusion is None
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_fwd_oneshot_vs_step_path(causal, monkeypatch):
+    """The single-shot forward (`_flash_fwd_once_kernel`, the resident-
+    shape default since round 5) must agree with the ring-step + finalize
+    path it replaced — same outputs, same lse-driven backward — and the
+    `HVD_PALLAS_ONESHOT_FWD` knob must actually switch paths (read at
+    trace time, not import)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(31), 2, 256, 2, 64)
+    w = jax.random.normal(jax.random.PRNGKey(32), q.shape, q.dtype)
+
+    # spies prove which dispatch each run took (agreement alone would also
+    # pass with a dead knob)
+    calls = {"once": 0, "step": 0}
+    real_once, real_step = pk._flash_fwd_once_call, pk._flash_step_call
+
+    def spy_once(*a, **kw):
+        calls["once"] += 1
+        return real_once(*a, **kw)
+
+    def spy_step(*a, **kw):
+        calls["step"] += 1
+        return real_step(*a, **kw)
+
+    monkeypatch.setattr(pk, "_flash_fwd_once_call", spy_once)
+    monkeypatch.setattr(pk, "_flash_step_call", spy_step)
+
+    def run():
+        out = pk.flash_attention(q, k, v, causal=causal)
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(pk.flash_attention(q, k, v,
+                                                       causal=causal) * w),
+            argnums=(0, 1, 2))(q, k, v)
+        return out, g
+
+    # ONE leading cache clear only: the env flip below must take effect
+    # through the CACHED vjp object (the knob is read per trace, not
+    # captured at cache-build time)
+    pk._flash_fullattn_vjp.cache_clear()
+    monkeypatch.delenv("HVD_PALLAS_ONESHOT_FWD", raising=False)
+    out_once, g_once = run()
+    assert calls["once"] > 0 and calls["step"] == 0, calls
+
+    monkeypatch.setenv("HVD_PALLAS_ONESHOT_FWD", "0")
+    calls.update(once=0, step=0)
+    out_step, g_step = run()
+    assert calls["step"] > 0 and calls["once"] == 0, calls
+    pk._flash_fullattn_vjp.cache_clear()
+
+    np.testing.assert_allclose(np.asarray(out_once), np.asarray(out_step),
+                               rtol=1e-6, atol=1e-6)
+    for a, b in zip(g_once, g_step):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
