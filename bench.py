@@ -168,6 +168,15 @@ def main():
         # compare against the wrong denominator
         "vs_baseline": (round(per_chip / 103.55, 3)
                         if model_name == "ResNet50" else None),
+        # denominator context so the ratio cannot mislead on its own: it
+        # divides by the reference's 2017-era per-GPU number — from its
+        # ResNet-101 illustrative run, the only published figure — not a
+        # same-generation or same-model part; the roofline story lives in
+        # docs/benchmarks.md (this step runs at ~97% of v5e HBM bandwidth)
+        "baseline_denominator": (
+            "103.55 img/s per Pascal GPU, 2017, from the reference's "
+            "ResNet-101 run (docs/benchmarks.rst:43) — its only published "
+            "throughput figure" if model_name == "ResNet50" else None),
     }))
 
 
