@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from .. import basics
 from ..basics import Adasum, Average, Sum
 from ..runtime.messages import AlltoallvResult, RequestType, TensorTableEntry
+from . import compression as _compression
 from .compression import Compression
 
 _auto_counter = {}
@@ -57,7 +58,7 @@ def _commit(tensor, rank: int):
 
 def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
              average=False, prescale=1.0, postscale=1.0,
-             callback=None, splits=None) -> int:
+             callback=None, splits=None, wire: str = "") -> int:
     eng = basics._engine()
     r = basics.rank()
     entry = TensorTableEntry(
@@ -71,6 +72,7 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
         postscale_factor=postscale,
         callback=callback,
         splits=splits,
+        compression=wire,
     )
     return eng.enqueue(entry)
 
@@ -78,12 +80,21 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
 # ----------------------------------------------------------------- allreduce
 def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0, callback=None) -> int:
+                    postscale_factor: float = 1.0, callback=None,
+                    compression=None) -> int:
     """Asynchronous allreduce; returns a handle (`torch/mpi_ops.py:207-229`).
     ``callback(ok, result_or_error)`` fires on the engine thread at
     completion, before ``synchronize`` unblocks (the reference's done-
-    callback contract, `mpi_ops_v2.cc:53-79`)."""
+    callback contract, `mpi_ops_v2.cc:53-79`).
+
+    ``compression=None`` resolves the job-wide ``HOROVOD_COMPRESSION``
+    default; wire-mode compressors (``Compression.int8`` / ``int8_dcn``)
+    enqueue the tensor unchanged and negotiate the quantized wire program
+    through the control plane (cast compressors belong on the synchronous
+    ``allreduce`` wrapper, which owns the decompress side)."""
     name = _auto_name("allreduce", name)
+    if compression is None:
+        compression = _compression.from_env()
     if op == Adasum:
         if prescale_factor != 1.0 or postscale_factor != 1.0:
             raise ValueError(
@@ -93,22 +104,30 @@ def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
     return _enqueue(RequestType.ALLREDUCE, tensor, name,
                     average=(op == Average),
                     prescale=prescale_factor, postscale=postscale_factor,
-                    callback=callback)
+                    callback=callback, wire=compression.wire or "")
 
 
 def allreduce(tensor, name: Optional[str] = None, op: int = Average,
-              compression=Compression.none,
+              compression=None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0):
     """Synchronous allreduce of a named tensor across all ranks.
 
     ``op``: Average (default; sum is divided by world size inside the fused
     XLA program), Sum, or Adasum (`tensorflow/__init__.py:44-118`).
+
+    ``compression``: a ``hvd.Compression`` member (default: the
+    ``HOROVOD_COMPRESSION`` env choice, else none). fp16/bf16 cast here at
+    the framework layer; int8/int8-dcn quantize inside the executor's
+    compiled collective program (`docs/compression.md`).
     """
+    if compression is None:
+        compression = _compression.from_env()
     comp, ctx = compression.compress(jnp.asarray(tensor))
     h = allreduce_async(comp, name=name, op=op,
                         prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
+                        postscale_factor=postscale_factor,
+                        compression=compression)
     out = synchronize(h)
     return compression.decompress(out, ctx)
 

@@ -7,15 +7,129 @@ Reference parity: `horovod/tensorflow/compression.py` / `horovod/torch/compressi
 TPU-native note: on TPU the natural 16-bit wire format is **bfloat16** (MXU
 native, same exponent range as fp32 so no loss-scaling needed); ``fp16`` is
 kept for API parity and ``bf16`` added as the recommended choice.
+
+Beyond the reference's dtype casts this module owns the **block-quantized
+int8 wire format** (EQuARX-style, PAPERS.md arXiv:2506.17615): per-block
+(default 256 elements) symmetric int8 payload with one fp32 scale per
+block. Unlike the cast compressors, int8 quantization cannot run at the
+framework layer — per-rank scales don't commute with the sum — so
+``Compression.int8`` / ``Compression.int8_dcn`` are *wire markers*:
+``compress()`` is the identity and the executor lowers the
+quantize → allreduce → dequantize pipeline into its single compiled
+collective program (`runtime/executor.py`). The numerics live here
+(`quantize_blocks` / `dequantize_blocks`, jnp reference implementation
+with a Pallas kernel fast path) so tests, error feedback and the executor
+share one definition.
+
+Job-wide default: ``HOROVOD_COMPRESSION={none,fp16,bf16,int8,int8-dcn}``
+(resolved by :func:`from_env`); ``HOROVOD_INT8_BLOCK`` overrides the block
+size.
 """
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
+
+DEFAULT_BLOCK = 256
+
+
+def block_size() -> int:
+    """Quantization block length (``HOROVOD_INT8_BLOCK``, default 256)."""
+    b = int(os.environ.get("HOROVOD_INT8_BLOCK", DEFAULT_BLOCK))
+    if b <= 0:
+        raise ValueError(f"HOROVOD_INT8_BLOCK={b}: must be positive")
+    return b
+
+
+def _kernels():
+    from . import pallas_kernels
+    return pallas_kernels
+
+
+def quantize_blocks(x, block: int | None = None):
+    """Block-quantize a float array to (int8 payload, fp32 scales).
+
+    ``x`` is flattened; its length must be a multiple of ``block`` (callers
+    pad — see :func:`quantize_roundtrip` / the executor's chunk padding).
+    Returns ``(q, scales)`` with ``q`` int8 of ``x.size`` elements and
+    ``scales`` fp32 of ``x.size // block`` elements, where block ``i`` of
+    ``x`` is approximately ``q[i*block:(i+1)*block] * scales[i]``.
+    """
+    block = block or block_size()
+    flat = jnp.ravel(x).astype(jnp.float32)
+    if flat.shape[0] % block:
+        raise ValueError(
+            f"quantize_blocks: size {flat.shape[0]} not a multiple of "
+            f"block {block}")
+    x2 = flat.reshape(-1, block)
+    pk = _kernels()
+    if pk.int8_supported(x2.shape[0], block) and not pk.vma_active(x2):
+        q2, s2 = pk.int8_quantize_2d(x2)
+        return q2.reshape(-1), s2[:, 0]
+    absmax = jnp.max(jnp.abs(x2), axis=1, keepdims=True)
+    scale = absmax * (1.0 / 127.0)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q2 = jnp.clip(jnp.round(x2 / safe), -127.0, 127.0).astype(jnp.int8)
+    return q2.reshape(-1), scale[:, 0]
+
+
+def dequantize_blocks(q, scales, dtype=jnp.float32, block: int | None = None):
+    """Inverse of :func:`quantize_blocks`: int8 payload × per-block scale."""
+    block = block or block_size()
+    q2 = jnp.ravel(q).reshape(-1, block)
+    s2 = jnp.ravel(scales).astype(jnp.float32)[:, None]
+    pk = _kernels()
+    if pk.int8_supported(q2.shape[0], block) and not pk.vma_active(q2, s2):
+        y2 = pk.int8_dequantize_2d(q2, s2)
+    else:
+        y2 = q2.astype(jnp.float32) * s2
+    return y2.reshape(-1).astype(dtype)
+
+
+def quantize_roundtrip(x, block: int | None = None):
+    """Quantize→dequantize ``x`` (any shape/float dtype), padding internally.
+
+    This is the exact value the quantized wire delivers for a single-rank
+    hop; error feedback (`optim/distributed.py`) uses it to compute the
+    residual the wire dropped.
+    """
+    block = block or block_size()
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    q, s = quantize_blocks(flat, block)
+    y = dequantize_blocks(q, s, dtype=x.dtype, block=block)
+    return y[:n].reshape(x.shape)
+
+
+def wire_footprint(num_elements: int, mode: str,
+                   block: int | None = None) -> int:
+    """Bytes a fused bucket of ``num_elements`` fp32 elements moves over the
+    wire for one reduce-scatter + allgather round in the given mode
+    (``int8-dcn`` counts the quantized DCN hop — its ICI hops ride bf16).
+    """
+    per_elem = {"none": 4, "fp32": 4, "fp16": 2, "bf16": 2}.get(mode)
+    if per_elem is not None:
+        return 2 * num_elements * per_elem
+    if mode in ("int8", "int8-dcn", "int8_dcn"):
+        block = block or block_size()
+        blocks = -(-num_elements // block)
+        return 2 * (num_elements + 4 * blocks)
+    raise ValueError(f"unknown compression mode {mode!r}")
 
 
 class Compressor:
-    """Interface: compress before enqueue, decompress after completion."""
+    """Interface: compress before enqueue, decompress after completion.
+
+    ``wire`` names an in-collective wire format the executor should apply
+    (None = the wire carries whatever ``compress`` produced).
+    """
+
+    wire: str | None = None
 
     @staticmethod
     def compress(tensor):
@@ -25,6 +139,13 @@ class Compressor:
     @staticmethod
     def decompress(tensor, ctx):
         raise NotImplementedError
+
+    @classmethod
+    def roundtrip(cls, tensor):
+        """The value the wire delivers for this compressor (lossy part only;
+        used by error feedback to measure what the wire dropped)."""
+        comp, ctx = cls.compress(tensor)
+        return cls.decompress(comp, ctx)
 
 
 class NoneCompressor(Compressor):
@@ -60,9 +181,71 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class _WireCompressor(NoneCompressor):
+    """Marker: framework-level identity, executor-level quantized wire.
+
+    The tensor is enqueued unchanged; ``TensorTableEntry.compression``
+    carries ``wire`` through negotiation so every rank's executor compiles
+    the same quantize → collective → dequantize program. Integer/bool
+    tensors and buckets below the executor's size floor bypass quantization
+    inside the executor (the entry still negotiates the mode so ranks
+    agree on the program).
+    """
+
+    @classmethod
+    def roundtrip(cls, tensor):
+        if not jnp.issubdtype(jnp.asarray(tensor).dtype, jnp.floating):
+            return tensor
+        return quantize_roundtrip(tensor)
+
+
+class Int8Compressor(_WireCompressor):
+    wire = "int8"
+
+
+class Int8DcnCompressor(_WireCompressor):
+    """int8 on the slow DCN hop only; ICI hops ride bf16 (EQuARX mixed
+    mode applied to the two-level hierarchical allreduce)."""
+
+    wire = "int8-dcn"
+
+
 class Compression:
     """Parity with the reference's Compression namespace."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor  # TPU-native extension
+    int8 = Int8Compressor  # block-quantized wire (executor-fused)
+    int8_dcn = Int8DcnCompressor
+
+
+_BY_NAME = {
+    "": NoneCompressor,
+    "none": NoneCompressor,
+    "fp16": FP16Compressor,
+    "bf16": BF16Compressor,
+    "int8": Int8Compressor,
+    "int8-dcn": Int8DcnCompressor,
+    "int8_dcn": Int8DcnCompressor,
+}
+
+# wire-name → compressor, for reconstructing the negotiated mode from
+# control-plane metadata on ranks that had no local entry.
+BY_WIRE = {"int8": Int8Compressor, "int8-dcn": Int8DcnCompressor}
+
+
+def by_name(name: str):
+    """Resolve a compression mode name (the HOROVOD_COMPRESSION values)."""
+    try:
+        return _BY_NAME[str(name).strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown compression {name!r}; expected one of "
+            "none/fp16/bf16/int8/int8-dcn") from None
+
+
+def from_env(default=NoneCompressor):
+    """Job-wide default compressor from ``HOROVOD_COMPRESSION``."""
+    name = os.environ.get("HOROVOD_COMPRESSION")
+    return by_name(name) if name else default

@@ -1545,3 +1545,66 @@ def fused_layer_norm(x, gamma, beta, *, eps: float = 1e-6):
     n = int(np.prod(x.shape[:-1]))
     y = _ln_fused(x.reshape(n, x.shape[-1]), gamma, beta, eps)
     return y.reshape(x.shape)
+
+
+# ====================================================== int8 block quantize
+# The wire-compression kernels for the quantized allreduce path
+# (`runtime/executor.py` / `ops/compression.py`): per-block symmetric int8
+# with an f32 scale per block — the EQuARX wire format. One row of the 2D
+# view is one quantization block, so the reduction that computes absmax is
+# a lane-dimension max and the grid is embarrassingly parallel over rows.
+
+
+def _int8_quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax * (1.0 / 127.0)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q_ref[...] = jnp.clip(jnp.round(x / safe), -127.0, 127.0).astype(jnp.int8)
+    s_ref[...] = scale
+
+
+def _int8_dequant_kernel(q_ref, s_ref, y_ref):
+    y_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[...]
+
+
+def int8_supported(rows: int, block: int) -> bool:
+    """Kernel path engages for lane-aligned blocks and tileable row counts;
+    everything else takes the caller's jnp fallback (identical contract)."""
+    return (mode() != "off" and block % 128 == 0
+            and _pick_block(rows, 256) is not None)
+
+
+def int8_quantize_2d(x2):
+    """[rows, block] float → ([rows, block] int8, [rows, 1] f32 scales)."""
+    rows, block = x2.shape
+    br = _pick_block(rows, 256)
+    row = pl.BlockSpec((br, block), lambda i: (i, 0))
+    col = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    return pl.pallas_call(
+        _int8_quant_kernel,
+        grid=(rows // br,),
+        in_specs=[row],
+        out_specs=[row, col],
+        out_shape=[_struct((rows, block), jnp.int8, x2),
+                   _struct((rows, 1), jnp.float32, x2)],
+        compiler_params=_cparams("parallel"),
+        interpret=_interpret(),
+    )(x2)
+
+
+def int8_dequantize_2d(q2, s2):
+    """([rows, block] int8, [rows, 1] f32) → [rows, block] f32."""
+    rows, block = q2.shape
+    br = _pick_block(rows, 256)
+    row = pl.BlockSpec((br, block), lambda i: (i, 0))
+    col = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    return pl.pallas_call(
+        _int8_dequant_kernel,
+        grid=(rows // br,),
+        in_specs=[row, col],
+        out_specs=row,
+        out_shape=_struct((rows, block), jnp.float32, q2, s2),
+        compiler_params=_cparams("parallel"),
+        interpret=_interpret(),
+    )(q2, s2)

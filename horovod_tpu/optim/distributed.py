@@ -28,11 +28,12 @@ import jax.numpy as jnp
 from .. import basics
 from ..basics import Adasum, Average, Sum
 from ..ops import collective_ops as ops
+from ..ops import compression as _compression
 from ..ops.compression import Compression
 
 
 def allreduce_gradients(grads, op: int = Average,
-                        compression=Compression.none, prefix: str = "grad",
+                        compression=None, prefix: str = "grad",
                         sparse_as_dense: bool = False):
     """Average a gradient pytree across ranks through the engine: one named
     async allreduce per leaf, all in flight simultaneously (the hook-overlap
@@ -45,6 +46,8 @@ def allreduce_gradients(grads, op: int = Average,
     """
     from ..ops import sparse as _sparse
 
+    if compression is None:
+        compression = _compression.from_env()
     is_sparse = lambda x: isinstance(x, _sparse.IndexedSlices)  # noqa: E731
     if basics.size() == 1:
         # Keep single-rank and multi-rank return types consistent:
@@ -69,7 +72,9 @@ def allreduce_gradients(grads, op: int = Average,
                      leaf))
                 continue
         comp, ctx = compression.compress(jnp.asarray(leaf))
-        started.append(("dense", ops.allreduce_async(comp, name=name, op=op),
+        started.append(("dense",
+                        ops.allreduce_async(comp, name=name, op=op,
+                                            compression=compression),
                         ctx))
     outs = []
     for kind, h, meta in started:
@@ -146,11 +151,25 @@ class DistributedOptimizer(_GradAccumulation):
         tx = hvd.DistributedOptimizer(optax.sgd(0.01))
         state = tx.init(params)
         updates, state = tx.update(grads, state, params)
+
+    ``error_feedback=True`` (EF-SGD, for lossy ``compression`` — int8 wire
+    or fp16/bf16 casts): each step communicates ``grads + residual`` and the
+    residual becomes what the wire dropped, ``corrected -
+    compression.roundtrip(corrected)``, so quantization error accumulates
+    into the next step's gradients instead of being lost. The residual is a
+    rank-local pytree (like the accumulation buffer); it measures this
+    rank's local quantization loss — the standard EF approximation of the
+    dequant-sum-requant wire.
     """
 
-    def __new__(cls, tx=None, compression=Compression.none, op: int = Average,
+    def __new__(cls, tx=None, compression=None, op: int = Average,
                 backward_passes_per_step: int = 1, prefix: str = "grad",
-                sparse_as_dense: bool = False):
+                sparse_as_dense: bool = False, error_feedback: bool = False):
+        if op == Adasum and error_feedback:
+            raise ValueError(
+                "error_feedback is not supported with op=Adasum (the "
+                "delta-flow optimizer communicates updates, not "
+                "gradients)")
         if op == Adasum and basics.size() > 1:
             return DistributedAdasumOptimizer(
                 tx, compression=compression,
@@ -158,17 +177,34 @@ class DistributedOptimizer(_GradAccumulation):
                 sparse_as_dense=sparse_as_dense)
         return super().__new__(cls)
 
-    def __init__(self, tx, compression=Compression.none, op: int = Average,
+    def __init__(self, tx, compression=None, op: int = Average,
                  backward_passes_per_step: int = 1, prefix: str = "grad",
-                 sparse_as_dense: bool = False):
+                 sparse_as_dense: bool = False, error_feedback: bool = False):
         self._tx = tx
-        self._compression = compression
+        self._compression = (compression if compression is not None
+                             else _compression.from_env())
         self._op = op
         self._prefix = prefix
+        self._error_feedback = error_feedback
+        self._ef_residual = None
         self._init_accumulation(backward_passes_per_step, sparse_as_dense)
 
     def init(self, params):
         return self._tx.init(params)
+
+    def _apply_error_feedback(self, grads):
+        """corrected = grads + residual; the new residual is the part of
+        ``corrected`` the lossy wire will drop this step."""
+        grads = _densify_or_raise(
+            grads, self._sparse_as_dense,
+            "error_feedback with sparse gradient leaves requires "
+            "sparse_as_dense=True")
+        if self._ef_residual is not None:
+            grads = jax.tree_util.tree_map(jnp.add, grads, self._ef_residual)
+        rt = self._compression.roundtrip
+        self._ef_residual = jax.tree_util.tree_map(
+            lambda g: g - rt(g), grads)
+        return grads
 
     def update(self, grads, state, params=None):
         # Stable tensor names across steps (like torch parameter names);
@@ -178,6 +214,8 @@ class DistributedOptimizer(_GradAccumulation):
         if not communicate:
             zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
             return zero, state
+        if self._error_feedback:
+            grads = self._apply_error_feedback(grads)
         grads = allreduce_gradients(
             grads, op=self._op, compression=self._compression,
             prefix=self._prefix, sparse_as_dense=self._sparse_as_dense)
@@ -209,11 +247,12 @@ class DistributedAdasumOptimizer(_GradAccumulation):
     scale-invariant, so the cast loses precision but not correctness.
     """
 
-    def __init__(self, tx, compression=Compression.none,
+    def __init__(self, tx, compression=None,
                  backward_passes_per_step: int = 1,
                  prefix: str = "adasum", sparse_as_dense: bool = False):
         self._tx = tx
-        self._compression = compression
+        self._compression = (compression if compression is not None
+                             else Compression.none)
         self._prefix = prefix
         self._init_accumulation(backward_passes_per_step, sparse_as_dense)
 

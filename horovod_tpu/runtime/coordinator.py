@@ -326,6 +326,7 @@ class CoordState:
             resp.postscale = m0.postscale
             resp.root_rank = m0.root_rank
             resp.tensor_dtype = m0.dtype
+            resp.compression = m0.compression
             cids: List[int] = []
             for k in bucket:
                 kname, pk = singles[k]
@@ -376,8 +377,10 @@ class CoordState:
 
     @staticmethod
     def _fuse_sig(m: ReqMeta):
+        # compression in the key: a quantized bucket compiles a different
+        # wire program, so plain and quantized tensors never share a bucket
         return (m.rtype, m.dtype, m.average, m.prescale, m.postscale,
-                m.root_rank)
+                m.root_rank, m.compression)
 
     def _assign_cache_id(self, name: str, metas: Dict[int, ReqMeta]) -> int:
         cid = self.cache_ids.get(name)
@@ -410,6 +413,12 @@ class CoordState:
                     m0.average, m0.prescale, m0.postscale):
                 return ("Mismatched reduction op/scale factors for tensor "
                         f"'{name}' between ranks {r0} and {r}.")
+            if m.compression != m0.compression:
+                return (f"Mismatched compression for tensor '{name}': rank "
+                        f"{r0} requested "
+                        f"'{m0.compression or 'none'}', rank {r} requested "
+                        f"'{m.compression or 'none'}' (set "
+                        "HOROVOD_COMPRESSION identically on every rank).")
         rt = int(m0.rtype)
         a2a_ragged = (rt == int(RequestType.ALLTOALL)
                       and m0.splits is not None)
@@ -747,7 +756,8 @@ class CoordController:
                            str(entry.array.dtype), tuple(entry.array.shape),
                            entry.root_rank, entry.average,
                            entry.prescale_factor, entry.postscale_factor,
-                           splits=entry.splits)
+                           splits=entry.splits,
+                           compression=entry.compression)
             cid = self._sig_cache.get(meta.sig(), -1)
             if cid >= 0:
                 self._hits += 1

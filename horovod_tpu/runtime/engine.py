@@ -27,6 +27,20 @@ Env knobs (parity with `common.h:61-87` / `operations.cc:388-485`):
   HOROVOD_TIMELINE         (path for Chrome-trace output)
   HOROVOD_AUTOTUNE         (1 = GP/EI tuning of fusion threshold+cycle time)
   HVD_TPU_NATIVE           (0 = force the pure-Python controller)
+  HOROVOD_COMPRESSION      (none/fp16/bf16/int8/int8-dcn — job-wide default
+                            wire compression; int8* negotiate the quantized
+                            collective program, docs/compression.md)
+  HOROVOD_INT8_BLOCK       (quantization block length, default 256)
+  HOROVOD_COMPRESSION_MIN_SIZE (elements; buckets below it skip
+                            quantization, default 1024)
+
+Autotune and compression: quantized allreduces are scored by the bytes the
+wire actually moved (int8 payload + f32 scales, Executor.last_wire_bytes),
+not the fp32 bucket size, so the tuner's fusion threshold learns the
+compressed wire's economics. The compression mode itself is not a tuned
+parameter — it is negotiated once through the coordinated controller's
+response metadata (Response.compression) so all ranks compile identical
+programs; per-sample flapping would recompile every bucket.
 """
 
 from __future__ import annotations
@@ -383,6 +397,12 @@ class Engine:
                      for es in ebr.values() for e in es)
         try:
             results = self._executor.execute(resp, ebr)
+            if self._executor.last_wire_mode:
+                # quantized wire: score the bytes actually moved (int8
+                # payload + scales; last_wire_bytes is one rank's
+                # reduce+gather round, same units as the fp32 accounting
+                # above) so autotune learns the compressed economics
+                nbytes = (self._executor.last_wire_bytes // 2) * len(ebr)
             for r, es in ebr.items():
                 outs = results[r]
                 for e, out in zip(es, outs):

@@ -87,6 +87,10 @@ class Response:
     # per-tensor shape of the rank-0 instance (allgather: dim0 is rank 0's;
     # use tensor_sizes for the negotiated per-rank dim0s)
     tensor_shapes: List[Tuple[int, ...]] = field(default_factory=list)
+    # negotiated wire compression for the fused bucket ("" = none, "int8",
+    # "int8-dcn"): the coordinator's decision every rank compiles against,
+    # so the quantize→collective→dequantize programs match across ranks
+    compression: str = ""
 
 
 @dataclass
@@ -110,3 +114,5 @@ class TensorTableEntry:
     average: bool = False  # Average op: fused divide-by-size
     # alltoall splits (extension)
     splits: Optional[Any] = None
+    # requested wire compression ("" = none; see Response.compression)
+    compression: str = ""

@@ -17,7 +17,7 @@ from .messages import RequestType, Response, ResponseType, TensorTableEntry
 class _Meta:
     __slots__ = ("name", "rank", "type", "dtype", "shape", "root_rank",
                  "average", "prescale", "postscale", "handle", "enqueue_t",
-                 "nbytes", "splits")
+                 "nbytes", "splits", "compression")
 
     def __init__(self, e: TensorTableEntry, handle: int):
         self.name = e.tensor_name
@@ -34,6 +34,7 @@ class _Meta:
         self.nbytes = int(e.array.size) * e.array.dtype.itemsize
         self.splits = None if e.splits is None else tuple(int(s)
                                                           for s in e.splits)
+        self.compression = e.compression
 
 
 class PyController:
@@ -105,6 +106,9 @@ class PyController:
         if any((m.average, m.prescale, m.postscale)
                != (e0.average, e0.prescale, e0.postscale) for m in metas):
             return f"Mismatched reduction op/scale factors for tensor '{name}'"
+        if any(m.compression != e0.compression for m in metas):
+            return (f"Mismatched compression for tensor '{name}': set "
+                    "HOROVOD_COMPRESSION identically on every rank")
         a2a_ragged = (e0.type == RequestType.ALLTOALL
                       and e0.splits is not None)
         if e0.type in (RequestType.ALLREDUCE, RequestType.ADASUM,
@@ -175,8 +179,10 @@ class PyController:
 
     @staticmethod
     def _sig(m: _Meta):
+        # compression included: quantized and plain buckets compile
+        # different wire programs (see CoordState._fuse_sig)
         return (int(m.type), m.dtype, m.average, m.prescale, m.postscale,
-                m.root_rank)
+                m.root_rank, m.compression)
 
     def tick(self):
         with self._lock:
@@ -262,6 +268,7 @@ class PyController:
                 resp.prescale = e0.prescale
                 resp.postscale = e0.postscale
                 resp.root_rank = e0.root_rank
+                resp.compression = e0.compression
                 hp: List[Tuple[int, int]] = []
                 for k in bucket:
                     hp.extend(singles[k][2])

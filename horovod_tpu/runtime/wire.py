@@ -145,12 +145,13 @@ class ReqMeta:
     (message.h Request)."""
 
     __slots__ = ("name", "rtype", "dtype", "shape", "root_rank", "average",
-                 "prescale", "postscale", "splits")
+                 "prescale", "postscale", "splits", "compression")
 
     def __init__(self, name: str, rtype: int, dtype: str,
                  shape: Tuple[int, ...], root_rank: int = -1,
                  average: bool = False, prescale: float = 1.0,
-                 postscale: float = 1.0, splits=None):
+                 postscale: float = 1.0, splits=None,
+                 compression: str = ""):
         self.name = name
         self.rtype = rtype
         self.dtype = dtype
@@ -163,13 +164,15 @@ class ReqMeta:
         # (later-horovod `alltoall(tensor, splits)`); None = equal split
         self.splits = None if splits is None else tuple(int(s)
                                                         for s in splits)
+        # requested wire compression ("" = none, "int8", "int8-dcn")
+        self.compression = compression
 
     def sig(self) -> Tuple:
         """Cache signature: everything negotiation depends on
         (`response_cache.h:45-97` keys entries the same way)."""
         return (self.name, self.rtype, self.dtype, self.shape,
                 self.root_rank, self.average, self.prescale, self.postscale,
-                self.splits)
+                self.splits, self.compression)
 
 
 # RequestList flags
@@ -211,6 +214,7 @@ def encode_request_list(flags: int, cached_ids: List[int],
             w.u32(len(m.splits))
             for s in m.splits:
                 w.i64(s)
+        w.str(m.compression)
     w.u8(0 if score is None else 1)
     if score is not None:
         w.i64(int(score[0]))
@@ -236,8 +240,9 @@ def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta],
         splits = None
         if rd.u8():
             splits = tuple(rd.i64() for _ in range(rd.u32()))
+        compression = rd.str()
         reqs.append(ReqMeta(name, rtype, dtype, shape, root, avg, pre, post,
-                            splits=splits))
+                            splits=splits, compression=compression))
     score = None
     if rd.remaining() and rd.u8():
         score = (rd.i64(), rd.f64())
@@ -268,6 +273,7 @@ def encode_response_list(flags: int, last_joined: int,
             w.str(n)
         w.str(resp.error_message)
         w.str(resp.tensor_dtype)
+        w.str(resp.compression)
         w.u8(int(resp.average))
         w.f64(resp.prescale)
         w.f64(resp.postscale)
@@ -307,6 +313,7 @@ def decode_response_list(buf: bytes):
         names = [rd.str() for _ in range(rd.u32())]
         err = rd.str()
         dtype = rd.str()
+        compression = rd.str()
         avg = rd.u8() != 0
         pre = rd.f64()
         post = rd.f64()
@@ -320,6 +327,7 @@ def decode_response_list(buf: bytes):
         cids = [rd.i32() for _ in range(rd.u32())]
         resp = Response(rtype, names, error_message=err, average=avg)
         resp.tensor_dtype = dtype
+        resp.compression = compression
         resp.prescale = pre
         resp.postscale = post
         resp.root_rank = root

@@ -181,7 +181,16 @@ def _eager_alltoall(tensor, splits, name):
 
         return fwd(tensor)
 
-    sp = tuple(int(s) for s in np.asarray(splits).reshape(-1))
+    # a symbolic (graph-mode) splits tensor has no concrete values to read
+    # here; np.asarray on it fails with an opaque NotImplementedError deep
+    # in numpy — catch it and say what to do instead
+    try:
+        sp = tuple(int(s) for s in np.asarray(splits).reshape(-1))
+    except (TypeError, NotImplementedError, ValueError) as e:
+        raise ValueError(
+            "alltoall splits must be concrete in eager mode; use "
+            "tf.function for traced splits (got symbolic "
+            f"{type(splits).__name__})") from e
 
     @t.custom_gradient
     def fwdv(x):

@@ -163,6 +163,10 @@ def allreduce_(tensor, average: Optional[bool] = None,
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:
+    if tensor.dim() == 0:
+        raise ValueError(
+            "hvd.allgather requires a tensor with at least one dimension "
+            "(got a 0-dim scalar); reshape with tensor.reshape(1) first")
     h = _ops.allgather_async(_to_numpy(tensor), name=name)
     _HANDLE_DTYPES[h] = tensor.dtype
     return h
@@ -178,6 +182,14 @@ class _HorovodAllgather:
 
     @classmethod
     def apply(cls, tensor, name):
+        if tensor.dim() == 0:
+            # the backward narrows dim 0 of the gathered gradient; a 0-dim
+            # input has no dim 0 and autograd would fail much later with an
+            # opaque 'invalid gradient' shape error — reject up front
+            raise ValueError(
+                "hvd.allgather requires a tensor with at least one "
+                "dimension (got a 0-dim scalar); reshape with "
+                "tensor.reshape(1) first")
         if cls._cls is None:
             torch = _require_torch()
 

@@ -1,0 +1,56 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern jax surface (``jax.shard_map`` with
+``check_vma=``, ``jax.typeof``); older installs (<= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with the ``check_rep=`` spelling
+and no ``jax.typeof``. ``install()`` bridges the gap by publishing the
+modern names on the ``jax`` module when absent, so every call site (and
+user test code importing ``horovod_tpu`` first) can use one spelling.
+
+Idempotent and a no-op on jax versions that already provide the names.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _shard_map_fallback():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_rep=True, **kwargs):
+        # Modern jax spells the replication check ``check_vma``; the
+        # experimental API spells it ``check_rep``. Accept both.
+        if "check_vma" in kwargs:
+            check_rep = bool(kwargs.pop("check_vma"))
+        kwargs.pop("axis_names", None)  # modern-only cosmetic kwarg
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_rep, **kwargs)
+
+    shard_map.__doc__ = _sm.__doc__
+    return shard_map
+
+
+def _typeof_fallback():
+    def typeof(x):
+        return jax.core.get_aval(x)
+
+    return typeof
+
+
+def install():
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_fallback()
+    if not hasattr(jax, "typeof"):
+        jax.typeof = _typeof_fallback()
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pragma: no cover - pallas always ships with jax
+        return
+    if not hasattr(pltpu, "CompilerParams"):
+        # renamed from TPUCompilerParams in newer jax
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+install()

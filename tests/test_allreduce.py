@@ -155,3 +155,68 @@ def test_allreduce_fp16_compression():
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_allreduce_int8_compression():
+    """The quantized wire: output stays fp32 and lands within the
+    half-LSB-per-rank quantization bound of the exact sum."""
+
+    def fn():
+        r = hvd.rank()
+        x = np.random.RandomState(50 + r).randn(4096).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, name="q8wire", op=hvd.Sum,
+                                       compression=hvd.Compression.int8))
+        assert out.dtype == np.float32
+        exact = np.sum([np.random.RandomState(50 + i).randn(4096)
+                        for i in range(4)], axis=0).astype(np.float32)
+        rel = np.max(np.abs(out - exact)) / np.max(np.abs(exact))
+        assert rel <= 1.5e-2, rel
+        return True
+
+    assert all(testing.run_cluster(fn, np=4))
+
+
+def test_allreduce_compression_mismatch_errors():
+    """HOROVOD_COMPRESSION must agree across ranks: the coordinator rejects
+    a bucket whose ranks negotiated different wire modes, fast."""
+
+    def fn():
+        r = hvd.rank()
+        c = hvd.Compression.int8 if r == 0 else hvd.Compression.none
+        with pytest.raises(hvd.HorovodInternalError,
+                           match="[Cc]ompression"):
+            hvd.allreduce(np.ones((2048,), np.float32), name="qmismatch",
+                          op=hvd.Sum, compression=c)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_allreduce_int8_dcn_two_level(monkeypatch):
+    """int8-dcn on a synthetic 2-host x 2-rank topology: ICI hops ride
+    bf16, only the DCN hop quantizes — looser than fp32 but inside the
+    combined bf16+int8 bound."""
+    if hvd.is_initialized():
+        hvd.shutdown()
+    monkeypatch.setenv("HVD_LOCAL_SIZE", "2")
+
+    def fn():
+        from horovod_tpu import basics
+
+        r = hvd.rank()
+        x = np.random.RandomState(60 + r).randn(4096).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, name="qdcn", op=hvd.Sum,
+                                       compression=hvd.Compression.int8_dcn))
+        exact = np.sum([np.random.RandomState(60 + i).randn(4096)
+                        for i in range(4)], axis=0).astype(np.float32)
+        rel = np.max(np.abs(out - exact)) / np.max(np.abs(exact))
+        assert rel <= 3e-2, rel
+        ex = basics._engine()._executor
+        keys = [k for k in ex._fn_cache if k[0] == "allreduce_q"]
+        return keys
+
+    try:
+        all_keys = testing.run_cluster(fn, np=4)
+    finally:
+        hvd.shutdown()
+    assert any(k[1] == "int8-dcn" for keys in all_keys for k in keys)

@@ -62,3 +62,21 @@ def test_allreduce_bench_spmd_and_eager(capsys):
     out = capsys.readouterr().out.strip().splitlines()
     summary = json.loads(out[-1])
     assert summary["metric"] == "allreduce_busbw_gbps"
+
+
+def test_allreduce_bench_compression_sweep(capsys):
+    """The wire-mode sweep emits bytes-on-wire per mode: int8 at ~25.4% of
+    the fp32 bytes, bf16 at exactly half."""
+    import allreduce_bench
+
+    results = allreduce_bench.main(
+        ["--compression", "none,int8", "--sizes-mb", "0.0625",
+         "--iters", "2", "--warmup", "1"])
+    by_mode = {r["mode"]: r for r in results}
+    assert set(by_mode) == {"none", "int8"}
+    assert by_mode["none"]["wire_ratio_vs_fp32"] == 1.0
+    assert by_mode["int8"]["wire_ratio_vs_fp32"] <= 0.28
+    assert all(r["wire_gbps"] > 0 and r["time_us"] > 0 for r in results)
+    out = capsys.readouterr().out.strip().splitlines()
+    metrics = [json.loads(l) for l in out if '"metric"' in l]
+    assert any(m["metric"] == "allreduce_int8_wire_ratio" for m in metrics)

@@ -1,0 +1,287 @@
+"""Int8 block-quantized wire path: quantizer math, wire accounting,
+end-to-end allreduce, bypasses, and error feedback.
+
+Acceptance targets (ISSUE): round-trip max relative error <= 1e-2 for
+N(0,1); int8 wire moves <= ~28% of the fp32 bytes for a 64 MB bucket
+(byte-counting, no allocation); quantize -> allreduce -> dequantize runs
+as ONE compiled program (asserted via the executor's compiled-program
+cache signature); int/bool and sub-threshold tensors bypass exactly.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import testing
+from horovod_tpu.ops import compression as comp
+from horovod_tpu.runtime.executor import Executor
+
+
+# ---------------------------------------------------------------- quantizer
+
+@pytest.mark.parametrize("block", [128, 256, 512])
+@pytest.mark.parametrize("n", [256, 4096, 5000])
+def test_roundtrip_error_bound(n, block):
+    """Per-block scale = absmax/127, so round-to-nearest error is at most
+    half an LSB: |x - rt(x)| <= absmax_block/254 <= absmax/254 < 1e-2
+    relative, the ISSUE acceptance bound for N(0,1)."""
+    rng = np.random.RandomState(42 + n + block)
+    x = rng.randn(n).astype(np.float32)
+    y = np.asarray(comp.quantize_roundtrip(x, block=block))
+    absmax = np.max(np.abs(x))
+    err = np.max(np.abs(y - x))
+    assert err <= absmax / 127 + 1e-7  # one full LSB, generous
+    assert err / absmax <= 1e-2
+
+
+def test_roundtrip_exact_cases():
+    # zeros survive the zero-scale guard (scale=0 -> divide by 1, q=0)
+    z = np.zeros(512, np.float32)
+    np.testing.assert_array_equal(np.asarray(comp.quantize_roundtrip(z)), z)
+    # a constant block is exact: q = +-127, dequant = absmax
+    c = np.full(256, -3.25, np.float32)
+    np.testing.assert_allclose(np.asarray(comp.quantize_roundtrip(c)), c,
+                               rtol=1e-6)
+    # dtype is preserved
+    h = np.random.RandomState(0).randn(256).astype(np.float16)
+    assert np.asarray(comp.quantize_roundtrip(h)).dtype == np.float16
+
+
+def test_quantize_blocks_layout():
+    x = np.random.RandomState(1).randn(1024).astype(np.float32)
+    q, s = comp.quantize_blocks(x, 256)
+    q, s = np.asarray(q), np.asarray(s)
+    assert q.dtype == np.int8 and q.shape == (1024,)
+    assert s.dtype == np.float32 and s.shape == (1024 // 256,)
+    assert np.all(np.abs(q.astype(np.int32)) <= 127)
+    y = np.asarray(comp.dequantize_blocks(q, s, dtype=np.float32, block=256))
+    np.testing.assert_allclose(y, x, atol=np.max(np.abs(x)) / 127)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_dequant_sum_requant_associativity(world):
+    """The wire reduction (dequant -> f32 sum -> requant) stays within the
+    analytic bound at every world size: each rank contributes <= half an
+    LSB of its own absmax, the requantized sum another half-LSB of the
+    sum's absmax — error grows additively, not multiplicatively."""
+    rng = np.random.RandomState(world)
+    parts = [rng.randn(1024).astype(np.float32) for _ in range(world)]
+    exact = np.sum(parts, axis=0, dtype=np.float32)
+    deq = [np.asarray(comp.quantize_roundtrip(p)) for p in parts]
+    reduced = np.asarray(comp.quantize_roundtrip(
+        np.sum(deq, axis=0, dtype=np.float32)))
+    bound = (sum(np.max(np.abs(p)) for p in parts)
+             + np.max(np.abs(exact))) / 254 + 1e-6
+    assert np.max(np.abs(reduced - exact)) <= bound
+    assert np.max(np.abs(reduced - exact)) / np.max(np.abs(exact)) <= 2e-2
+
+
+def test_int_and_bool_roundtrip_bypass():
+    """Non-floating tensors pass through the wire compressors untouched."""
+    i = np.arange(-4, 4, dtype=np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(hvd.Compression.int8.roundtrip(i)), i)
+    b = np.array([True, False, True])
+    np.testing.assert_array_equal(
+        np.asarray(hvd.Compression.int8.roundtrip(b)), b)
+
+
+# ------------------------------------------------------------- wire bytes
+
+def test_wire_bytes_under_28_percent_for_64mb_bucket():
+    """Byte-counting only — no 64 MB allocation. fp32 moves
+    2 * n * 4 bytes (reduce-scatter + all-gather hops); int8 moves
+    2 * (n + 4 * n/256): 1 byte/element plus one f32 scale per 256."""
+    n = 64 * 1024 * 1024 // 4  # 64 MB of fp32
+    fp32_bytes = comp.wire_footprint(n, "none")
+    assert fp32_bytes == 2 * n * 4
+    int8_bytes = comp.wire_footprint(n, "int8")
+    assert int8_bytes / fp32_bytes <= 0.28
+    # executor's layout math agrees, including block padding across ranks
+    for world in (2, 4, 64):
+        lay = Executor.quantized_wire_layout(n, world, block=256)
+        assert lay["padded"] % (world * 256) == 0
+        assert lay["wire_bytes"] / fp32_bytes <= 0.28
+
+
+def test_wire_layout_padding():
+    lay = Executor.quantized_wire_layout(5000, 4, block=256)
+    assert lay["chunk"] == 1280          # ceil(5000/4)=1250 -> 5 blocks
+    assert lay["padded"] == 5120
+    assert lay["scale_bytes"] == (5120 // 256) * 4
+    assert lay["wire_bytes"] == 2 * (5120 + lay["scale_bytes"])
+
+
+def test_by_name_and_env(monkeypatch):
+    assert comp.by_name("int8") is comp.Int8Compressor
+    assert comp.by_name("int8-dcn") is comp.Int8DcnCompressor
+    assert comp.by_name("none") is comp.NoneCompressor
+    with pytest.raises(ValueError, match="int8"):
+        comp.by_name("int7")
+    monkeypatch.setenv("HOROVOD_COMPRESSION", "int8")
+    assert comp.from_env() is comp.Int8Compressor
+    monkeypatch.delenv("HOROVOD_COMPRESSION")
+    assert comp.from_env() is comp.NoneCompressor
+
+
+# ------------------------------------------------- end-to-end wire program
+
+def _exact_sum(seed0, n, world):
+    return np.sum([np.random.RandomState(seed0 + i).randn(n)
+                   for i in range(world)], axis=0).astype(np.float32)
+
+
+def test_int8_allreduce_fused_program():
+    """4-rank int8 allreduce: result within the quantization bound AND the
+    executor compiled exactly one quantized program for the bucket (cache
+    key ('allreduce_q', 'int8', ...)) with wire-true byte accounting."""
+
+    def fn():
+        from horovod_tpu import basics
+
+        r = hvd.rank()
+        n = 5000
+        x = np.random.RandomState(100 + r).randn(n).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, name="q8", op=hvd.Sum,
+                                       compression=hvd.Compression.int8))
+        exact = _exact_sum(100, n, 4)
+        rel = np.max(np.abs(out - exact)) / np.max(np.abs(exact))
+        ex = basics._engine()._executor
+        qkeys = [k for k in ex._fn_cache if k[0] == "allreduce_q"]
+        return {"rel": rel, "qkeys": qkeys, "mode": ex.last_wire_mode,
+                "bytes": ex.last_wire_bytes}
+
+    infos = testing.run_cluster(fn, np=4)
+    assert all(i["rel"] <= 1.5e-2 for i in infos)
+    lay = Executor.quantized_wire_layout(5000, 4)
+    # every rank ran the SAME single compiled quantize+allreduce+dequantize
+    # program — no separate quantize/dequantize dispatches
+    assert any(i["qkeys"] for i in infos)
+    for i in infos:
+        if not i["qkeys"]:
+            continue
+        assert len(i["qkeys"]) == 1
+        key = i["qkeys"][0]
+        assert key[1] == "int8" and key[3] == 5000
+        assert i["mode"] == "int8"
+        assert i["bytes"] == lay["wire_bytes"]
+
+
+def test_int8_allreduce_average_and_scales():
+    def fn():
+        r = hvd.rank()
+        x = np.random.RandomState(7 + r).randn(4096).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, name="q8avg",
+                                       compression=hvd.Compression.int8,
+                                       prescale_factor=2.0,
+                                       postscale_factor=0.5))
+        exact = _exact_sum(7, 4096, 2) / 2.0  # average of 2 ranks, 2*0.5=1
+        assert (np.max(np.abs(out - exact))
+                / np.max(np.abs(exact))) <= 1.5e-2
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_int8_bypass_integer_dtype():
+    def fn():
+        from horovod_tpu import basics
+
+        r = hvd.rank()
+        x = np.arange(2048, dtype=np.int32) * (r + 1)
+        out = np.asarray(hvd.allreduce(x, name="qint", op=hvd.Sum,
+                                       compression=hvd.Compression.int8))
+        np.testing.assert_array_equal(out, np.arange(2048, dtype=np.int32) * 3)
+        return basics._engine()._executor.last_wire_mode
+
+    modes = testing.run_cluster(fn, np=2)
+    assert all(m == "" for m in modes)  # exact wire, no quantization
+
+
+def test_int8_bypass_small_tensor():
+    """Below HOROVOD_COMPRESSION_MIN_SIZE (1024 elements) the scale
+    overhead beats the savings — the bucket rides the exact fp32 wire."""
+
+    def fn():
+        from horovod_tpu import basics
+
+        r = hvd.rank()
+        x = np.full((100,), float(r + 1), np.float32)
+        out = np.asarray(hvd.allreduce(x, name="qsmall", op=hvd.Sum,
+                                       compression=hvd.Compression.int8))
+        np.testing.assert_allclose(out, np.full((100,), 3.0, np.float32))
+        return basics._engine()._executor.last_wire_mode
+
+    modes = testing.run_cluster(fn, np=2)
+    assert all(m == "" for m in modes)
+
+
+# ---------------------------------------------------------- error feedback
+
+def test_error_feedback_residual_accounting():
+    """After one step the residual is exactly what the wire dropped:
+    residual = corrected - roundtrip(corrected)."""
+    import optax
+
+    hvd.init()
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                  compression=hvd.Compression.int8,
+                                  error_feedback=True)
+    g = np.random.RandomState(3).randn(2048).astype(np.float32)
+    params = {"w": np.zeros(2048, np.float32)}
+    state = tx.init(params)
+    tx.update({"w": g}, state, params)
+    res = np.asarray(tx._ef_residual["w"])
+    expect = g - np.asarray(comp.quantize_roundtrip(g))
+    np.testing.assert_allclose(res, expect, atol=1e-6)
+    assert np.max(np.abs(res)) > 0  # the wire really dropped something
+
+
+def test_error_feedback_rejects_adasum():
+    import optax
+
+    with pytest.raises(ValueError, match="[Aa]dasum"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                 error_feedback=True)
+
+
+def test_error_feedback_tiny_lm_convergence():
+    """A tiny bigram LM trained through the int8 wire with error feedback:
+    cross-entropy must fall well below its init value — the EF residual
+    keeps quantization noise from biasing the gradient direction."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def fn():
+        r = hvd.rank()
+        V = 48                      # W is V*V = 2304 elems > min-size floor
+        rng = np.random.RandomState(11)
+        corpus = rng.randint(0, V, size=257)
+        xs = corpus[:-1].reshape(2, -1)[r]   # each rank trains on its shard
+        ys = corpus[1:].reshape(2, -1)[r]
+
+        def loss(W, x, y):
+            logits = W[x]
+            return jnp.mean(-jax.nn.log_softmax(logits)[jnp.arange(len(y)),
+                                                        y])
+
+        grad = jax.jit(jax.grad(loss))
+        # mean-CE gradients scale like 1/len(xs) per row, so the toy needs
+        # a large lr to move in 30 steps; softmax regression is convex and
+        # stable under it
+        tx = hvd.DistributedOptimizer(optax.sgd(30.0),
+                                      compression=hvd.Compression.int8,
+                                      error_feedback=True)
+        params = {"W": jnp.zeros((V, V), jnp.float32)}
+        state = tx.init(params)
+        init_loss = float(loss(params["W"], xs, ys))
+        for _ in range(30):
+            g = {"W": grad(params["W"], xs, ys)}
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        final = float(loss(params["W"], xs, ys))
+        return init_loss, final
+
+    for init_loss, final in testing.run_cluster(fn, np=2):
+        assert final < 0.65 * init_loss, (init_loss, final)

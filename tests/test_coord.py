@@ -102,6 +102,26 @@ class TestNegotiation:
                  1: (0, [], [meta("o", rtype=ALLGATHER)])})
         assert "Mismatched collective operations" in resps[0].error_message
 
+    def test_compression_mismatch(self):
+        st = make_state()
+        _, _, resps, _, _ = negotiate(
+            st, {0: (0, [], [meta("q", compression="int8")]),
+                 1: (0, [], [meta("q")])})
+        assert resps[0].response_type == ResponseType.ERROR
+        msg = resps[0].error_message
+        assert "compression" in msg and "'int8'" in msg and "'none'" in msg
+        assert "HOROVOD_COMPRESSION" in msg
+
+    def test_compression_carried_and_not_fused_across_modes(self):
+        st = make_state()
+        r0 = [meta("a", compression="int8"), meta("b", compression="int8"),
+              meta("c")]
+        _, _, resps, _, _ = negotiate(st, {0: (0, [], r0), 1: (0, [], r0)})
+        # same mode fuses and the response carries it; plain rides apart
+        by_names = {tuple(r.tensor_names): r for r in resps}
+        assert by_names[("a", "b")].compression == "int8"
+        assert by_names[("c",)].compression == ""
+
     def test_ragged_allgather_sizes(self):
         st = make_state()
         _, _, resps, _, _ = negotiate(
