@@ -23,6 +23,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DOC = os.path.join(REPO, "docs", "running.md")
+ELASTIC_DOC = os.path.join(REPO, "docs", "elastic.md")
 
 
 def pod_day_commands():
@@ -32,6 +33,19 @@ def pod_day_commands():
     cmds = [ln.strip() for ln in m.group(1).splitlines()
             if ln.strip().startswith("hvdrun ")]
     assert len(cmds) >= 4, f"expected >=4 pod-day commands, found {cmds}"
+    return cmds
+
+
+def elastic_commands():
+    """The documented elastic launch lines (docs/elastic.md) get the same
+    no-rot guarantee: --min-np/--max-np/--host-discovery-script/
+    --blacklist-cooldown must keep parsing against the real launcher."""
+    text = open(ELASTIC_DOC).read()
+    cmds = [ln.strip()
+            for m in re.finditer(r"```bash\n(.*?)```", text, re.S)
+            for ln in m.group(1).splitlines()
+            if ln.strip().startswith("hvdrun ")]
+    assert len(cmds) >= 2, f"expected >=2 elastic commands, found {cmds}"
     return cmds
 
 
@@ -78,7 +92,7 @@ def check_command(cmd: str) -> None:
 
 
 def main():
-    cmds = pod_day_commands()
+    cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
         check_command(cmd)
         print(f"ok: {cmd}")

@@ -54,7 +54,9 @@ from .exceptions import (  # noqa: F401
     HorovodError,
     HorovodInternalError,
     NotInitializedError,
+    RanksChangedError,
     ShutdownError,
+    WorkerLostError,
 )
 from .ops.collective_ops import (  # noqa: F401
     allgather,
@@ -88,6 +90,7 @@ from .optim.distributed import (  # noqa: F401
 )
 from . import callbacks  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401
 from . import spmd  # noqa: F401
 from .run.api import run  # noqa: F401

@@ -64,6 +64,10 @@ class _GlobalState:
     mesh: Any = None  # replica mesh: ALL devices, axis "hvd" (SPMD fast path)
     rank_mesh: Any = None  # one device per rank (eager engine collectives)
     engine: Any = None
+    # elastic job (HVD_ELASTIC=1): jax.distributed is skipped so workers can
+    # die/join; the engine routes collectives over the coordinator's host
+    # wire instead of cross-process XLA (docs/elastic.md)
+    elastic: bool = False
     lock: threading.Lock = field(default_factory=threading.Lock)
 
 
@@ -153,6 +157,36 @@ def init(
                 rank_devices=devices,
                 mesh=_build_mesh(devices),
                 rank_mesh=_build_mesh(devices),
+            )
+        elif os.environ.get("HVD_ELASTIC", "") not in ("", "0"):
+            # Elastic job: jax.distributed is deliberately NOT initialized —
+            # XLA's cross-process runtime cannot survive a worker dying, and
+            # the whole point here is that the job outlives its members.
+            # Each process runs single-process JAX; collective payloads ride
+            # the coordinator's TCP channel (elastic/executor.py).
+            nproc = int(os.environ.get("HVD_NUM_PROCS", "1"))
+            pid = int(os.environ.get("HVD_PROCESS_ID", "0"))
+            local_rank = int(os.environ.get("HVD_LOCAL_RANK", 0))
+            local_size = int(os.environ.get("HVD_LOCAL_SIZE", 1))
+            cross_rank = int(os.environ.get("HVD_CROSS_RANK", pid))
+            cross_size = int(os.environ.get("HVD_CROSS_SIZE", nproc))
+            devices = list(jax.devices())
+            # every rank "lives" on this process's first device; size the list
+            # past nproc so late joiners (pid >= initial nproc) still resolve
+            rank_devices = [devices[0]] * max(nproc, pid + 1)
+            st = _GlobalState(
+                initialized=True,
+                mode="multiprocess",
+                size=nproc,
+                local_size=local_size,
+                cross_size=cross_size,
+                rank0=pid,
+                local_rank0=local_rank,
+                cross_rank0=cross_rank,
+                rank_devices=rank_devices,
+                mesh=_build_mesh(devices[:1]),
+                rank_mesh=_build_mesh(devices[:1]),
+                elastic=True,
             )
         elif coord or jax.process_count() > 1:
             if coord:

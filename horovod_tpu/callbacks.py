@@ -165,6 +165,25 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
             print(f"Epoch {epoch}: warmup lr = {state['lr']:.6f}")
 
 
+class CommitStateCallback(Callback):
+    """Commit an :class:`~.elastic.ElasticState` every N batches, bounding
+    how much work a membership reset can roll back (reference
+    `horovod/_keras/elastic.py` CommitStateCallback). Commit boundaries are
+    also where waiting joiners are admitted, so smaller N means faster
+    scale-up at the cost of more frequent snapshots."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        self.state = state
+        self.batches_per_commit = max(1, int(batches_per_commit))
+        self._since_commit = 0
+
+    def on_batch_end(self, batch, state):
+        self._since_commit += 1
+        if self._since_commit >= self.batches_per_commit:
+            self._since_commit = 0
+            self.state.commit()
+
+
 class CallbackList:
     def __init__(self, callbacks: List[Callback]):
         self.callbacks = list(callbacks)

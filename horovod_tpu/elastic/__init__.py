@@ -1,0 +1,12 @@
+"""Elastic training: survive worker loss, absorb worker arrival, no restart.
+
+See docs/elastic.md. Public surface:
+
+- :class:`ElasticState` — commit/restore/sync wrapper around training pytrees
+- :func:`run_fn` (alias :func:`run`) — retry-loop decorator catching
+  membership resets
+- :class:`~.executor.ElasticExecutor` — internal: host-wire data plane the
+  engine installs when ``HVD_ELASTIC=1``
+"""
+
+from .state import ElasticState, run, run_fn  # noqa: F401

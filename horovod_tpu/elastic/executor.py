@@ -1,0 +1,113 @@
+"""Host-wire executor for elastic jobs.
+
+In elastic mode (``HVD_ELASTIC=1``) ``jax.distributed`` is never initialized:
+XLA's cross-process runtime pins the process set at startup and a single dead
+worker wedges every collective in it. Instead each process runs single-process
+JAX and collective *payloads* ride the coordinator's TCP channel — the same
+socket that already carries negotiation — as MSG_DATA frames aggregated per
+``(epoch, dseq)`` over the current member set (coordinator.py
+``CoordState.data_exchange``).
+
+This trades bandwidth for survivability: the host wire is the pod's DCN-class
+control network, not ICI, so elastic mode is for jobs where "keeps training
+through a preemption" beats raw step time (docs/elastic.md). Only ALLREDUCE
+and BROADCAST are supported — exactly what :class:`~..elastic.state.ElasticState`
+sync and gradient averaging need.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..exceptions import HorovodInternalError
+from ..runtime.messages import Response, ResponseType, TensorTableEntry
+
+
+class ElasticExecutor:
+    """Executes one Response by shipping the fused buffer over the
+    coordinator wire. Interface-compatible with
+    :class:`~..runtime.executor.Executor` (``execute`` + wire accounting
+    attrs) so the engine is agnostic."""
+
+    def __init__(self, state, controller):
+        self._state = state
+        self._controller = controller
+        # wire accounting the engine reads after execute(); the host wire has
+        # no quantized mode, so mode stays "" and autotune scores raw bytes
+        self.last_wire_mode: str = ""
+        self.last_wire_bytes: int = 0
+
+    def execute(self, response: Response,
+                entries_by_rank: Dict[int, List[TensorTableEntry]]):
+        rt = response.response_type
+        self.last_wire_mode = ""
+        self.last_wire_bytes = 0
+        if rt not in (ResponseType.ALLREDUCE, ResponseType.BROADCAST):
+            raise HorovodInternalError(
+                f"{rt.name} is not supported in elastic mode (only allreduce "
+                "and broadcast ride the host wire; see docs/elastic.md)")
+        self_rank = self._state.rank0
+        entries = entries_by_rank.get(self_rank, [])
+        by_name = {e.tensor_name: e for e in entries}
+
+        # Build this rank's fused contribution in negotiated name order.
+        # A joined rank (no local entry for a name) contributes zeros using
+        # the negotiated shape/dtype, exactly like the coordinated
+        # multi-controller path (`controller.cc:202-256`).
+        dtype = np.dtype(response.tensor_dtype or (
+            entries[0].array.dtype if entries else np.float32))
+        parts = []
+        shapes = []
+        for i, name in enumerate(response.tensor_names):
+            e = by_name.get(name)
+            if e is not None:
+                arr = np.asarray(e.array, dtype=dtype)
+            elif i < len(response.tensor_shapes):
+                arr = np.zeros(response.tensor_shapes[i], dtype=dtype)
+            else:
+                raise HorovodInternalError(
+                    f"elastic executor has no local entry and no negotiated "
+                    f"shape for '{name}'")
+            shapes.append(arr.shape)
+            parts.append(arr.ravel())
+        flat = (np.concatenate(parts) if parts
+                else np.zeros((0,), dtype=dtype))
+        if rt == ResponseType.ALLREDUCE and response.prescale != 1.0:
+            flat = flat * dtype.type(response.prescale)
+
+        from ..runtime.messages import RequestType
+
+        op = (int(RequestType.BROADCAST) if rt == ResponseType.BROADCAST
+              else int(RequestType.ALLREDUCE))
+        combined, nparticipants = self._controller.data_exchange(
+            op, response.root_rank, flat)
+        # one send + one receive of the fused buffer
+        self.last_wire_bytes = 2 * int(flat.size) * dtype.itemsize
+
+        combined = np.asarray(combined, dtype=dtype)
+        if rt == ResponseType.ALLREDUCE:
+            if response.average and nparticipants > 0:
+                combined = combined / dtype.type(nparticipants)
+            if response.postscale != 1.0:
+                combined = combined * dtype.type(response.postscale)
+            combined = combined.astype(dtype, copy=False)
+
+        import jax.numpy as jnp
+
+        outs = []
+        off = 0
+        for shape in shapes:
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            outs.append(jnp.asarray(
+                combined[off:off + n].reshape(shape)))
+            off += n
+        # results keyed by rank, entries in name order — but only for names
+        # this rank actually enqueued (joined names produced zeros purely to
+        # keep the wire layout identical; they have no handle to complete)
+        results: Dict[int, List] = {}
+        if entries:
+            name_to_out = dict(zip(response.tensor_names, outs))
+            results[self_rank] = [name_to_out[e.tensor_name] for e in entries]
+        return results

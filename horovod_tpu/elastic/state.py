@@ -1,0 +1,197 @@
+"""Elastic training state: commit / restore / sync around membership changes.
+
+Reference parity: `horovod/common/elastic.py` (``State``/``ObjectState``) and
+`horovod/torch/elastic.py` — the reference wraps model+optimizer state, commits
+a known-good snapshot each N batches, and on ``HorovodInternalError`` restores
+the snapshot, re-initializes collectives, and broadcasts state from a surviving
+rank before resuming. Here the pytree IS the state container, the reset signal
+is :class:`~..exceptions.RanksChangedError`, and the re-broadcast rides
+:func:`~..optim.broadcast.broadcast_pytree` over the epoch's surviving member
+set (docs/elastic.md).
+
+Typical use::
+
+    import horovod_tpu as hvd
+
+    state = hvd.elastic.ElasticState(params=params, opt_state=opt_state,
+                                     step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < total_steps:
+            state.params, state.opt_state = train_step(state.params,
+                                                       state.opt_state)
+            state.step += 1
+            state.commit()
+
+    train(state)
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import logging
+
+import numpy as np
+
+from ..exceptions import NotInitializedError, RanksChangedError
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+
+def _snapshot_leaf(x):
+    """Copy a leaf so later in-place mutation can't corrupt the snapshot.
+    jax.Arrays are immutable — share them; numpy buffers and python scalars
+    get copied."""
+    if isinstance(x, np.ndarray):
+        return x.copy()
+    try:
+        import jax
+
+        if isinstance(x, jax.Array):
+            return x
+    except Exception:
+        pass
+    return copy.deepcopy(x)
+
+
+def _copy_tree(tree):
+    import jax
+
+    return jax.tree_util.tree_map(_snapshot_leaf, tree)
+
+
+def _controller():
+    """The live engine's controller, or None before init / after shutdown —
+    ElasticState must stay usable as a plain local snapshot container in
+    single-process code and unit tests."""
+    from .. import basics
+
+    try:
+        return basics._engine().controller
+    except (NotInitializedError, AttributeError):
+        return None
+
+
+class ElasticState:
+    """Named slots of training state (each an arbitrary pytree) with
+    transactional commit/restore and membership-aware sync.
+
+    Attribute access is the API: ``state.params = ...`` registers/updates a
+    slot, ``state.params`` reads it. ``commit()`` snapshots every slot AND
+    marks a commit boundary on the control plane (where waiting joiners are
+    admitted); ``restore()`` rolls back to the last snapshot; ``sync()``
+    re-broadcasts every slot from the lowest surviving rank and commits.
+    """
+
+    def __init__(self, **slots):
+        object.__setattr__(self, "_values", {})
+        object.__setattr__(self, "_committed", {})
+        object.__setattr__(self, "_reset_count", 0)
+        for k, v in slots.items():
+            self._values[k] = v
+        # local-only initial snapshot: a restore() before the first commit()
+        # (e.g. a joiner failing mid-first-sync) rolls back to construction
+        # values instead of KeyErroring
+        self._committed.update(
+            {k: _copy_tree(v) for k, v in self._values.items()})
+
+    # ---- attribute protocol: public names are slots
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(
+                f"ElasticState has no slot '{name}'") from None
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._values[name] = value
+
+    # ---- introspection
+    def slots(self):
+        return sorted(self._values)
+
+    @property
+    def reset_count(self) -> int:
+        """How many membership resets this state has synced through."""
+        return self._reset_count
+
+    # ---- transaction API
+    def commit(self) -> None:
+        """Snapshot every slot and mark a commit boundary on the control
+        plane. The boundary is where waiting joiners are admitted: the
+        coordinator holds new workers until every current member has
+        committed, so admission never lands mid-collective
+        (coordinator.py ``_maybe_admit_locked``)."""
+        self._committed.clear()
+        self._committed.update(
+            {k: _copy_tree(v) for k, v in self._values.items()})
+        ctrl = _controller()
+        fn = getattr(ctrl, "commit", None)
+        if fn is not None:
+            fn()
+
+    def restore(self) -> None:
+        """Roll every slot back to the last committed snapshot (the partial
+        step that raised is discarded — its collectives may have completed on
+        a subset of ranks)."""
+        self._values.clear()
+        self._values.update(
+            {k: _copy_tree(v) for k, v in self._committed.items()})
+
+    def sync(self, root_rank=None) -> None:
+        """Re-align all ranks: clear the controller's reset latch, broadcast
+        every slot from ``root_rank`` (default: the lowest surviving rank) to
+        everyone — joiners receive the committed state, survivors confirm it
+        — then commit the agreed snapshot."""
+        from ..optim.broadcast import broadcast_pytree
+
+        ctrl = _controller()
+        resume = getattr(ctrl, "resume", None)
+        if resume is not None:
+            resume()
+        if root_rank is None:
+            members = getattr(ctrl, "members", None)
+            root_rank = min(members()) if members is not None else 0
+        for key in sorted(self._values):
+            self._values[key] = broadcast_pytree(
+                self._values[key], root_rank=root_rank,
+                prefix=f"elastic_sync/{key}")
+        self.commit()
+
+
+def run_fn(func):
+    """Wrap a training function taking ``(state, *args, **kwargs)`` in the
+    elastic retry loop: sync state across the current members, run, and on
+    :class:`~..exceptions.RanksChangedError` (worker lost or joined) restore
+    the last commit and go again under the new membership epoch. Everything
+    the function must not lose across a reset belongs in ``state``."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        while True:
+            try:
+                # sync() is inside the retry: a fresh joiner's very first
+                # sync raises RanksChangedError when its admission bumps
+                # the epoch, and a second membership change can land while
+                # a previous reset is still re-syncing
+                state.sync()
+                return func(state, *args, **kwargs)
+            except RanksChangedError as exc:
+                state._reset_count += 1
+                logger.warning(
+                    "elastic reset #%d (%s): restoring last commit and "
+                    "re-syncing", state.reset_count, exc)
+                state.restore()
+
+    return wrapper
+
+
+# decorator alias mirroring the reference's ``@hvd.elastic.run``
+run = run_fn

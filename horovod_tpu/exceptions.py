@@ -35,6 +35,26 @@ class ShutdownError(HorovodInternalError):
     """
 
 
+class RanksChangedError(HorovodInternalError):
+    """Cluster membership changed under an in-flight collective.
+
+    Raised from ``synchronize()`` when the coordinator bumped the membership
+    epoch (a worker was lost or admitted) while this collective was pending.
+    Elastic drivers (``horovod_tpu.elastic.run_fn``) catch this, restore the
+    last committed state, ``sync()`` from the lowest surviving rank and
+    resume; non-elastic callers see it as a fatal engine error. Mirrors
+    later-horovod's ``HorovodInternalError`` recovery contract
+    (`horovod/common/elastic.py`).
+    """
+
+
+class WorkerLostError(RanksChangedError):
+    """Membership changed because a worker dropped its control-plane
+    connection (crash, preemption, kill) — as opposed to a planned
+    join/resize. Subclasses RanksChangedError so one handler covers both.
+    """
+
+
 class NotInitializedError(HorovodError):
     """API used before ``init()`` was called.
 

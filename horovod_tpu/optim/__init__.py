@@ -1,6 +1,7 @@
 from .broadcast import (  # noqa: F401
     broadcast_optimizer_state,
     broadcast_parameters,
+    broadcast_pytree,
 )
 from .distributed import (  # noqa: F401
     DistributedAdasumOptimizer,

@@ -51,13 +51,15 @@ def broadcast_parameters(params, root_rank: int = 0, prefix: str = "param"):
     return jax.tree_util.tree_unflatten(treedef, flat)
 
 
-def broadcast_optimizer_state(opt_state, root_rank: int = 0):
-    """Broadcast optimizer state (optax pytree). Non-array leaves (step counts,
-    schedules as scalars) are wrapped into arrays for the wire and unwrapped
-    after, mirroring the scalar-wrapping in `torch/__init__.py:469-585`."""
+def broadcast_pytree(tree, root_rank: int = 0, prefix: str = "tree"):
+    """Broadcast an arbitrary pytree from ``root_rank``, tolerating python
+    scalar leaves (step counts, schedule positions): scalars are wrapped into
+    arrays for the wire and cast back after, mirroring the scalar-wrapping in
+    `torch/__init__.py:469-585`. Array leaves go through
+    :func:`broadcast_parameters` unchanged."""
     if basics.size() == 1:
-        return opt_state
-    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
     wrapped = []
     kinds = []  # remember python scalar types to cast back
     for leaf in leaves:
@@ -67,11 +69,17 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0):
         else:
             kinds.append(None)
             wrapped.append(leaf)
-    tree = jax.tree_util.tree_unflatten(treedef, wrapped)
-    tree = broadcast_parameters(tree, root_rank, prefix="opt")
-    leaves2 = jax.tree_util.tree_leaves(tree)
+    full = jax.tree_util.tree_unflatten(treedef, wrapped)
+    full = broadcast_parameters(full, root_rank, prefix=prefix)
+    leaves2 = jax.tree_util.tree_leaves(full)
     restored = [k(l) if k is not None else l for k, l in zip(kinds, leaves2)]
     return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast optimizer state (optax pytree). Delegates to
+    :func:`broadcast_pytree` for the scalar-leaf handling."""
+    return broadcast_pytree(opt_state, root_rank, prefix="opt")
 
 
 def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None):

@@ -1,0 +1,106 @@
+"""Host discovery + failure blacklisting for elastic launches.
+
+Reference parity: `horovod/run/elastic/discovery.py` — ``HostDiscovery``
+(fixed list or a user script re-run periodically, one ``host[:slots]`` per
+line) and the blacklist that keeps a failed host out of the candidate set.
+Extension: the blacklist has a cooldown (``--blacklist-cooldown``) after
+which a host becomes eligible again — preempted TPU hosts routinely come
+back with the same name, and a permanent blacklist would strand them.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from .hosts import HostSlots, parse_hosts
+
+logger = logging.getLogger("horovod_tpu.run.discovery")
+
+
+class HostDiscovery:
+    """Interface: ``discover()`` returns the currently available hosts."""
+
+    def discover(self) -> List[HostSlots]:
+        raise NotImplementedError
+
+
+class FixedHostDiscovery(HostDiscovery):
+    """Static ``-H host:slots,...`` set (elastic within a fixed pool: lost
+    hosts are blacklisted, recovered ones rejoin after cooldown)."""
+
+    def __init__(self, hosts: List[HostSlots]):
+        self._hosts = list(hosts)
+
+    def discover(self) -> List[HostSlots]:
+        return list(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script that prints one ``host`` or ``host:slots`` per
+    line (the reference's ``--host-discovery-script`` contract; see
+    docs/elastic.md for the exact format). A failing or hanging script
+    yields the previous snapshot rather than killing the job."""
+
+    def __init__(self, script: str, timeout: float = 30.0,
+                 default_slots: int = 1):
+        self._script = script
+        self._timeout = timeout
+        self._default_slots = default_slots
+        self._last: List[HostSlots] = []
+
+    def discover(self) -> List[HostSlots]:
+        try:
+            out = subprocess.run(
+                [self._script], capture_output=True, text=True,
+                timeout=self._timeout, check=True).stdout
+        except (OSError, subprocess.SubprocessError) as exc:
+            logger.warning("host discovery script %s failed (%s); keeping "
+                           "previous host set", self._script, exc)
+            return list(self._last)
+        hosts: List[HostSlots] = []
+        for line in out.splitlines():
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parsed = parse_hosts(line)
+            for h in parsed:
+                if ":" not in line:
+                    h.slots = self._default_slots
+            hosts.extend(parsed)
+        self._last = hosts
+        return hosts
+
+
+class Blacklist:
+    """Failed-host registry with cooldown. ``fail(host)`` records a failure;
+    ``blacklisted(host)`` is True until ``cooldown`` seconds have passed
+    (cooldown <= 0 means permanent, the reference behaviour)."""
+
+    def __init__(self, cooldown: float = 0.0):
+        self.cooldown = cooldown
+        self._failed: Dict[str, float] = {}
+
+    def fail(self, host: str) -> None:
+        self._failed[host] = time.monotonic()
+        logger.warning("blacklisting host %s%s", host,
+                       f" for {self.cooldown:.0f}s" if self.cooldown > 0
+                       else " permanently")
+
+    def blacklisted(self, host: str) -> bool:
+        ts = self._failed.get(host)
+        if ts is None:
+            return False
+        if self.cooldown > 0 and time.monotonic() - ts >= self.cooldown:
+            del self._failed[host]
+            logger.info("host %s cooldown expired; eligible again", host)
+            return False
+        return True
+
+    def filter(self, hosts: List[HostSlots]) -> List[HostSlots]:
+        return [h for h in hosts if not self.blacklisted(h.hostname)]
+
+    def hosts(self) -> List[str]:
+        return sorted(self._failed)

@@ -1,0 +1,238 @@
+"""Elastic launch driver: monitor workers, blacklist failed hosts, spawn
+joiners discovered at runtime.
+
+Reference parity: `horovod/run/elastic/driver.py` — the driver keeps the job
+alive while at least ``min_np`` workers survive, periodically re-runs host
+discovery, and assigns newly discovered slots fresh (monotonic) ranks up to
+``max_np``. Unlike the reference's Gloo rendezvous rebuild, workers here
+re-rendezvous *in-band*: the rank-0 coordinator admits a joiner at the next
+commit boundary and bumps the membership epoch (runtime/coordinator.py), so
+the driver's only jobs are process supervision, blacklisting, and spawning.
+
+Rank-0 loss is fatal by design: rank 0 hosts the coordinator (and the KV
+server lives with the launcher), so its death takes the control plane with
+it — the reference has the same asymmetry around the rendezvous server.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+from . import hosts as hosts_mod, rendezvous
+from .discovery import Blacklist, HostDiscovery
+from .exec_utils import RankProcess
+from .launcher import make_rank_envs
+from .service import DriverService
+
+logger = logging.getLogger("horovod_tpu.run.elastic")
+
+
+class ElasticDriver:
+    """Supervises an elastic job. ``run()`` blocks until rank 0 exits (its
+    code is the job's code) or membership falls below ``min_np``."""
+
+    def __init__(self, np: int, min_np: int, max_np: int,
+                 command: List[str], discovery: HostDiscovery,
+                 blacklist: Optional[Blacklist] = None,
+                 ssh_port: int = 22,
+                 knob_env: Optional[Dict[str, str]] = None,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 output_filename: Optional[str] = None,
+                 discovery_interval: float = 5.0,
+                 poll_interval: float = 0.5):
+        self.np = np
+        self.min_np = min_np
+        self.max_np = max_np
+        self.command = list(command)
+        self.discovery = discovery
+        self.blacklist = blacklist or Blacklist()
+        self.ssh_port = ssh_port
+        self.knob_env = dict(knob_env or {})
+        self.extra_env = dict(extra_env or {})
+        self.output_filename = output_filename
+        self.discovery_interval = discovery_interval
+        self.poll_interval = poll_interval
+
+        self._procs: Dict[int, RankProcess] = {}  # rank → live process
+        self._rank_host: Dict[int, str] = {}
+        self._next_rank = 0
+        self._secret = rendezvous.make_secret()
+        self._kv: Optional[rendezvous.KVStoreServer] = None
+        self._driver_svc: Optional[DriverService] = None
+        self._base_env: Dict[str, str] = {}
+
+    # -------------------------------------------------------------- spawning
+    def _is_local(self, hostname: str) -> bool:
+        from .network import resolves_local
+
+        return resolves_local(hostname)
+
+    def _spawn(self, rank: int, host: str, local_rank: int = 0,
+               local_size: int = 1) -> None:
+        info = hosts_mod.RankInfo(
+            rank=rank, size=self.np, hostname=host,
+            local_rank=local_rank, local_size=local_size,
+            # cross placement is informational in elastic mode (no
+            # hierarchical collectives on the host wire)
+            cross_rank=0, cross_size=1)
+        env = make_rank_envs([info], self._base_env["coord"],
+                             self._base_env["kv"], self._secret,
+                             self.knob_env)[0]
+        env.update(self.extra_env)
+        env["HVD_ELASTIC"] = "1"
+        if self._driver_svc is not None:
+            env["HVD_DRIVER_ADDR"] = self._base_env["driver"]
+        out = (f"{self.output_filename}.{rank}"
+               if self.output_filename else None)
+        logger.info("spawning rank %d on %s", rank, host)
+        self._procs[rank] = RankProcess(
+            rank, self.command, env, hostname=host, ssh_port=self.ssh_port,
+            output_file=out, is_local=self._is_local(host))
+        self._rank_host[rank] = host
+
+    def _host_load(self) -> Dict[str, int]:
+        load: Dict[str, int] = {}
+        for r in self._procs:
+            h = self._rank_host[r]
+            load[h] = load.get(h, 0) + 1
+        return load
+
+    def _scale_up(self, available: List[hosts_mod.HostSlots]) -> None:
+        """Fill free slots on non-blacklisted hosts with fresh ranks until
+        max_np. New ranks re-rendezvous in-band (coordinator admission)."""
+        load = self._host_load()
+        for h in available:
+            while (len(self._procs) < self.max_np
+                   and load.get(h.hostname, 0) < h.slots):
+                rank = self._next_rank
+                self._next_rank += 1
+                self._spawn(rank, h.hostname,
+                            local_rank=load.get(h.hostname, 0),
+                            local_size=h.slots)
+                load[h.hostname] = load.get(h.hostname, 0) + 1
+
+    # -------------------------------------------------------------- monitor
+    def _merge_reported_failures(self) -> None:
+        """Hosts reported dead via DriverClient.notify_host_failure join the
+        blacklist (the monitor's own poll() only sees local/ssh exit codes;
+        a task can report an unreachable *neighbour* this way)."""
+        if self._driver_svc is None:
+            return
+        for host, (_, reason) in self._driver_svc.failed_hosts().items():
+            if not self.blacklist.blacklisted(host):
+                logger.warning("host %s reported failed: %s", host, reason)
+                self.blacklist.fail(host)
+
+    def run(self) -> int:
+        self._kv = rendezvous.KVStoreServer(self._secret).start()
+        initial = self.blacklist.filter(self.discovery.discover())
+        if not initial:
+            raise RuntimeError("host discovery returned no usable hosts")
+        total_slots = sum(h.slots for h in initial)
+        start_np = max(self.min_np, min(self.np, total_slots, self.max_np))
+        ranks = hosts_mod.allocate(initial, start_np)
+
+        multi_host = any(not self._is_local(r.hostname) for r in ranks)
+        ip = rendezvous.local_ip() if multi_host else "127.0.0.1"
+        self._driver_svc = DriverService(len(initial), self._secret)
+        self._base_env = {
+            "kv": f"{ip}:{self._kv.port}",
+            # elastic workers resolve the coordinator via the KV store;
+            # exported for parity with the static launcher env
+            "coord": f"{ip}:{rendezvous.find_free_port()}",
+            "driver": f"{ip}:{self._driver_svc.port}",
+        }
+        try:
+            for r in ranks:
+                self._spawn(r.rank, r.hostname, r.local_rank, r.local_size)
+                self._next_rank = max(self._next_rank, r.rank + 1)
+            return self._monitor()
+        finally:
+            for p in self._procs.values():
+                p.terminate()
+            self._driver_svc.stop()
+            self._kv.stop()
+
+    def _monitor(self) -> int:
+        last_discovery = time.monotonic()
+        while True:
+            for rank in sorted(self._procs):
+                rc = self._procs[rank].poll()
+                if rc is None:
+                    continue
+                host = self._rank_host[rank]
+                del self._procs[rank]
+                if rank == 0:
+                    # rank 0 hosts the coordinator: its exit — clean or
+                    # not — ends the job
+                    logger.info("rank 0 exited with code %d; job %s",
+                                rc, "complete" if rc == 0 else "failed")
+                    return rc
+                if rc == 0:
+                    logger.info("rank %d on %s finished cleanly", rank, host)
+                    continue
+                logger.warning("rank %d on %s exited with code %d; "
+                               "continuing with %d workers",
+                               rank, host, rc, len(self._procs))
+                self.blacklist.fail(host)
+                if len(self._procs) < self.min_np:
+                    logger.error(
+                        "alive workers (%d) fell below --min-np (%d); "
+                        "aborting", len(self._procs), self.min_np)
+                    return rc
+            if not self._procs:
+                return 0
+            now = time.monotonic()
+            if now - last_discovery >= self.discovery_interval:
+                last_discovery = now
+                self._merge_reported_failures()
+                try:
+                    available = self.blacklist.filter(
+                        self.discovery.discover())
+                except Exception as exc:
+                    logger.warning("host discovery failed: %s", exc)
+                    available = []
+                if len(self._procs) < self.max_np:
+                    self._scale_up(available)
+            time.sleep(self.poll_interval)
+
+
+def launch_elastic(np: int, command: List[str],
+                   min_np: Optional[int] = None,
+                   max_np: Optional[int] = None,
+                   hosts: Optional[str] = None,
+                   hostfile: Optional[str] = None,
+                   host_discovery_script: Optional[str] = None,
+                   blacklist_cooldown: float = 0.0,
+                   ssh_port: int = 22,
+                   knob_env: Optional[Dict[str, str]] = None,
+                   extra_env: Optional[Dict[str, str]] = None,
+                   output_filename: Optional[str] = None) -> int:
+    """Entry point the launcher routes to when any elastic flag is present
+    (``--min-np`` / ``--max-np`` / ``--host-discovery-script``)."""
+    from .discovery import (FixedHostDiscovery, HostDiscoveryScript)
+
+    if host_discovery_script:
+        discovery: HostDiscovery = HostDiscoveryScript(host_discovery_script)
+    elif hostfile:
+        discovery = FixedHostDiscovery(hosts_mod.parse_hostfile(hostfile))
+    elif hosts:
+        discovery = FixedHostDiscovery(hosts_mod.parse_hosts(hosts))
+    else:
+        discovery = FixedHostDiscovery(
+            [hosts_mod.HostSlots("localhost", max_np or np)])
+    driver = ElasticDriver(
+        np=np,
+        min_np=min_np if min_np is not None else 1,
+        max_np=max_np if max_np is not None else np,
+        command=command,
+        discovery=discovery,
+        blacklist=Blacklist(cooldown=blacklist_cooldown),
+        ssh_port=ssh_port,
+        knob_env=knob_env,
+        extra_env=extra_env,
+        output_filename=output_filename)
+    return driver.run()

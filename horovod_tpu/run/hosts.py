@@ -60,6 +60,19 @@ def parse_hostfile(path: str) -> List[HostSlots]:
     return out
 
 
+def diff_hosts(old: List[HostSlots], new: List[HostSlots]):
+    """Membership delta between two discovery snapshots: hostnames added and
+    removed (slot-count changes on a surviving host count as neither — the
+    elastic driver re-reads slots when it spawns there). Used by the elastic
+    driver's discovery loop (reference `run/elastic/discovery.py`
+    HostManager.update_available_hosts)."""
+    old_names = {h.hostname for h in old}
+    new_names = {h.hostname for h in new}
+    added = [h.hostname for h in new if h.hostname not in old_names]
+    removed = [h.hostname for h in old if h.hostname not in new_names]
+    return added, removed
+
+
 def allocate(hosts: List[HostSlots], np: int) -> List[RankInfo]:
     """Assign np ranks to hosts in declaration order (gloo_run._allocate)."""
     total = sum(h.slots for h in hosts)

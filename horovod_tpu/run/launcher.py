@@ -54,6 +54,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="do not memoize ssh checks on disk")
     p.add_argument("--output-filename", default=None,
                    help="per-rank output file prefix (rank appended)")
+    # elastic mode (docs/elastic.md): any of these flags routes the launch
+    # through the elastic driver (run/elastic_driver.py)
+    p.add_argument("--min-np", "--min-num-proc", dest="min_np", type=int,
+                   default=None,
+                   help="elastic: minimum surviving workers before the job "
+                        "aborts (default: 1)")
+    p.add_argument("--max-np", "--max-num-proc", dest="max_np", type=int,
+                   default=None,
+                   help="elastic: maximum workers to scale up to "
+                        "(default: -np)")
+    p.add_argument("--host-discovery-script", default=None,
+                   help="elastic: executable printing one 'host[:slots]' "
+                        "per line, re-run periodically to find "
+                        "arriving/departing hosts")
+    p.add_argument("--blacklist-cooldown", type=float, default=0.0,
+                   help="elastic: seconds before a failed host may be "
+                        "retried (0 = blacklist forever)")
     p.add_argument("--start-timeout", type=float, default=600.0)
     p.add_argument("--verbose", action="store_true")
     # knob flags (run.py:395-616)
@@ -392,6 +409,28 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         print("hvdrun: -np/--num-proc is required", file=sys.stderr)
         return 2
     knob_env = config_parser.env_from_config(args.config_file, args)
+    elastic = (args.min_np is not None or args.max_np is not None
+               or args.host_discovery_script is not None)
+    if elastic:
+        if args.min_np is not None and args.min_np > args.num_proc:
+            print("hvdrun: --min-np cannot exceed -np", file=sys.stderr)
+            return 2
+        if args.max_np is not None and args.max_np < args.num_proc:
+            print("hvdrun: --max-np cannot be below -np", file=sys.stderr)
+            return 2
+        from .elastic_driver import launch_elastic
+
+        if args.verbose:
+            print(f"hvdrun: elastic launch, {args.num_proc} ranks "
+                  f"(min {args.min_np or 1}, max "
+                  f"{args.max_np or args.num_proc}): {cmd}", file=sys.stderr)
+        return launch_elastic(
+            args.num_proc, cmd, min_np=args.min_np, max_np=args.max_np,
+            hosts=args.hosts, hostfile=args.hostfile,
+            host_discovery_script=args.host_discovery_script,
+            blacklist_cooldown=args.blacklist_cooldown,
+            ssh_port=args.ssh_port, knob_env=knob_env,
+            output_filename=args.output_filename)
     if args.verbose:
         print(f"hvdrun: launching {args.num_proc} ranks: {cmd}",
               file=sys.stderr)

@@ -21,6 +21,7 @@ import secrets as pysecrets
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, Optional, Tuple
 
@@ -106,21 +107,45 @@ class KVStoreServer:
 
 
 class KVStoreClient:
+    # transient-failure policy: the KV server rides on rank 0's host, and an
+    # elastic reset (or plain startup ordering) can leave brief windows where
+    # connections are refused; retry with bounded exponential backoff instead
+    # of failing the whole job on one dropped packet
+    RETRIES = 5
+    BACKOFF = 0.1  # seconds, doubles per attempt
+
     def __init__(self, addr: str, secret: str, timeout: float = 30.0):
         self._base = f"http://{addr}"
         self._secret = secret
         self._timeout = timeout
 
+    def _open(self, req):
+        delay = self.BACKOFF
+        for attempt in range(self.RETRIES):
+            try:
+                return urllib.request.urlopen(req, timeout=self._timeout)
+            except urllib.error.HTTPError:
+                # a real server answer (403/404/...) — not transient; note
+                # HTTPError subclasses URLError/OSError, so this must come
+                # first
+                raise
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    socket.timeout):
+                if attempt == self.RETRIES - 1:
+                    raise
+                time.sleep(delay)
+                delay *= 2
+
     def put(self, scope: str, key: str, value: bytes) -> None:
         req = urllib.request.Request(
             f"{self._base}/{scope}/{key}", data=value, method="PUT",
             headers={"X-HVD-Sig": _sign(self._secret, value)})
-        urllib.request.urlopen(req, timeout=self._timeout).read()
+        self._open(req).read()
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         try:
             req = urllib.request.Request(f"{self._base}/{scope}/{key}")
-            return urllib.request.urlopen(req, timeout=self._timeout).read()
+            return self._open(req).read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None

@@ -240,6 +240,10 @@ class DriverService:
         self._registered: Dict[int, Dict[str, Tuple[str, int]]] = {}
         self._host_hashes: Dict[int, str] = {}
         self._routed: Dict[int, Set[str]] = {}
+        # elastic: hosts reported dead (by the monitor loop or by a task
+        # observing its neighbour), hostname → (monotonic ts, reason); the
+        # discovery loop consults this before re-offering a host
+        self._failed_hosts: Dict[str, Tuple[float, str]] = {}
         self._svc = _Service(secret, self._handle)
         self.port = self._svc.port
 
@@ -251,7 +255,18 @@ class DriverService:
                 self._host_hashes[msg["index"]] = msg.get("host_hash", "")
                 self._cv.notify_all()
             return {"ok": True}
+        if op == "host_failed":
+            with self._cv:
+                self._failed_hosts[msg["host"]] = (
+                    time.monotonic(), msg.get("reason", ""))
+                self._cv.notify_all()
+            return {"ok": True}
         raise ValueError(f"unknown op: {op}")
+
+    def failed_hosts(self) -> Dict[str, Tuple[float, str]]:
+        """hostname → (monotonic timestamp, reason) of reported failures."""
+        with self._cv:
+            return dict(self._failed_hosts)
 
     def wait_for_registration(self, timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout
@@ -311,3 +326,11 @@ class DriverClient:
         call(self._addr, self._secret,
              {"op": "register", "index": index, "addresses": addresses,
               "host_hash": host_hash}, timeout=timeout)
+
+    def notify_host_failure(self, host: str, reason: str = "",
+                            timeout: float = 10.0) -> None:
+        """Report a dead/unreachable host so the elastic driver blacklists
+        it instead of rescheduling onto it."""
+        call(self._addr, self._secret,
+             {"op": "host_failed", "host": host, "reason": reason},
+             timeout=timeout)

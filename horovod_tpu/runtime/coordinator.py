@@ -48,7 +48,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..exceptions import ShutdownError
+from ..exceptions import RanksChangedError, ShutdownError, WorkerLostError
 from ..utils.timeline import Timeline
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 from . import wire
@@ -60,6 +60,15 @@ MSG_HELLO = 1
 MSG_LIST = 2
 MSG_RESP = 3
 MSG_BYE = 4
+# elastic host-wire data plane: allreduce/broadcast payload riding the
+# control-plane channel (elastic jobs have no cross-process XLA collectives)
+MSG_DATA = 5
+MSG_DATA_RESP = 6
+
+# After a membership reset every surviving controller realigns its tick
+# counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
+# a common sequence number regardless of how far each had advanced.
+EPOCH_SEQ_BASE = 1 << 20
 
 _FUSABLE = (int(RequestType.ALLREDUCE), int(RequestType.ADASUM),
             int(RequestType.ALLGATHER))
@@ -126,7 +135,8 @@ class CoordState:
 
     def __init__(self, world: int, fusion_threshold: int,
                  cache_capacity: int, stall_warning_s: float,
-                 stall_shutdown_s: float, tuner=None):
+                 stall_shutdown_s: float, tuner=None,
+                 elastic: bool = False):
         self.world = world
         self.threshold = fusion_threshold
         self.cache_capacity = cache_capacity
@@ -159,6 +169,20 @@ class CoordState:
         self.cache_hits = 0
         self.cache_misses = 0
         self.warned: set = set()
+        # ---- elastic membership (docs/elastic.md). Non-elastic jobs keep
+        # members == range(world) for life, so every len(self.members)
+        # below degenerates to self.world.
+        self.elastic = elastic
+        self.epoch = 0
+        self.members: set = set(range(world))
+        self.pending_joins: set = set()
+        self.committed: set = set()
+        self.reset_reason = ""
+        # host-wire data plane: (epoch, dseq) -> in-flight aggregation
+        self.data: Dict[Tuple[int, int], dict] = {}
+        # per-seq participant count at negotiation time (membership may have
+        # changed by the time stragglers fetch)
+        self.expected: Dict[int, int] = {}
 
     # ---- client entry: one call per rank per tick
     def exchange(self, rank: int, seq: int, payload: bytes) -> bytes:
@@ -167,23 +191,204 @@ class CoordState:
                 return self._shutdown_bytes()
             flags_cached_reqs_score = wire.decode_request_list(payload)
             score = flags_cached_reqs_score[3]
+            if self.elastic:
+                if rank not in self.members:
+                    # prospective joiner: blocks until every current member
+                    # reaches a commit boundary, then enters under the bumped
+                    # epoch (re-rendezvous; docs/elastic.md)
+                    self.pending_joins.add(rank)
+                    self._maybe_admit_locked()
+                    while rank not in self.members:
+                        if self.bye:
+                            self.pending_joins.discard(rank)
+                            return self._shutdown_bytes()
+                        self.cv.wait(timeout=0.5)
+                    return self._ranks_changed_bytes()
+                if flags_cached_reqs_score[4] != self.epoch:
+                    # stale-epoch submission (queued before a reset): fail
+                    # fast instead of entering a barrier the current member
+                    # set can never complete
+                    return self._ranks_changed_bytes()
+                if flags_cached_reqs_score[0] & wire.REQ_COMMIT:
+                    self.committed.add(rank)
+                    self._maybe_admit_locked()
+                    if self.epoch != flags_cached_reqs_score[4]:
+                        # this commit admitted joiners; the frame itself is
+                        # now stale — sender re-syncs like everyone else
+                        return self._ranks_changed_bytes()
             if score is not None and self.tuner is not None:
                 self.round_bytes += score[0]
                 self.round_seconds = max(self.round_seconds, score[1])
             self.lists.setdefault(seq, {})[rank] = flags_cached_reqs_score[:3]
-            if len(self.lists[seq]) == self.world:
+            if len(self.lists[seq]) == len(self.members):
+                self.expected[seq] = len(self.members)
                 self.resps[seq] = self._negotiate(self.lists.pop(seq))
                 self.cv.notify_all()
+            entry_epoch = self.epoch
             while seq not in self.resps:
                 if self.bye:
                     return self._shutdown_bytes()
+                if self.elastic and self.epoch != entry_epoch:
+                    # membership reset while blocked: withdraw our entry and
+                    # realign instead of waiting on a dead barrier
+                    if seq in self.lists:
+                        self.lists[seq].pop(rank, None)
+                    return self._ranks_changed_bytes()
                 self.cv.wait(timeout=0.5)
             data = self.resps[seq]
             self.fetched[seq] = self.fetched.get(seq, 0) + 1
-            if self.fetched[seq] == self.world:
+            if self.fetched[seq] >= self.expected.get(seq, self.world):
                 del self.resps[seq]
                 del self.fetched[seq]
+                self.expected.pop(seq, None)
             return data
+
+    # ---- elastic membership (all under self.cv unless noted)
+    def rank_lost(self, rank: int, reason: str) -> None:
+        """A member dropped its control-plane connection: remove it, bump the
+        epoch and release every blocked barrier with RESP_RANKS_CHANGED so
+        survivors re-sync instead of dying (the elastic alternative to
+        :meth:`set_bye`)."""
+        with self.cv:
+            if self.bye or rank not in self.members:
+                return
+            self.members.discard(rank)
+            self._reset_locked(
+                f"worker lost: rank {rank} dropped its control-plane "
+                f"connection ({reason})")
+
+    def _maybe_admit_locked(self) -> None:
+        if not self.pending_joins:
+            if self.committed >= self.members:
+                self.committed.clear()  # boundary passed with no joiners
+            return
+        if self.committed >= self.members:
+            admitted = sorted(self.pending_joins)
+            self.members |= self.pending_joins
+            self.pending_joins.clear()
+            self._reset_locked(
+                f"worker joined: rank(s) {admitted} admitted at commit "
+                "boundary")
+
+    def _reset_locked(self, reason: str) -> None:
+        """Bump the membership epoch and drop every piece of state tied to
+        the old rank set: pending barriers, negotiated-but-unfetched
+        responses, the negotiation table, the response cache (ids were
+        assigned against the old member set) and in-flight data
+        aggregations. Blocked waiters observe the epoch change and return
+        RESP_RANKS_CHANGED / DATA_RANKS_CHANGED to their controllers."""
+        self.epoch += 1
+        self.reset_reason = reason
+        self.committed.clear()
+        self.table.clear()
+        self.order_ctr = 0
+        self.warned.clear()
+        self.joined &= self.members
+        self.last_joined = -1
+        self.cache_ids.clear()
+        self.cache_meta = []
+        self.lists.clear()
+        self.resps.clear()
+        self.fetched.clear()
+        self.expected.clear()
+        self.data.clear()
+        logger.warning("elastic: membership epoch %d (%s); members now %s",
+                       self.epoch, reason, sorted(self.members))
+        self._publish_members_locked()
+        self.cv.notify_all()
+
+    def _publish_members_locked(self) -> None:
+        """Best-effort membership advertisement through the launcher KV store
+        (key ``elastic/members`` = "epoch;r0,r1,..."), off-thread so a slow
+        KV server never stalls the coordinator lock."""
+        kv_addr = os.environ.get("HVD_KV_ADDR")
+        if not kv_addr:
+            return
+        payload = (f"{self.epoch};"
+                   f"{','.join(str(r) for r in sorted(self.members))}")
+
+        def _put():
+            try:
+                from ..run.rendezvous import KVStoreClient
+
+                KVStoreClient(kv_addr, os.environ.get("HVD_SECRET", "")).put(
+                    "elastic", "members", payload.encode())
+            except Exception:
+                logger.debug("elastic: membership publish failed",
+                             exc_info=True)
+
+        threading.Thread(target=_put, name="hvd_elastic_members",
+                         daemon=True).start()
+
+    def _ranks_changed_bytes(self) -> bytes:
+        return wire.encode_response_list(
+            wire.RESP_RANKS_CHANGED, -1, [], [], [], self.reset_reason,
+            epoch=self.epoch, members=sorted(self.members))
+
+    # ---- elastic host-wire data plane
+    def data_exchange(self, rank: int, payload: bytes) -> bytes:
+        """Aggregate one rank's allreduce/broadcast payload for (epoch, dseq)
+        over the current member set; blocks until all members contribute.
+        The reply carries the participant count so Average divides by the
+        epoch's actual world size."""
+        (epoch, dseq, op, root, dtype, shape,
+         raw) = wire.decode_data_request(payload)
+        with self.cv:
+            if self.bye:
+                return self._data_error_locked()
+            if (not self.elastic or rank not in self.members
+                    or epoch != self.epoch):
+                return self._ranks_changed_data_locked()
+            key = (epoch, dseq)
+            agg = self.data.get(key)
+            if agg is None:
+                agg = self.data[key] = {"parts": {}, "result": None,
+                                        "nparticipants": 0, "fetched": 0,
+                                        "expected": set(self.members)}
+            agg["parts"][rank] = (op, root, dtype, shape, raw)
+            if (agg["result"] is None
+                    and set(agg["parts"]) >= agg["expected"]):
+                agg["result"] = self._combine(agg)
+                agg["nparticipants"] = len(agg["parts"])
+                self.cv.notify_all()
+            while agg["result"] is None:
+                if self.bye:
+                    return self._data_error_locked()
+                if self.epoch != epoch:
+                    return self._ranks_changed_data_locked()
+                self.cv.wait(timeout=0.5)
+            out = wire.encode_data_result(wire.DATA_OK, epoch,
+                                          agg["nparticipants"], None,
+                                          agg["result"])
+            agg["fetched"] += 1
+            if agg["fetched"] >= agg["nparticipants"]:
+                self.data.pop(key, None)
+            return out
+
+    @staticmethod
+    def _combine(agg: dict) -> bytes:
+        import numpy as np
+
+        parts = agg["parts"]
+        op, root, dtype, shape, _ = parts[min(parts)]
+        if op == int(RequestType.BROADCAST):
+            # epoch checks guarantee the root is a live member with a part
+            return parts[root][4]
+        acc = None
+        for r in sorted(parts):
+            arr = np.frombuffer(parts[r][4], dtype=np.dtype(dtype))
+            acc = arr.copy() if acc is None else acc + arr
+        return acc.astype(np.dtype(dtype), copy=False).tobytes()
+
+    def _ranks_changed_data_locked(self) -> bytes:
+        return wire.encode_data_result(
+            wire.DATA_RANKS_CHANGED, self.epoch, 0, sorted(self.members),
+            self.reset_reason.encode())
+
+    def _data_error_locked(self) -> bytes:
+        msg = self.shutdown_reason or "control plane shut down"
+        return wire.encode_data_result(wire.DATA_ERROR, self.epoch, 0, None,
+                                       msg.encode())
 
     def set_bye(self, reason: str = "") -> None:
         """A rank left (clean BYE or dead connection): coordinated shutdown.
@@ -251,7 +456,9 @@ class CoordState:
                 self._add(rank, m)
 
         now = time.monotonic()
-        active = set(range(self.world)) - self.joined
+        active = set(self.members) - self.joined
+        epoch = self.epoch if self.elastic else -1
+        emembers = sorted(self.members) if self.elastic else None
 
         # join barrier: all ranks joined and nothing pending
         # (`controller.cc:202-256`)
@@ -261,7 +468,8 @@ class CoordState:
             self.joined.clear()
             self.last_joined = -1
             return wire.encode_response_list(flags, last, [], [], [],
-                                             tuned=tuned)
+                                             tuned=tuned, epoch=epoch,
+                                             members=emembers)
 
         ready: List[str] = []
         warnings: List[str] = []
@@ -270,6 +478,9 @@ class CoordState:
             have = set(p.metas)
             if active <= have:
                 ready.append(name)
+                # completed: re-arm the stall inspector so a second stall of
+                # the same tensor warns again
+                self.warned.discard(name)
                 continue
             waited = now - p.first_t
             missing = sorted(active - have)
@@ -353,7 +564,8 @@ class CoordState:
             assignments.append(cids)
         return wire.encode_response_list(flags, self.last_joined, responses,
                                          assignments, warnings,
-                                         self.shutdown_reason, tuned=tuned)
+                                         self.shutdown_reason, tuned=tuned,
+                                         epoch=epoch, members=emembers)
 
     def _add(self, rank: int, m: ReqMeta) -> None:
         p = self.table.get(m.name)
@@ -482,7 +694,12 @@ class CoordState:
                     return (f"Mismatched root ranks for broadcast '{name}': "
                             f"rank {r0} says {m0.root_rank}, rank {r} says "
                             f"{m.root_rank}.")
-            if not (0 <= m0.root_rank < self.world):
+            if self.elastic:
+                if m0.root_rank not in self.members:
+                    return (f"Invalid root rank {m0.root_rank} for broadcast "
+                            f"'{name}' (current members "
+                            f"{sorted(self.members)}).")
+            elif not (0 <= m0.root_rank < self.world):
                 return (f"Invalid root rank {m0.root_rank} for broadcast "
                         f"'{name}' (world size {self.world}).")
         if self.joined and rt in (int(RequestType.ALLGATHER),
@@ -540,6 +757,11 @@ class CoordinatorServer:
                 if mt == MSG_BYE:
                     self.state.set_bye()
                     return
+                if mt == MSG_DATA:
+                    data = self.state.data_exchange(rank, payload)
+                    _send_frame(conn, self.secret, MSG_DATA_RESP, seq, 0,
+                                data)
+                    continue
                 if mt != MSG_LIST:
                     raise ConnectionError(f"unexpected message type {mt}")
                 data = self.state.exchange(rank, seq, payload)
@@ -548,6 +770,15 @@ class CoordinatorServer:
             pass
         except (ConnectionError, OSError) as exc:
             if not self._stop.is_set():
+                if self.state.elastic and rank > 0:
+                    # elastic: losing a non-coordinator worker is survivable —
+                    # membership reset instead of job shutdown. Rank 0 hosts
+                    # this very coordinator, so its loss stays fatal.
+                    logger.warning("coordinator: rank %s connection lost "
+                                   "(%s); elastic membership reset",
+                                   rank, exc)
+                    self.state.rank_lost(rank, str(exc))
+                    return
                 logger.warning("coordinator: rank %s connection lost (%s); "
                                "broadcasting shutdown", rank, exc)
                 self.state.set_bye(f"lost control-plane connection to rank "
@@ -659,6 +890,7 @@ class CoordController:
 
     SUBMIT_DUPLICATE = -1
     SUBMIT_SHUTDOWN = -2
+    SUBMIT_RANKS_CHANGED = -3
     coordinated = True
 
     def __init__(self, world: int, fusion_threshold: int,
@@ -691,6 +923,16 @@ class CoordController:
         self._score_bytes = 0
         self._score_busy = 0.0
         self._score_epoch: Optional[float] = None
+        # ---- elastic membership (docs/elastic.md)
+        self._elastic = os.environ.get("HVD_ELASTIC", "") not in ("", "0")
+        self._epoch = 0 if self._elastic else -1
+        self._members: List[int] = list(range(world))
+        # set while a membership reset is unacknowledged: every submit fails
+        # with SUBMIT_RANKS_CHANGED until ElasticState.sync() calls resume(),
+        # so no survivor can silently keep training against a stale epoch
+        self._ranks_changed_reason: Optional[str] = None
+        self._commit_pending = False
+        self._dseq = 0
 
         gen = _next_gen(self_rank)
         if self_rank == 0:
@@ -717,7 +959,7 @@ class CoordController:
             self._state: Optional[CoordState] = CoordState(
                 world, fusion_threshold if fusion_enabled else 0,
                 cache_capacity, stall_warning_s, stall_shutdown_s,
-                tuner=tuner)
+                tuner=tuner, elastic=self._elastic)
             advertise = _advertise_host()
             bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
             self._server: Optional[CoordinatorServer] = CoordinatorServer(
@@ -750,6 +992,8 @@ class CoordController:
         with self._lock:
             if self._stop.is_set():
                 return self.SUBMIT_SHUTDOWN
+            if self._ranks_changed_reason is not None:
+                return self.SUBMIT_RANKS_CHANGED
             if entry.tensor_name in self._inflight:
                 return self.SUBMIT_DUPLICATE
             meta = ReqMeta(entry.tensor_name, int(entry.request_type),
@@ -790,10 +1034,14 @@ class CoordController:
             if self._join_handle is not None and not self._join_announced:
                 flags |= wire.REQ_JOIN
                 self._join_announced = True
+            if self._commit_pending:
+                flags |= wire.REQ_COMMIT
+                self._commit_pending = False
             cached = [r.cached_id for r in outbox if r.cached_id >= 0]
             fresh = [r.meta for r in outbox if r.cached_id < 0]
             seq = self._seq
             self._seq += 1
+            epoch = self._epoch
             score = None
             if self._autotune and self._score_bytes > 0:
                 # wall interval since the first buffered op: unlike pure busy
@@ -805,13 +1053,18 @@ class CoordController:
                 self._score_bytes = 0
                 self._score_busy = 0.0
                 self._score_epoch = None
-        payload = wire.encode_request_list(flags, cached, fresh, score=score)
+        payload = wire.encode_request_list(flags, cached, fresh, score=score,
+                                           epoch=epoch)
         try:
             data = self._exchange(seq, payload)
         except (ConnectionError, OSError):
             raise ShutdownError("control-plane connection lost")
         (rflags, last_joined, responses, assignments, warnings,
-         reason, tuned) = wire.decode_response_list(data)
+         reason, tuned, repoch, rmembers) = wire.decode_response_list(data)
+        if rflags & wire.RESP_RANKS_CHANGED:
+            self._apply_ranks_changed(repoch, rmembers or [], reason)
+        for resp in responses:
+            resp.epoch = repoch
         if tuned is not None:
             # apply the coordinator's broadcast (threshold, cycle_time):
             # every rank moves to the same parameters at the same tick; the
@@ -879,6 +1132,97 @@ class CoordController:
                                             self._stop)
             if mt == MSG_RESP and rseq == seq:
                 return data
+
+    # -------------------------------------------------------------- elastic
+    def commit(self) -> None:
+        """Mark a commit boundary: REQ_COMMIT rides the next request frame.
+        Joiners waiting at the coordinator are admitted once every current
+        member has committed (docs/elastic.md)."""
+        with self._lock:
+            self._commit_pending = True
+
+    def resume(self) -> None:
+        """Acknowledge a membership reset: re-enable submits after
+        ElasticState.sync() realigned the training state."""
+        with self._lock:
+            self._ranks_changed_reason = None
+
+    def members(self) -> List[int]:
+        with self._lock:
+            return list(self._members)
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def _apply_ranks_changed(self, epoch: int, members: List[int],
+                             reason: str):
+        """Adopt the coordinator's new membership and raise. The sig cache
+        dies with the coordinator's id table; the tick counter realigns so
+        survivors' next exchanges share a sequence number regardless of how
+        far each had advanced; every later submit fails with
+        SUBMIT_RANKS_CHANGED until resume()."""
+        with self._lock:
+            self._epoch = epoch
+            self._members = sorted(members)
+            self._seq = epoch * EPOCH_SEQ_BASE
+            self._dseq = 0
+            self._sig_cache.clear()
+            self._inflight.clear()
+            self._outbox.clear()
+            self._ranks_changed_reason = reason or "cluster membership changed"
+        self._timeline.epoch_marker(epoch)
+        msg = (f"membership epoch {epoch}: members {self._members}"
+               + (f" ({reason})" if reason else ""))
+        if "lost" in (reason or ""):
+            raise WorkerLostError(msg)
+        raise RanksChangedError(msg)
+
+    def data_exchange(self, op: int, root: int, array):
+        """Elastic host-wire collective: ship this rank's buffer through the
+        coordinator, get back the combined buffer and the participant count.
+        Blocking; engine-thread only (strict request/reply on the one
+        control-plane socket). Raises RanksChangedError/WorkerLostError when
+        membership changed under the exchange."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(array)
+        with self._lock:
+            epoch = self._epoch
+            dseq = self._dseq
+            self._dseq += 1
+        payload = wire.encode_data_request(epoch, dseq, op, root,
+                                           str(arr.dtype), arr.shape,
+                                           arr.tobytes())
+        frame_seq = dseq & 0xFFFFFFFF
+        try:
+            if self._rank == 0:
+                assert self._state is not None
+                data = self._state.data_exchange(0, payload)
+            else:
+                assert self._sock is not None
+                with self._send_lock:
+                    _send_frame(self._sock, self._secret, MSG_DATA,
+                                frame_seq, self._rank, payload)
+                while True:
+                    mt, rseq, _, data = _recv_frame(self._sock, self._secret,
+                                                    self._stop)
+                    if mt == MSG_DATA_RESP and rseq == frame_seq:
+                        break
+        except (ConnectionError, OSError):
+            raise ShutdownError("control-plane connection lost")
+        (status, repoch, nparticipants, rmembers,
+         raw) = wire.decode_data_result(data)
+        if status == wire.DATA_RANKS_CHANGED:
+            self._apply_ranks_changed(
+                repoch, rmembers or [],
+                raw.decode("utf-8", "replace") or "membership changed "
+                "during collective")
+        if status == wire.DATA_ERROR:
+            raise ShutdownError(raw.decode("utf-8", "replace")
+                                or "elastic data exchange failed")
+        out = np.frombuffer(raw, dtype=arr.dtype).reshape(arr.shape)
+        return out.copy(), nparticipants
 
     def interrupt(self) -> None:
         """Unblock a tick in flight (called from the user thread on

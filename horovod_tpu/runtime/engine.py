@@ -51,7 +51,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..exceptions import DuplicateNameError, ShutdownError
+from ..exceptions import (DuplicateNameError, RanksChangedError,
+                          ShutdownError)
 from ..utils.env import env_float as _env_float, env_on as _env_on
 from .executor import Executor
 from .handles import HandleManager
@@ -160,10 +161,18 @@ class Engine:
         self._state = state
         self._world = state.size
         self._mode = state.mode
-        self._executor = Executor(state)
         self.handles = HandleManager()
         self.controller, self.native = _make_controller(
             state.size, state.mode, state.rank0)
+        if getattr(state, "elastic", False):
+            # elastic jobs have no cross-process XLA collectives
+            # (jax.distributed is skipped so workers can die/join); the data
+            # plane rides the coordinator's TCP channel instead
+            from ..elastic.executor import ElasticExecutor
+
+            self._executor = ElasticExecutor(state, self.controller)
+        else:
+            self._executor = Executor(state)
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -218,6 +227,12 @@ class Engine:
                             f"{entry.rank} is already pending.")
                 elif ch == self.controller.SUBMIT_SHUTDOWN:
                     fail = (ShutdownError, "Horovod has been shut down.")
+                elif ch == getattr(self.controller,
+                                   "SUBMIT_RANKS_CHANGED", None):
+                    fail = (RanksChangedError,
+                            "cluster membership changed; restore committed "
+                            "state and sync() before submitting new "
+                            "collectives (docs/elastic.md)")
                 else:
                     self._pending[ch] = entry
                     self._wake.notify_all()
@@ -316,6 +331,28 @@ class Engine:
                         "Stalled tensors exceeded "
                         "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting "
                         "(stall_inspector.h:80).")
+            except RanksChangedError as exc:
+                # elastic membership reset: fail everything in flight with
+                # the reset error so user threads unblock into the elastic
+                # recovery loop — then KEEP RUNNING; the engine survives the
+                # epoch change and serves the re-synced training
+                logger.warning("engine: %s; failing in-flight collectives "
+                               "for elastic recovery", exc)
+                with self._lock:
+                    entries = list(self._pending.values())
+                    self._pending.clear()
+                    users = [u for us in self._join_waiters.values()
+                             for u in us]
+                    self._join_waiters.clear()
+                for entry in entries:
+                    self._fire_callback(entry, False, str(exc))
+                    self.handles.mark_done(entry.handle, False,
+                                           error=str(exc),
+                                           error_cls=type(exc))
+                for user in users:
+                    self.handles.mark_done(user, False, error=str(exc),
+                                           error_cls=type(exc))
+                continue
             except ShutdownError as exc:
                 # coordinated shutdown (a peer sent BYE / the coordinator
                 # broadcast the shutdown flag): drain quietly — this is the
@@ -411,6 +448,17 @@ class Engine:
                     # the time synchronize() unblocks
                     self._fire_callback(e, True, out)
                     self.handles.mark_done(e.handle, True, result=out)
+        except RanksChangedError as exc:
+            # membership changed under this response's data exchange: fail
+            # its handles with the reset error and re-raise so the loop
+            # handler clears the rest of the in-flight set and continues
+            msg = str(exc)
+            for es in ebr.values():
+                for e in es:
+                    self._fire_callback(e, False, msg)
+                    self.handles.mark_done(e.handle, False, error=msg,
+                                           error_cls=type(exc))
+            raise
         except Exception as exc:  # surface execution errors on every handle
             msg = f"{type(exc).__name__}: {exc}"
             for es in ebr.values():

@@ -91,6 +91,11 @@ class Response:
     # "int8-dcn"): the coordinator's decision every rank compiles against,
     # so the quantize→collective→dequantize programs match across ranks
     compression: str = ""
+    # membership epoch the decision was negotiated under (-1 = non-elastic);
+    # executing a response against a different epoch means a rank set change
+    # raced this tick, and the executor must fail fast instead of exchanging
+    # data with a stale member set (docs/elastic.md)
+    epoch: int = -1
 
 
 @dataclass

@@ -40,6 +40,7 @@ class _Meta:
 class PyController:
     SUBMIT_DUPLICATE = -1
     SUBMIT_SHUTDOWN = -2
+    SUBMIT_RANKS_CHANGED = -3
 
     def __init__(self, world: int, fusion_threshold: int,
                  stall_warning_s: float, stall_shutdown_s: float,
@@ -64,8 +65,36 @@ class PyController:
         self._last_joined = -1
         self._shutdown = False
         self._warned: set = set()
+        # elastic: ranks currently negotiating (None = fixed range(world));
+        # membership epoch mirrors the coordinated controller's counter
+        self._active_ranks: Optional[set] = None
+        self._epoch = -1
         import threading
         self._lock = threading.Lock()
+
+    def reset(self, ranks, epoch: int) -> List[int]:
+        """Elastic membership reset: drop pending negotiation state, adopt
+        the surviving rank set and epoch, and return the orphaned handles so
+        the engine can fail them with RanksChangedError. Mirrors
+        CoordState._reset_locked for the in-process controller."""
+        with self._lock:
+            orphans = [m.handle for st in self._table.values()
+                       for m in st.values()]
+            orphans.extend(self._join_handles.values())
+            self._table.clear()
+            self._order.clear()
+            self._join_handles.clear()
+            self._joined.clear()
+            self._warned.clear()
+            self._last_joined = -1
+            self._active_ranks = set(ranks)
+            self._epoch = epoch
+        self._timeline.epoch_marker(epoch)
+        return orphans
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
 
     def submit(self, entry: TensorTableEntry) -> int:
         with self._lock:
@@ -191,14 +220,19 @@ class PyController:
             now = time.monotonic()
             if self._local_only:
                 active = {self._self_rank} - self._joined
+            elif self._active_ranks is not None:
+                active = self._active_ranks - self._joined
             else:
                 active = set(range(self._world)) - self._joined
 
             join_released: List[int] = []
             last_joined = -1
-            all_joined = (self._self_rank in self._joined
-                          if self._local_only
-                          else len(self._joined) == self._world)
+            if self._local_only:
+                all_joined = self._self_rank in self._joined
+            elif self._active_ranks is not None:
+                all_joined = self._active_ranks <= self._joined
+            else:
+                all_joined = len(self._joined) == self._world
             if self._joined and all_joined and not self._table:
                 join_released = list(self._join_handles.values())
                 last_joined = self._last_joined
@@ -215,6 +249,9 @@ class PyController:
                     continue
                 if active <= set(st.keys()):
                     ready.append(name)
+                    # completed: re-arm the stall inspector so a second
+                    # stall of the same tensor warns again
+                    self._warned.discard(name)
                 else:
                     waiting.append(name)
                     waited = now - min(m.enqueue_t for m in st.values())

@@ -177,19 +177,35 @@ class ReqMeta:
 
 # RequestList flags
 REQ_JOIN = 1
+# this rank reached a commit boundary (elastic: pending joiners are admitted
+# once every current member has committed)
+REQ_COMMIT = 2
 
 # ResponseList flags
 RESP_SHUTDOWN = 1
 RESP_JOIN_RELEASE = 2
+# membership epoch bumped (worker lost/admitted): the response carries the new
+# epoch + member list instead of collective decisions; controllers must drop
+# in-flight work and re-sync (elastic subsystem, docs/elastic.md)
+RESP_RANKS_CHANGED = 4
+
+# data_exchange result status (elastic host-wire data plane)
+DATA_OK = 0
+DATA_RANKS_CHANGED = 1
+DATA_ERROR = 2
 
 
 def encode_request_list(flags: int, cached_ids: List[int],
                         new_reqs: List[ReqMeta],
-                        score: Optional[Tuple[int, float]] = None) -> bytes:
+                        score: Optional[Tuple[int, float]] = None,
+                        epoch: int = -1) -> bytes:
     """``score`` is this rank's accumulated autotune sample since its last
     frame: (bytes moved, busy seconds). Carried in the request frame the way
     the reference piggybacks parameter-manager traffic on the coordinator
-    exchange rather than adding a side channel."""
+    exchange rather than adding a side channel. ``epoch`` is the sender's
+    membership epoch (-1 = non-elastic job, epoch checks disabled); a stale
+    epoch makes the coordinator answer RESP_RANKS_CHANGED instead of queuing
+    the frame into a barrier the dead rank set can never complete."""
     w = Writer()
     w.u8(flags)
     w.u32(len(cached_ids))
@@ -219,11 +235,13 @@ def encode_request_list(flags: int, cached_ids: List[int],
     if score is not None:
         w.i64(int(score[0]))
         w.f64(float(score[1]))
+    w.i32(epoch)
     return w.getvalue()
 
 
 def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta],
-                                             Optional[Tuple[int, float]]]:
+                                             Optional[Tuple[int, float]],
+                                             int]:
     rd = Reader(buf)
     flags = rd.u8()
     cached = [rd.u32() for _ in range(rd.u32())]
@@ -246,7 +264,8 @@ def decode_request_list(buf: bytes) -> Tuple[int, List[int], List[ReqMeta],
     score = None
     if rd.remaining() and rd.u8():
         score = (rd.i64(), rd.f64())
-    return flags, cached, reqs, score
+    epoch = rd.i32() if rd.remaining() >= 4 else -1
+    return flags, cached, reqs, score, epoch
 
 
 def encode_response_list(flags: int, last_joined: int,
@@ -254,13 +273,17 @@ def encode_response_list(flags: int, last_joined: int,
                          cache_assignments: List[List[int]],
                          stall_warnings: List[str],
                          shutdown_reason: str = "",
-                         tuned: Optional[Tuple[int, float]] = None) -> bytes:
+                         tuned: Optional[Tuple[int, float]] = None,
+                         epoch: int = -1,
+                         members: Optional[List[int]] = None) -> bytes:
     """``cache_assignments[i]`` parallels ``responses[i].tensor_names``:
     coordinator-assigned cache id per tensor (-1 = uncached).
     ``shutdown_reason`` distinguishes a normal end-of-job shutdown (empty)
     from an abnormal abort (stall shutdown, peer loss). ``tuned`` broadcasts
     autotuned (fusion_threshold, cycle_time_ms) so every rank applies the
-    same parameters at the same tick."""
+    same parameters at the same tick. ``epoch``/``members`` carry the
+    membership state on RESP_RANKS_CHANGED responses (elastic); -1/None on
+    ordinary ticks keeps old decoders byte-compatible."""
     w = Writer()
     w.u8(flags)
     w.str(shutdown_reason)
@@ -298,6 +321,10 @@ def encode_response_list(flags: int, last_joined: int,
     if tuned is not None:
         w.i64(int(tuned[0]))
         w.f64(float(tuned[1]))
+    w.i32(epoch)
+    w.u32(0 if members is None else len(members))
+    for r in (members or ()):
+        w.i32(r)
     return w.getvalue()
 
 
@@ -339,5 +366,77 @@ def decode_response_list(buf: bytes):
     tuned = None
     if rd.remaining() and rd.u8():
         tuned = (rd.i64(), rd.f64())
+    epoch = rd.i32() if rd.remaining() >= 4 else -1
+    members: Optional[List[int]] = None
+    if rd.remaining() >= 4:
+        members = [rd.i32() for _ in range(rd.u32())]
     return (flags, last_joined, responses, assignments, warnings,
-            shutdown_reason, tuned)
+            shutdown_reason, tuned, epoch, members)
+
+
+# --------------------------------------------------------------------------
+# Elastic host-wire data plane (MSG_DATA frames through the coordinator).
+# Elastic jobs skip jax.distributed, so cross-process XLA collectives are
+# unavailable; allreduce/broadcast payloads instead ride the already-open
+# control-plane TCP channel, aggregated per (epoch, dseq) over the current
+# member set (docs/elastic.md).
+# --------------------------------------------------------------------------
+
+def encode_data_request(epoch: int, dseq: int, op: int, root: int,
+                        dtype: str, shape: Tuple[int, ...],
+                        payload: bytes) -> bytes:
+    w = Writer()
+    w.i32(epoch)
+    w.i64(dseq)
+    w.u8(op)
+    w.i32(root)
+    w.str(dtype)
+    w.u32(len(shape))
+    for d in shape:
+        w.i64(d)
+    w.u32(len(payload))
+    w.parts.append(payload)
+    return w.getvalue()
+
+
+def decode_data_request(buf: bytes):
+    rd = Reader(buf)
+    epoch = rd.i32()
+    dseq = rd.i64()
+    op = rd.u8()
+    root = rd.i32()
+    dtype = rd.str()
+    shape = tuple(rd.i64() for _ in range(rd.u32()))
+    n = rd.u32()
+    payload = rd.buf[rd.off:rd.off + n]
+    return epoch, dseq, op, root, dtype, shape, payload
+
+
+def encode_data_result(status: int, epoch: int, nparticipants: int,
+                       members: Optional[List[int]],
+                       payload: bytes) -> bytes:
+    """``nparticipants`` lets the sender divide an averaged allreduce by the
+    actual member count of the epoch (world size is dynamic under elastic);
+    ``members`` rides along on DATA_RANKS_CHANGED so the client can realign
+    without an extra round trip."""
+    w = Writer()
+    w.u8(status)
+    w.i32(epoch)
+    w.u32(nparticipants)
+    w.u32(0 if members is None else len(members))
+    for r in (members or ()):
+        w.i32(r)
+    w.u32(len(payload))
+    w.parts.append(payload)
+    return w.getvalue()
+
+
+def decode_data_result(buf: bytes):
+    rd = Reader(buf)
+    status = rd.u8()
+    epoch = rd.i32()
+    nparticipants = rd.u32()
+    members = [rd.i32() for _ in range(rd.u32())]
+    n = rd.u32()
+    payload = rd.buf[rd.off:rd.off + n]
+    return status, epoch, nparticipants, members, payload

@@ -102,6 +102,14 @@ class Timeline:
             self._emit({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
                         "ts": self._ts(), "s": "g"})
 
+    def epoch_marker(self, epoch: int) -> None:
+        """Global instant event on every elastic membership epoch change, so a
+        trace shows exactly which collectives straddled a reset
+        (docs/elastic.md)."""
+        if self._enabled:
+            self._emit({"name": f"EPOCH_{epoch}", "ph": "i", "pid": 0,
+                        "tid": 0, "ts": self._ts(), "s": "g"})
+
     def cache_counter(self, hits: int, misses: int) -> None:
         """Chrome counter track of response-cache hits/misses (the fast
         path that skips negotiation, reference `controller.cc:171-185`)."""

@@ -248,6 +248,68 @@ class TestExchangeProtocol:
             c0.shutdown()
             kv.stop()
 
+    def test_join_release_last_joined_consistent(self, monkeypatch,
+                                                 tmp_path):
+        """Every surviving rank must observe the SAME last-joined rank when
+        the join barrier releases (join() return-value contract,
+        `controller.cc` join negotiation)."""
+        c0, c1, kv = self._controllers(monkeypatch, tmp_path)
+        try:
+            h0 = c0.join(0)
+            h1 = c1.join(1)
+            assert h0 >= 0 and h1 >= 0
+            out = {}
+
+            def tick0():
+                out[0] = c0.tick()
+
+            t = threading.Thread(target=tick0)
+            t.start()
+            out[1] = c1.tick()
+            t.join(timeout=30)
+            for r in (0, 1):
+                _, _, join_released, last_joined, _, _ = out[r]
+                assert join_released == [h0 if r == 0 else h1]
+            # identical on both ranks — whichever frame the coordinator
+            # consumed second is THE last joiner, everywhere
+            assert out[0][3] == out[1][3]
+            assert out[0][3] in (0, 1)
+        finally:
+            c1.shutdown()
+            c0.shutdown()
+            kv.stop()
+
+
+class TestPyControllerJoin:
+    """join() last-joined agreement on the in-process controller: every
+    released join handle ships the same last-joined rank."""
+
+    def _ctrl(self, world=2):
+        from horovod_tpu.runtime.pycontroller import PyController
+
+        return PyController(world=world, fusion_threshold=64 << 20,
+                            stall_warning_s=60.0, stall_shutdown_s=0.0,
+                            cache_capacity=64, fusion_enabled=True,
+                            timeline_path=None, autotune=False,
+                            cycle_time_ms=5.0)
+
+    def test_all_ranks_released_with_same_last_joined(self):
+        ctrl = self._ctrl()
+        h0 = ctrl.join(0)
+        h1 = ctrl.join(1)
+        responses, pairs, join_released, last_joined, _, _ = ctrl.tick()
+        assert responses == [] and pairs == []
+        assert sorted(join_released) == sorted([h0, h1])
+        assert last_joined == 1  # rank 1 joined last; one value for all
+
+    def test_join_order_determines_last_joined(self):
+        ctrl = self._ctrl()
+        ctrl.join(1)
+        ctrl.join(0)
+        _, _, released, last_joined, _, _ = ctrl.tick()
+        assert len(released) == 2
+        assert last_joined == 0
+
 
 # ----------------------------------------------------------- integration (2p)
 def _worker_shape_mismatch():

@@ -155,9 +155,11 @@ def test_wire_request_response_roundtrip_randomized():
                         if rng.randint(2) else None)))
         score = ((int(rng.randint(0, 2 ** 48)), float(rng.rand()))
                  if rng.randint(2) else None)
-        buf = wire.encode_request_list(flags, cached, reqs, score=score)
-        f2, c2, r2, s2 = wire.decode_request_list(buf)
-        assert (f2, c2, s2) == (flags, cached, score)
+        epoch = int(rng.randint(-1, 5))
+        buf = wire.encode_request_list(flags, cached, reqs, score=score,
+                                       epoch=epoch)
+        f2, c2, r2, s2, e2 = wire.decode_request_list(buf)
+        assert (f2, c2, s2, e2) == (flags, cached, score, epoch)
         assert [m.sig() for m in r2] == [m.sig() for m in reqs]
 
         resps, cids = [], []
@@ -184,10 +186,15 @@ def test_wire_request_response_roundtrip_randomized():
         reason = "lost peer ✗" if rng.randint(2) else ""
         tuned = ((int(rng.randint(0, 2 ** 31)), float(rng.rand() * 50))
                  if rng.randint(2) else None)
+        members = ([int(x) for x in rng.randint(0, 16, rng.randint(0, 4))]
+                   if rng.randint(2) else [])
         buf = wire.encode_response_list(3, -1, resps, cids, warns, reason,
-                                        tuned=tuned)
-        f2, last2, r2, c2, w2, reason2, t2 = wire.decode_response_list(buf)
+                                        tuned=tuned, epoch=epoch,
+                                        members=members)
+        (f2, last2, r2, c2, w2, reason2, t2,
+         e2, m2) = wire.decode_response_list(buf)
         assert (f2, reason2, last2, w2, t2) == (3, reason, -1, warns, tuned)
+        assert (e2, m2) == (epoch, members)
         assert c2 == cids
         for a, b in zip(r2, resps):
             assert a.response_type == b.response_type
