@@ -17,6 +17,7 @@ exists: 1656.82 img/s on 16 Pascal GPUs = 103.55 img/s/GPU
 (`docs/benchmarks.rst:43`, BASELINE.md).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -27,7 +28,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
-def main():
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Synthetic training benchmark (env knobs: BENCH_MODEL, "
+                    "BENCH_BATCH, BENCH_IMAGE, BENCH_WARMUP, BENCH_ROUNDS, "
+                    "BENCH_ITERS).")
+    p.add_argument("--metrics-dump", metavar="PATH", default=None,
+                   help="write the final aggregated runtime-metrics snapshot "
+                        "(hvd.metrics(), docs/metrics.md) as JSON to PATH")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
     import jax
     import jax.numpy as jnp
     import optax
@@ -178,6 +191,12 @@ def main():
             "ResNet-101 run (docs/benchmarks.rst:43) — its only published "
             "throughput figure" if model_name == "ResNet50" else None),
     }))
+
+    if args.metrics_dump:
+        with open(args.metrics_dump, "w") as f:
+            json.dump(hvd.metrics(), f, indent=2, sort_keys=True)
+        print(f"# metrics snapshot written to {args.metrics_dump}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -91,12 +91,57 @@ def check_command(cmd: str) -> None:
         py_compile.compile(script_path, doraise=True)
 
 
+def check_metrics_endpoint() -> None:
+    """Live /metrics smoke (docs/metrics.md): a 2-thread local cluster with
+    HOROVOD_METRICS_PORT=0 scrapes its own endpoint via urllib and prints the
+    text; this parent fails on empty or Prometheus-unparsable output."""
+    code = (
+        "import os, sys, urllib.request\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "os.environ['HOROVOD_METRICS_PORT'] = '0'\n"
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "from horovod_tpu import testing\n"
+        "from horovod_tpu.metrics import server_port\n"
+        "def fn():\n"
+        "    for i in range(3):\n"
+        "        hvd.allreduce(np.ones((8,), np.float32), name='g',"
+        " op=hvd.Sum)\n"
+        "    return True\n"
+        "assert all(testing.run_cluster(fn, np=2))\n"
+        "port = server_port()\n"
+        "assert port, 'metrics endpoint did not start'\n"
+        "body = urllib.request.urlopen(\n"
+        "    f'http://127.0.0.1:{port}/metrics', timeout=10).read()\n"
+        "hvd.shutdown()\n"
+        "sys.stdout.write(body.decode())\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"metrics smoke job failed:\n{r.stderr[-2000:]}")
+    from horovod_tpu.metrics import parse_prometheus
+
+    assert r.stdout.strip(), "metrics endpoint served empty output"
+    samples = parse_prometheus(r.stdout)  # ValueError on unparsable text
+    for want in ("hvd_allreduce_latency_seconds_count",
+                 "hvd_wire_bytes_total",
+                 "hvd_response_cache_hits_total",
+                 "hvd_elastic_epoch"):
+        assert want in samples, f"/metrics output missing {want}"
+    print(f"ok: /metrics endpoint served {len(samples)} sample families")
+
+
 def main():
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
         check_command(cmd)
         print(f"ok: {cmd}")
-    print(f"pod-day smoke: {len(cmds)} command lines valid")
+    check_metrics_endpoint()
+    print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
+          "valid")
 
 
 if __name__ == "__main__":

@@ -25,6 +25,9 @@ python setup.py build_native
 stage "unit suite (8-device virtual CPU platform)"
 python -m pytest tests/ -q -m "not integration"
 
+stage "metrics subsystem (registry, wire roundtrip, /metrics endpoint)"
+python -m pytest tests/test_metrics.py -q
+
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # includes tests/test_spark_real.py (real-pyspark scenarios; they skip
 # when pyspark is absent from the image)

@@ -89,8 +89,14 @@ from .optim.distributed import (  # noqa: F401
     grad,
 )
 from . import callbacks  # noqa: F401
+from .callbacks import MetricsCallback  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import elastic  # noqa: F401
+# NOTE: this import makes the *function* shadow the `horovod_tpu.metrics`
+# module as a package attribute (hvd.metrics() returns the aggregated
+# snapshot). The module stays importable as `from horovod_tpu.metrics
+# import ...` / `import horovod_tpu.metrics` via sys.modules.
+from .metrics import metrics  # noqa: F401
 from . import parallel  # noqa: F401
 from . import spmd  # noqa: F401
 from .run.api import run  # noqa: F401

@@ -249,6 +249,12 @@ def init(
         st.engine = Engine(st)
         st.engine.start()
         _state = st
+        if st.rank0 == 0:
+            # aggregating process: serve /metrics when HOROVOD_METRICS_PORT
+            # is set (rank 0 in multiprocess mode; the one process otherwise)
+            from .metrics import maybe_start_server
+
+            maybe_start_server()
 
 
 _shutdown_hooks = []
@@ -277,6 +283,10 @@ def shutdown() -> None:
         if _state.engine is not None:
             _state.engine.shutdown()
         _state = _GlobalState()
+        from .metrics import clear_reports, stop_server
+
+        stop_server()
+        clear_reports()
     for fn in _shutdown_hooks:
         try:
             fn()

@@ -184,6 +184,33 @@ class CommitStateCallback(Callback):
             self.state.commit()
 
 
+class MetricsCallback(Callback):
+    """Dump the aggregated runtime-metrics snapshot (docs/metrics.md) as JSON
+    at epoch boundaries, on the aggregating rank only. The file is rewritten
+    atomically each time, so ``path`` always holds the latest complete
+    snapshot; the written object is ``{"epoch": N, "metrics": snapshot}``."""
+
+    def __init__(self, path: str, every_n_epochs: int = 1):
+        self.path = path
+        self.every_n_epochs = max(1, int(every_n_epochs))
+
+    def on_epoch_end(self, epoch, state, metrics=None):
+        if basics.is_initialized() and basics.rank() != 0:
+            return
+        if (epoch + 1) % self.every_n_epochs:
+            return
+        import json
+        import os
+
+        from .metrics import aggregate
+
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": int(epoch), "metrics": aggregate()}, f,
+                      indent=2, sort_keys=True)
+        os.replace(tmp, self.path)
+
+
 class CallbackList:
     def __init__(self, callbacks: List[Callback]):
         self.callbacks = list(callbacks)

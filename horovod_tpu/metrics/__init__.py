@@ -1,0 +1,126 @@
+"""Runtime telemetry: registry, cross-rank aggregation, /metrics endpoint.
+
+Layout (docs/metrics.md):
+
+* :mod:`.registry` — dependency-free Counter / Gauge / Histogram, the
+  process-global registry, snapshot/merge, Prometheus text rendering.
+* :mod:`.instruments` — the standard ``hvd_*`` metric catalog.
+* :mod:`.http` — the stdlib HTTP server behind ``HOROVOD_METRICS_PORT``.
+
+This module owns the aggregation state: every rank periodically ships its
+registry snapshot over the coordinator control channel (``MSG_METRICS``
+frames, runtime/coordinator.py); the coordinator process stores them here
+via :func:`store_report` and the endpoint / ``hvd.metrics()`` render the
+merge of the local registry with every stored report.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       exponential_buckets, get_registry, merge_snapshots,
+                       parse_prometheus, render_prometheus, reset_registry)
+from . import instruments
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "instruments",
+    "exponential_buckets", "get_registry", "merge_snapshots",
+    "parse_prometheus", "render_prometheus", "reset_registry",
+    "local_snapshot", "store_report", "clear_reports", "aggregate",
+    "metrics_text", "metrics", "maybe_start_server", "stop_server",
+    "server_port",
+]
+
+# Per-rank snapshots received over the control channel, keyed by rank.
+# Only populated on the aggregating (coordinator) process.
+_reports = {}
+_reports_lock = threading.Lock()
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def local_snapshot() -> dict:
+    """This process's registry as a plain dict (wire- and merge-ready)."""
+    return get_registry().snapshot()
+
+
+def store_report(rank: int, snapshot: dict, timestamp: float = 0.0) -> None:
+    """Record one rank's shipped snapshot (coordinator side)."""
+    with _reports_lock:
+        _reports[int(rank)] = (float(timestamp), snapshot)
+
+
+def clear_reports() -> None:
+    with _reports_lock:
+        _reports.clear()
+
+
+def report_ranks():
+    with _reports_lock:
+        return sorted(_reports)
+
+
+def aggregate() -> dict:
+    """Merge the local registry with every stored per-rank report.
+
+    The local registry is this process's own telemetry (on rank 0 that
+    includes the coordinator-side counters); remote ranks never store a
+    report for rank 0's registry, so nothing is double counted.
+    """
+    with _reports_lock:
+        remote = [snap for _, (_, snap) in sorted(_reports.items())]
+    return merge_snapshots([local_snapshot()] + remote)
+
+
+def metrics_text() -> str:
+    """The aggregated snapshot in Prometheus text format."""
+    return render_prometheus(aggregate())
+
+
+def metrics(prometheus: bool = False):
+    """Public API (``hvd.metrics()``): the aggregated metrics snapshot.
+
+    Returns the merged plain-dict snapshot — on the coordinator process the
+    whole job, on other ranks just the local registry.  With
+    ``prometheus=True`` returns the text exposition instead.
+    """
+    return metrics_text() if prometheus else aggregate()
+
+
+# -- endpoint lifecycle (called from basics.init / basics.shutdown) ---------
+
+def maybe_start_server(force: bool = False):
+    """Start the /metrics endpoint if ``HOROVOD_METRICS_PORT`` is set (or
+    ``force``).  Idempotent; port 0 binds an ephemeral port.  Returns the
+    server or None."""
+    global _server
+    from .http import MetricsHTTPServer
+
+    with _server_lock:
+        if _server is not None:
+            return _server
+        raw = os.environ.get("HOROVOD_METRICS_PORT", "")
+        if not raw.strip() and not force:
+            return None
+        port = int(raw) if raw.strip() else 0
+        srv = MetricsHTTPServer(port, metrics_text)
+        srv.start()
+        _server = srv
+        return srv
+
+
+def stop_server() -> None:
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()
+
+
+def server_port():
+    """Bound port of the running endpoint, or None."""
+    with _server_lock:
+        return None if _server is None else _server.port

@@ -1,0 +1,69 @@
+"""Prometheus-text /metrics endpoint (``HOROVOD_METRICS_PORT``).
+
+A daemon-threaded stdlib HTTP server started on the aggregating process
+(rank 0, or any standalone/local-cluster process).  Port 0 binds an
+ephemeral port; the bound port is exposed as ``server.port`` and logged,
+which is how tests and the CI smoke scrape without a fixed allocation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("horovod_tpu")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsHTTPServer:
+    """Serves ``render_fn()`` at /metrics; everything else is 404."""
+
+    def __init__(self, port: int, render_fn):
+        self._render = render_fn
+        self._requested_port = int(port)
+        self._httpd = None
+        self._thread = None
+        self.port = None  # bound port, set by start()
+
+    def start(self) -> int:
+        render = self._render
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render().encode("utf-8")
+                except Exception as exc:  # pragma: no cover - render bug
+                    self.send_error(500, str(exc))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("metrics http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer(("", self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.25},
+            name="hvd-metrics-http", daemon=True)
+        self._thread.start()
+        log.info("metrics endpoint on http://0.0.0.0:%d/metrics", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

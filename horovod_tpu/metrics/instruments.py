@@ -1,0 +1,123 @@
+"""The standard horovod_tpu metric catalog (docs/metrics.md).
+
+Each accessor returns the live metric from the process-global registry,
+creating it on first touch.  Accessors re-resolve through the registry on
+every call (a dict lookup under a lock) so handles never go stale across
+``reset_metrics()`` — instrumentation sites may still cache the returned
+object locally when they sit in a tight loop.
+"""
+
+from __future__ import annotations
+
+from .registry import exponential_buckets, get_registry
+
+#: Fused-batch fill: tensors per executed response.
+FUSION_TENSOR_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512]
+#: Fused-batch fill: bytes per executed response (1 KiB .. 1 GiB).
+FUSION_BYTE_BUCKETS = exponential_buckets(1024.0, 4.0, 10)
+
+
+def engine_ticks():
+    return get_registry().counter(
+        "hvd_engine_ticks_total", "Background engine loop iterations.")
+
+
+def allreduce_latency():
+    return get_registry().histogram(
+        "hvd_allreduce_latency_seconds",
+        "Wall time of one executed allreduce/adasum response (fused "
+        "bucket), submit-batch to results-ready.",
+        labels=("dtype", "compression"))
+
+
+def collective_latency():
+    return get_registry().histogram(
+        "hvd_collective_latency_seconds",
+        "Wall time of one executed response, any collective op.",
+        labels=("op",))
+
+
+def fusion_tensors():
+    return get_registry().histogram(
+        "hvd_fusion_tensors",
+        "Tensors fused into one executed response.",
+        buckets=FUSION_TENSOR_BUCKETS)
+
+
+def fusion_bytes():
+    return get_registry().histogram(
+        "hvd_fusion_bytes",
+        "Payload bytes of one executed response (pre-compression).",
+        buckets=FUSION_BYTE_BUCKETS)
+
+
+def response_cache_hits():
+    return get_registry().counter(
+        "hvd_response_cache_hits_total",
+        "Negotiations answered from the response cache.")
+
+
+def response_cache_misses():
+    return get_registry().counter(
+        "hvd_response_cache_misses_total",
+        "Negotiations that required a full metadata exchange.")
+
+
+def negotiations():
+    return get_registry().counter(
+        "hvd_negotiations_total",
+        "Coordinator negotiation rounds that produced responses (rank 0).")
+
+
+def wire_bytes():
+    return get_registry().counter(
+        "hvd_wire_bytes_total",
+        "Collective payload bytes this rank put on the wire, after "
+        "compression.", labels=("compression",))
+
+
+def wire_bytes_exact():
+    return get_registry().counter(
+        "hvd_wire_bytes_exact_total",
+        "Collective payload bytes the same traffic would have cost "
+        "uncompressed (ratio denominator).")
+
+
+def quantization_ratio():
+    return get_registry().gauge(
+        "hvd_quantization_ratio",
+        "Running wire-bytes / exact-bytes ratio (1.0 = no compression win).",
+        agg="max")
+
+
+def error_feedback_roundtrips():
+    return get_registry().counter(
+        "hvd_error_feedback_roundtrips_total",
+        "Eager quantize/dequantize round trips with EF-SGD residual "
+        "accumulation (ops/compression.py quantize_roundtrip).")
+
+
+def control_bytes():
+    return get_registry().counter(
+        "hvd_control_bytes_total",
+        "Control-plane (coordinator TCP) frame bytes.",
+        labels=("direction",))
+
+
+def elastic_epoch():
+    return get_registry().gauge(
+        "hvd_elastic_epoch",
+        "Current membership epoch (0 for non-elastic jobs).", agg="max")
+
+
+def elastic_rank_lost():
+    return get_registry().counter(
+        "hvd_elastic_rank_lost_total",
+        "Workers declared lost by the coordinator (elastic membership).")
+
+
+def stalled_tensors():
+    return get_registry().gauge(
+        "hvd_stalled_tensors",
+        "Tensors currently past the stall-check deadline with ranks "
+        "missing.", agg="max")

@@ -96,6 +96,11 @@ def quantize_roundtrip(x, block: int | None = None):
     residual the wire dropped.
     """
     block = block or block_size()
+    # metric lives here (the eager entry point), not in the jit-traced
+    # quantize/dequantize bodies where an inc would count compiles
+    from ..metrics import instruments
+
+    instruments.error_feedback_roundtrips().inc()
     flat = jnp.ravel(x)
     n = flat.shape[0]
     pad = (-n) % block

@@ -49,6 +49,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..exceptions import RanksChangedError, ShutdownError, WorkerLostError
+from ..metrics import instruments
 from ..utils.timeline import Timeline
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 from . import wire
@@ -64,6 +65,10 @@ MSG_BYE = 4
 # control-plane channel (elastic jobs have no cross-process XLA collectives)
 MSG_DATA = 5
 MSG_DATA_RESP = 6
+# fire-and-forget metrics report (rank registry snapshot -> coordinator); no
+# reply frame is sent, so it is safe to interleave with MSG_LIST/MSG_DATA
+# exchanges (their recv loops skip non-matching frame types)
+MSG_METRICS = 7
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -80,7 +85,9 @@ def _send_frame(sock: socket.socket, secret: str, msg_type: int, seq: int,
     head = struct.pack("<BIi", msg_type, seq, rank)
     mac = (hmac.new(secret.encode(), head + payload, hashlib.sha256).digest()
            if secret else b"")
-    sock.sendall(struct.pack("<I", len(payload)) + head + mac + payload)
+    frame = struct.pack("<I", len(payload)) + head + mac + payload
+    instruments.control_bytes().labels(direction="sent").inc(len(frame))
+    sock.sendall(frame)
 
 
 def _recv_exact(sock: socket.socket, n: int, stop: threading.Event) -> bytes:
@@ -110,6 +117,8 @@ def _recv_frame(sock: socket.socket, secret: str,
                         hashlib.sha256).digest()
         if not hmac.compare_digest(mac, want):
             raise ConnectionError("control-plane HMAC mismatch")
+    instruments.control_bytes().labels(direction="recv").inc(
+        4 + len(head) + len(mac) + len(payload))
     return msg_type, seq, rank, payload
 
 
@@ -253,6 +262,7 @@ class CoordState:
             if self.bye or rank not in self.members:
                 return
             self.members.discard(rank)
+            instruments.elastic_rank_lost().inc()
             self._reset_locked(
                 f"worker lost: rank {rank} dropped its control-plane "
                 f"connection ({reason})")
@@ -278,6 +288,7 @@ class CoordState:
         aggregations. Blocked waiters observe the epoch change and return
         RESP_RANKS_CHANGED / DATA_RANKS_CHANGED to their controllers."""
         self.epoch += 1
+        instruments.elastic_epoch().set(self.epoch)
         self.reset_reason = reason
         self.committed.clear()
         self.table.clear()
@@ -450,9 +461,11 @@ class CoordState:
                 m = self._meta_of(rank, cid)
                 if m is not None:
                     self.cache_hits += 1
+                    instruments.response_cache_hits().inc()
                     self._add(rank, m)
             for m in reqs:
                 self.cache_misses += 1
+                instruments.response_cache_misses().inc()
                 self._add(rank, m)
 
         now = time.monotonic()
@@ -473,6 +486,7 @@ class CoordState:
 
         ready: List[str] = []
         warnings: List[str] = []
+        n_stalled = 0
         for name, p in sorted(self.table.items(),
                               key=lambda kv: kv[1].order_idx):
             have = set(p.metas)
@@ -484,6 +498,8 @@ class CoordState:
                 continue
             waited = now - p.first_t
             missing = sorted(active - have)
+            if waited > self.stall_warning_s:
+                n_stalled += 1
             if waited > self.stall_warning_s and name not in self.warned:
                 self.warned.add(name)
                 warnings.append(
@@ -495,6 +511,8 @@ class CoordState:
                         f"stall shutdown: tensor '{name}' waited {int(waited)}"
                         f"s on ranks {missing} (HOROVOD_STALL_SHUTDOWN_TIME_"
                         "SECONDS exceeded, stall_inspector.h:80)")
+
+        instruments.stalled_tensors().set(n_stalled)
 
         singles = []
         responses: List[Response] = []
@@ -562,6 +580,8 @@ class CoordState:
                 cids.append(self._assign_cache_id(kname, pk.metas))
             responses.append(resp)
             assignments.append(cids)
+        if responses:
+            instruments.negotiations().inc()
         return wire.encode_response_list(flags, self.last_joined, responses,
                                          assignments, warnings,
                                          self.shutdown_reason, tuned=tuned,
@@ -761,6 +781,18 @@ class CoordinatorServer:
                     data = self.state.data_exchange(rank, payload)
                     _send_frame(conn, self.secret, MSG_DATA_RESP, seq, 0,
                                 data)
+                    continue
+                if mt == MSG_METRICS:
+                    # fire-and-forget: store the rank's snapshot for the
+                    # /metrics endpoint; no reply frame
+                    from ..metrics import store_report
+
+                    try:
+                        mrank, ts, snap = wire.decode_metrics_report(payload)
+                        store_report(mrank, snap, ts)
+                    except Exception:
+                        logger.debug("coordinator: bad metrics report from "
+                                     "rank %s", rank, exc_info=True)
                     continue
                 if mt != MSG_LIST:
                     raise ConnectionError(f"unexpected message type {mt}")
@@ -1133,6 +1165,24 @@ class CoordController:
             if mt == MSG_RESP and rseq == seq:
                 return data
 
+    def push_metrics(self) -> None:
+        """Ship this rank's registry snapshot to the coordinator as a
+        fire-and-forget MSG_METRICS frame (engine loop calls this every
+        HOROVOD_METRICS_INTERVAL seconds). Rank 0's registry is directly
+        visible to the endpoint, so it has nothing to ship."""
+        if self._rank == 0 or self._sock is None:
+            return
+        from ..metrics import local_snapshot
+
+        payload = wire.encode_metrics_report(
+            self._rank, time.time(), local_snapshot())
+        try:
+            with self._send_lock:
+                _send_frame(self._sock, self._secret, MSG_METRICS, 0,
+                            self._rank, payload)
+        except (ConnectionError, OSError):
+            pass  # telemetry only; the control path will surface the loss
+
     # -------------------------------------------------------------- elastic
     def commit(self) -> None:
         """Mark a commit boundary: REQ_COMMIT rides the next request frame.
@@ -1162,6 +1212,7 @@ class CoordController:
         survivors' next exchanges share a sequence number regardless of how
         far each had advanced; every later submit fails with
         SUBMIT_RANKS_CHANGED until resume()."""
+        instruments.elastic_epoch().set(epoch)
         with self._lock:
             self._epoch = epoch
             self._members = sorted(members)

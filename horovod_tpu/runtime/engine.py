@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 
 from ..exceptions import (DuplicateNameError, RanksChangedError,
                           ShutdownError)
+from ..metrics import instruments
 from ..utils.env import env_float as _env_float, env_on as _env_on
 from .executor import Executor
 from .handles import HandleManager
@@ -186,6 +187,22 @@ class Engine:
         # XLA compile time and must not be scored for autotune
         self._scored_sigs: set = set()
         self._last_cache_stats = (0, 0)
+        # wire/exact byte accumulators behind the quantization-ratio gauge
+        self._wire_acc = 0
+        self._exact_acc = 0
+        # per-rank snapshot shipping cadence (docs/metrics.md); coordinated
+        # controllers expose push_metrics(), everything else shares one
+        # process registry and has nothing to ship
+        self._metrics_interval = _env_float("HOROVOD_METRICS_INTERVAL", 5.0)
+        self._metrics_next_push = time.monotonic() + self._metrics_interval
+        # pre-touch the catalog's unlabeled series (inc(0) materializes the
+        # child) so /metrics renders them at 0 before the first negotiation
+        instruments.response_cache_hits().inc(0)
+        instruments.response_cache_misses().inc(0)
+        instruments.engine_ticks().inc(0)
+        epoch_fn = getattr(self.controller, "epoch", None)
+        instruments.elastic_epoch().set(
+            max(0, epoch_fn()) if callable(epoch_fn) else 0)
 
     # ------------------------------------------------------------------ API
     def start(self) -> None:
@@ -295,6 +312,13 @@ class Engine:
                     self._finish_drain(*drained)
                     return
                 tick = self.controller.tick()
+                instruments.engine_ticks().inc()
+                now = time.monotonic()
+                if now >= self._metrics_next_push:
+                    self._metrics_next_push = now + self._metrics_interval
+                    push = getattr(self.controller, "push_metrics", None)
+                    if push is not None:
+                        push()
                 if getattr(self.controller, "coordinated", False):
                     # coordinated autotune delivers tuned cycle time inside
                     # the tick's ResponseList; pick it up even on idle ticks
@@ -316,6 +340,17 @@ class Engine:
                     self.controller.timeline_cycle()
                     hits, misses = self.controller.cache_stats()
                     if (hits, misses) != self._last_cache_stats:
+                        # delta-based: native/pycontroller cache counters are
+                        # cumulative totals; the coordinated path already
+                        # counts at the negotiation site (rank 0), and its
+                        # worker-side cache_stats mirror the local sig cache
+                        dh = hits - self._last_cache_stats[0]
+                        dm = misses - self._last_cache_stats[1]
+                        if not getattr(self.controller, "coordinated", False):
+                            if dh > 0:
+                                instruments.response_cache_hits().inc(dh)
+                            if dm > 0:
+                                instruments.response_cache_misses().inc(dm)
                         self._last_cache_stats = (hits, misses)
                         self.controller.timeline_cache(hits, misses)
                 for resp, pairs in zip(responses, handle_pairs):
@@ -406,6 +441,33 @@ class Engine:
                 logger.error("completion callback for %r failed: %s",
                              entry.tensor_name, exc)
 
+    def _observe_perform(self, resp: Response, ebr, exact_bytes: int,
+                         wire_bytes: int, elapsed: float) -> None:
+        """Record one successfully executed response into the registry
+        (docs/metrics.md catalog). Runs on the engine thread right after the
+        executor returns; all failure paths skip it."""
+        op = resp.response_type.name.lower()
+        compression = self._executor.last_wire_mode or "none"
+        instruments.collective_latency().labels(op=op).observe(elapsed)
+        if resp.response_type in (ResponseType.ALLREDUCE,
+                                  ResponseType.ADASUM):
+            dtype = resp.tensor_dtype or next(
+                (str(e.array.dtype) for es in ebr.values() for e in es),
+                "unknown")
+            instruments.allreduce_latency().labels(
+                dtype=dtype, compression=compression).observe(elapsed)
+        n_tensors = sum(len(es) for es in ebr.values())
+        instruments.fusion_tensors().observe(n_tensors)
+        instruments.fusion_bytes().observe(exact_bytes)
+        instruments.wire_bytes().labels(compression=compression).inc(
+            wire_bytes)
+        instruments.wire_bytes_exact().inc(exact_bytes)
+        self._wire_acc += wire_bytes
+        self._exact_acc += exact_bytes
+        if self._exact_acc:
+            instruments.quantization_ratio().set(
+                self._wire_acc / self._exact_acc)
+
     # -------------------------------------------------------------- perform
     def _perform(self, resp: Response, pairs) -> None:
         """PerformOperation analogue (`operations.cc:227-304`)."""
@@ -432,6 +494,7 @@ class Engine:
         t0 = time.perf_counter()
         nbytes = sum(int(e.array.size) * e.array.dtype.itemsize
                      for es in ebr.values() for e in es)
+        exact_bytes = nbytes
         try:
             results = self._executor.execute(resp, ebr)
             if self._executor.last_wire_mode:
@@ -440,6 +503,8 @@ class Engine:
                 # reduce+gather round, same units as the fp32 accounting
                 # above) so autotune learns the compressed economics
                 nbytes = (self._executor.last_wire_bytes // 2) * len(ebr)
+            self._observe_perform(resp, ebr, exact_bytes, nbytes,
+                                  time.perf_counter() - t0)
             for r, es in ebr.items():
                 outs = results[r]
                 for e, out in zip(es, outs):

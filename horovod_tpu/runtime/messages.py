@@ -99,6 +99,19 @@ class Response:
 
 
 @dataclass
+class MetricsReport:
+    """One rank's metrics-registry snapshot shipped to the coordinator over
+    the control channel (``MSG_METRICS`` frames, fire-and-forget). The
+    coordinator stores the latest report per rank and the /metrics endpoint
+    renders the merge (docs/metrics.md). ``snapshot`` is the plain-dict shape
+    produced by :meth:`horovod_tpu.metrics.MetricsRegistry.snapshot`."""
+
+    rank: int
+    timestamp: float
+    snapshot: dict
+
+
+@dataclass
 class TensorTableEntry:
     """Pending named tensor from one rank (`common.h:129-250` TensorTableEntry).
 

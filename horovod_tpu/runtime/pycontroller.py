@@ -10,6 +10,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..metrics import instruments
 from ..utils.timeline import Timeline
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 
@@ -89,6 +90,7 @@ class PyController:
             self._last_joined = -1
             self._active_ranks = set(ranks)
             self._epoch = epoch
+        instruments.elastic_epoch().set(max(0, epoch))
         self._timeline.epoch_marker(epoch)
         return orphans
 
@@ -243,6 +245,7 @@ class PyController:
             ready, waiting = [], []
             stall_warnings: List[str] = []
             stall_shutdown = False
+            n_stalled = 0
             for name in self._order:
                 st = self._table.get(name)
                 if st is None:
@@ -255,11 +258,19 @@ class PyController:
                 else:
                     waiting.append(name)
                     waited = now - min(m.enqueue_t for m in st.values())
-                    if waited > self._stall_warning_s and name not in self._warned:
-                        self._warned.add(name)
-                        stall_warnings.append(name)
+                    if waited > self._stall_warning_s:
+                        n_stalled += 1
+                        if name not in self._warned:
+                            self._warned.add(name)
+                            # same shape as the coordinated stall report:
+                            # name the ranks this tensor is still waiting on
+                            missing = sorted(active - set(st.keys()))
+                            stall_warnings.append(
+                                f"{name} (waiting on ranks {missing} for "
+                                f"{int(waited)}s)")
                     if self._stall_shutdown_s and waited > self._stall_shutdown_s:
                         stall_shutdown = True
+            instruments.stalled_tensors().set(n_stalled)
             self._order = waiting
             if not ready and not stall_warnings and not stall_shutdown:
                 return None

@@ -412,6 +412,83 @@ def decode_data_request(buf: bytes):
     return epoch, dseq, op, root, dtype, shape, payload
 
 
+# --------------------------------------------------------------------------
+# Metrics reports (MSG_METRICS frames): one rank's registry snapshot, shipped
+# to the coordinator fire-and-forget every HOROVOD_METRICS_INTERVAL seconds
+# and merged into the /metrics endpoint (docs/metrics.md). The payload is the
+# plain-dict snapshot shape from metrics.MetricsRegistry.snapshot().
+# --------------------------------------------------------------------------
+
+def encode_metrics_report(rank: int, timestamp: float,
+                          snapshot: dict) -> bytes:
+    w = Writer()
+    w.i32(rank)
+    w.f64(timestamp)
+    w.u32(len(snapshot))
+    for name in sorted(snapshot):
+        fam = snapshot[name]
+        w.str(name)
+        w.str(fam["kind"])
+        w.str(fam.get("help", ""))
+        w.str(fam.get("agg", ""))
+        buckets = fam.get("buckets") or ()
+        w.u32(len(buckets))
+        for b in buckets:
+            w.f64(float(b))
+        series = fam.get("series", [])
+        w.u32(len(series))
+        for s in series:
+            labels = s.get("labels", {})
+            w.u32(len(labels))
+            for k in sorted(labels):
+                w.str(k)
+                w.str(str(labels[k]))
+            if fam["kind"] == "histogram":
+                counts = s["counts"]
+                w.u32(len(counts))
+                for c in counts:
+                    w.i64(int(c))
+                w.f64(float(s["sum"]))
+                w.i64(int(s["count"]))
+            else:
+                w.f64(float(s["value"]))
+    return w.getvalue()
+
+
+def decode_metrics_report(buf: bytes):
+    """Returns (rank, timestamp, snapshot)."""
+    rd = Reader(buf)
+    rank = rd.i32()
+    timestamp = rd.f64()
+    snapshot = {}
+    for _ in range(rd.u32()):
+        name = rd.str()
+        kind = rd.str()
+        help_ = rd.str()
+        agg = rd.str()
+        buckets = [rd.f64() for _ in range(rd.u32())]
+        fam = {"kind": kind, "help": help_, "series": []}
+        if agg:
+            fam["agg"] = agg
+        if buckets:
+            fam["buckets"] = buckets
+        for _ in range(rd.u32()):
+            labels = {}
+            for _ in range(rd.u32()):
+                k = rd.str()
+                labels[k] = rd.str()
+            if kind == "histogram":
+                counts = [rd.i64() for _ in range(rd.u32())]
+                total = rd.f64()
+                count = rd.i64()
+                fam["series"].append({"labels": labels, "counts": counts,
+                                      "sum": total, "count": count})
+            else:
+                fam["series"].append({"labels": labels, "value": rd.f64()})
+        snapshot[name] = fam
+    return rank, timestamp, snapshot
+
+
 def encode_data_result(status: int, epoch: int, nparticipants: int,
                        members: Optional[List[int]],
                        payload: bytes) -> bytes:

@@ -32,6 +32,7 @@ class Timeline:
         self._tid = {}
         self._next_tid = 1
         self._writer = None
+        self._wrote_event = False
         if self._enabled:
             self._f = open(path, "w")
             self._f.write("[\n")
@@ -48,11 +49,25 @@ class Timeline:
             self._q.put(ev)
 
     def _writer_loop(self) -> None:
+        # Comma BEFORE every event after the first keeps the file one valid
+        # JSON array at all times once close() appends "]"; batching the
+        # flush to queue-empty boundaries keeps the hot path off the disk.
         while True:
             ev = self._q.get()
             if ev is None:
                 return
-            self._f.write(json.dumps(ev) + ",\n")
+            while True:
+                if self._wrote_event:
+                    self._f.write(",\n")
+                self._f.write(json.dumps(ev))
+                self._wrote_event = True
+                try:
+                    ev = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if ev is None:
+                    self._f.flush()
+                    return
             self._f.flush()
 
     def _ts(self) -> int:
@@ -124,9 +139,9 @@ class Timeline:
         self._q.put(None)
         if self._writer is not None:
             self._writer.join(timeout=2)
-        # valid-enough JSON: chrome tracing accepts trailing commas when the
-        # array is closed; terminate with an empty metadata event.
-        self._f.write('{"name":"end","ph":"M","pid":0}\n]\n')
+        # the writer never leaves a trailing comma, so closing the array
+        # yields strictly valid Chrome-trace JSON ("[]" when no events fired)
+        self._f.write("\n]\n")
         self._f.close()
         self._enabled = False
 

@@ -36,6 +36,37 @@ def test_stall_warning_then_completion(monkeypatch, caplog):
     assert any("slow" in m for m in messages)
 
 
+def test_stall_warning_names_missing_ranks(monkeypatch, caplog):
+    """The stall warning names exactly WHICH ranks the tensor is waiting on,
+    matching the coordinated controller's report format — and the
+    hvd_stalled_tensors gauge tracks the stall while it lasts. Forces the
+    pure-Python controller: the gauge/rank-list site under test lives there
+    (the native core formats its own warnings)."""
+    monkeypatch.setenv("HVD_TPU_NATIVE", "0")
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.2")
+    from horovod_tpu.metrics import instruments
+
+    def fn():
+        if hvd.rank() == 1:
+            time.sleep(0.8)  # > stall warning threshold
+        out = hvd.allreduce(np.full((4,), float(hvd.rank() + 1),
+                                    np.float32), name="slow", op=hvd.Sum)
+        return np.asarray(out)
+
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        results = testing.run_cluster(fn, np=2)
+    for r in results:
+        np.testing.assert_allclose(r, np.full((4,), 3.0))
+    messages = [rec.getMessage() for rec in caplog.records]
+    stall_msgs = [m for m in messages if "waiting for remainder" in m]
+    assert stall_msgs, messages
+    # thread-cluster mode: rank 1 is the laggard, so the warning must name it
+    assert any("slow" in m and "waiting on ranks [1]" in m
+               for m in stall_msgs), stall_msgs
+    # the live gauge cleared once the laggard arrived and the op completed
+    assert instruments.stalled_tensors().value == 0
+
+
 def test_stall_shutdown(monkeypatch):
     """HOROVOD_STALL_SHUTDOWN_TIME_SECONDS kills the job when a rank never
     shows up (`stall_inspector.h:80`): outstanding handles fail instead of
