@@ -36,11 +36,21 @@ def parse_args(argv=None):
     p.add_argument("--metrics-dump", metavar="PATH", default=None,
                    help="write the final aggregated runtime-metrics snapshot "
                         "(hvd.metrics(), docs/metrics.md) as JSON to PATH")
+    p.add_argument("--chaos", metavar="SPEC", default=None,
+                   help="inject control-plane faults while benchmarking: a "
+                        "HOROVOD_FAULT_SPEC string, e.g. "
+                        "'conn_drop@tick:100;corrupt@frame:50' "
+                        "(docs/fault-tolerance.md). Measures throughput "
+                        "under reconnect/replay recovery")
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.chaos:
+        # must land before hvd.init(): the controller builds its injector
+        # (and wraps its control socket) at connect time
+        os.environ["HOROVOD_FAULT_SPEC"] = args.chaos
     import jax
     import jax.numpy as jnp
     import optax

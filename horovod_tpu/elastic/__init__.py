@@ -7,6 +7,17 @@ See docs/elastic.md. Public surface:
   membership resets
 - :class:`~.executor.ElasticExecutor` — internal: host-wire data plane the
   engine installs when ``HVD_ELASTIC=1``
+
+Interplay with control-plane fault tolerance (docs/fault-tolerance.md): a
+dropped worker connection no longer reaches ``rank_lost`` directly. The
+worker first gets ``HOROVOD_RECONNECT_GRACE`` seconds to reconnect and
+replay its in-flight exchange (transparent recovery — no membership reset,
+no epoch bump). Only when the grace window expires, or when heartbeats go
+silent past ``HOROVOD_HEARTBEAT_TIMEOUT``, does the coordinator feed the
+rank into the elastic ``rank_lost`` path and the machinery in this package
+takes over: epoch bump, barrier release with RANKS_CHANGED, re-rendezvous,
+state re-sync. Transient network blips therefore cost a reconnect instead
+of a full membership reset.
 """
 
 from .state import ElasticState, run, run_fn  # noqa: F401

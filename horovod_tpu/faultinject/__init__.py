@@ -1,0 +1,42 @@
+"""Env-driven fault injection for the control plane (docs/fault-tolerance.md).
+
+``HOROVOD_FAULT_SPEC`` (grammar in :mod:`.spec`) describes deterministic
+faults — connection drops, stalls, partial writes, corrupted/truncated
+frames — injected at named points of the coordinator wire on either side.
+The harness exists so the hardening in `runtime/coordinator.py` (reconnect,
+replay, heartbeats, CRC frame checks) is provable from tests and
+``bench.py --chaos`` rather than only observable in production incidents.
+
+Usage from instrumented code::
+
+    faults = faultinject.for_rank(rank)       # None when no spec is set
+    if faults is not None:
+        faults.fire("tick")                   # named-point hook
+        sock = faults.wrap(sock)              # frame-granular faults
+
+The spec is re-read from the environment on every :func:`for_rank` call, so
+tests can monkeypatch ``HOROVOD_FAULT_SPEC`` per scenario; with the variable
+unset the layer costs one dict lookup and adds nothing to the hot path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .injector import FaultSocket, Injector
+from .spec import FaultRule, parse_spec
+
+__all__ = ["FaultRule", "FaultSocket", "Injector", "parse_spec", "for_rank"]
+
+ENV_VAR = "HOROVOD_FAULT_SPEC"
+
+
+def for_rank(rank: int) -> Optional[Injector]:
+    """Build this rank's injector from ``HOROVOD_FAULT_SPEC``; None when the
+    spec is unset/empty or matches no rule for this rank."""
+    text = os.environ.get(ENV_VAR, "").strip()
+    if not text:
+        return None
+    inj = Injector(parse_spec(text), rank)
+    return inj if inj.active() else None

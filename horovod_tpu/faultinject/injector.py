@@ -1,0 +1,118 @@
+"""Deterministic fault execution: per-rank hit counters + socket wrapper.
+
+The :class:`Injector` owns one hit counter per (rule, point) so a spec like
+``conn_drop@tick:3`` fires at exactly the third tick of the process it runs
+in — deterministic by construction, no randomness anywhere. Frame-granular
+kinds (corrupt/truncate/partial, and conn_drop/delay at point ``frame``) are
+applied by :class:`FaultSocket`, which wraps the real control-plane socket
+and counts every outgoing frame as one hit of point ``frame``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .spec import FaultRule
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class Injector:
+    """Executes a parsed fault plan for one rank."""
+
+    def __init__(self, rules: List[FaultRule], rank: int):
+        self.rank = rank
+        self._rules = [r for r in rules if r.applies_to(rank)]
+        self._hits = {}  # id(rule) -> hit count
+        self._lock = threading.Lock()
+        self._drop_cb: Optional[Callable[[], None]] = None
+
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    def set_drop_callback(self, cb: Callable[[], None]) -> None:
+        """Register how a point-level ``conn_drop`` severs the connection
+        (the controller closes its current socket)."""
+        self._drop_cb = cb
+
+    def actions_for(self, point: str) -> List[Tuple[str, float]]:
+        """Count one hit of ``point`` and return the (kind, seconds) pairs
+        that fire on this hit."""
+        fired: List[Tuple[str, float]] = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.point != point:
+                    continue
+                key = id(rule)
+                n = self._hits.get(key, 0) + 1
+                self._hits[key] = n
+                if rule.nth is None or rule.nth == n:
+                    fired.append((rule.kind, rule.seconds))
+                    logger.warning(
+                        "faultinject: rank %s firing %s at %s (hit %d)",
+                        self.rank, rule.kind, point, n)
+        return fired
+
+    def fire(self, point: str) -> None:
+        """Named-point hook (tick/exchange/connect/heartbeat). Only
+        ``delay`` and ``conn_drop`` are meaningful outside the socket
+        wrapper; frame-granular kinds are ignored here."""
+        for kind, seconds in self.actions_for(point):
+            if kind == "delay":
+                time.sleep(seconds)
+            elif kind == "conn_drop" and self._drop_cb is not None:
+                self._drop_cb()
+
+    def wrap(self, sock) -> "FaultSocket":
+        return FaultSocket(sock, self)
+
+
+class FaultSocket:
+    """Socket proxy applying frame-granular faults to each sendall().
+
+    The control plane writes exactly one frame per sendall() call, so a
+    ``frame`` hit maps 1:1 onto wire frames. Reads pass through untouched —
+    corruption is injected on the sender, where the byte layout is known.
+    """
+
+    def __init__(self, sock, injector: Injector):
+        self._sock = sock
+        self._inj = injector
+
+    def sendall(self, data: bytes) -> None:
+        for kind, seconds in self._inj.actions_for("frame"):
+            if kind == "delay":
+                time.sleep(seconds)
+            elif kind == "conn_drop":
+                # close before sending: this sendall (or the next recv)
+                # surfaces the loss exactly as a peer reset would
+                self._close_quietly()
+            elif kind == "corrupt":
+                # flip every bit of the last byte: payload (or MAC) damage
+                # the receiver's CRC32/HMAC check must reject. The length
+                # prefix is left intact so framing itself survives.
+                data = data[:-1] + bytes([data[-1] ^ 0xFF])
+            elif kind == "truncate":
+                self._sock.sendall(data[:max(1, len(data) // 2)])
+                self._close_quietly()
+                raise ConnectionError(
+                    "faultinject: truncated frame mid-send")
+            elif kind == "partial":
+                # byte-at-a-time writes: the receiver must loop to the
+                # declared length instead of assuming whole-frame reads
+                for i in range(0, len(data), 1):
+                    self._sock.sendall(data[i:i + 1])
+                return
+        self._sock.sendall(data)
+
+    def _close_quietly(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)

@@ -1,0 +1,113 @@
+"""``HOROVOD_FAULT_SPEC`` grammar (docs/fault-tolerance.md).
+
+A spec is a semicolon-separated list of rules, each of the form::
+
+    kind@point[:arg[:arg2]][#ranks]
+
+* ``kind`` — what to inject:
+    - ``conn_drop``  close the control-plane socket (the peer sees a
+      connection reset; the worker-side reconnect path takes over)
+    - ``delay``      sleep ``arg`` seconds at the point
+    - ``corrupt``    flip a byte of the outgoing frame (the receiver's
+      CRC32 check rejects it and drops the connection)
+    - ``truncate``   send only half the frame, then close the socket
+      (the receiver observes a short read mid-frame)
+    - ``partial``    split the frame into byte-sized writes (exercises the
+      receiver's loop-to-declared-length read path; the frame arrives
+      intact)
+* ``point`` — a named injection site. Frame-granular kinds fire inside the
+  wrapped socket at point ``frame`` (one hit per sent frame); ``tick``,
+  ``exchange``, ``connect`` and ``heartbeat`` are explicit hooks in
+  `runtime/coordinator.py`.
+* ``arg`` — for ``delay`` the sleep in seconds, with an optional second
+  arg restricting it to the Nth hit (default: every hit). For every other
+  kind the 1-based hit index at which the rule fires once (default 1).
+* ``#ranks`` — optional comma list of ranks the rule applies to
+  (default: every rank).
+
+Example (the ISSUE's): ``conn_drop@tick:3;delay@exchange:0.5;corrupt@frame:1``
+— drop the connection at the 3rd engine tick, sleep 500 ms before every
+exchange, and corrupt the very first control-plane frame sent.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial")
+
+# kinds applied to outgoing frames by the FaultSocket wrapper (as opposed to
+# the named fire() hooks in controller code)
+FRAME_KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial")
+
+
+class FaultRule:
+    """One parsed rule; hit counting lives in the Injector."""
+
+    __slots__ = ("kind", "point", "nth", "seconds", "ranks")
+
+    def __init__(self, kind: str, point: str, nth: Optional[int],
+                 seconds: float, ranks: Optional[Sequence[int]]):
+        self.kind = kind
+        self.point = point
+        self.nth = nth            # 1-based hit index; None = every hit
+        self.seconds = seconds    # only meaningful for kind == "delay"
+        self.ranks = None if ranks is None else frozenset(ranks)
+
+    def applies_to(self, rank: int) -> bool:
+        return self.ranks is None or rank in self.ranks
+
+    def __repr__(self):
+        extra = f":{self.seconds}" if self.kind == "delay" else ""
+        nth = f":{self.nth}" if self.nth is not None else ""
+        ranks = ("" if self.ranks is None
+                 else "#" + ",".join(str(r) for r in sorted(self.ranks)))
+        return f"{self.kind}@{self.point}{extra}{nth}{ranks}"
+
+
+def parse_spec(text: str) -> List[FaultRule]:
+    """Parse a ``HOROVOD_FAULT_SPEC`` string; raises ValueError with the
+    offending rule on any grammar violation."""
+    rules: List[FaultRule] = []
+    for raw in (text or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        rule, _, rankpart = raw.partition("#")
+        ranks = None
+        if rankpart:
+            try:
+                ranks = [int(r) for r in rankpart.split(",") if r.strip()]
+            except ValueError:
+                raise ValueError(
+                    f"HOROVOD_FAULT_SPEC: bad rank list {rankpart!r} "
+                    f"in rule {raw!r}")
+        kind, sep, rest = rule.partition("@")
+        kind = kind.strip()
+        if not sep or kind not in KINDS:
+            raise ValueError(
+                f"HOROVOD_FAULT_SPEC: bad rule {raw!r} (expected "
+                f"kind@point[:arg][#ranks] with kind in {KINDS})")
+        parts = rest.split(":")
+        point = parts[0].strip()
+        if not point:
+            raise ValueError(
+                f"HOROVOD_FAULT_SPEC: rule {raw!r} names no point")
+        args = parts[1:]
+        try:
+            if kind == "delay":
+                if not args:
+                    raise ValueError
+                seconds = float(args[0])
+                nth = int(args[1]) if len(args) > 1 else None
+            else:
+                seconds = 0.0
+                nth = int(args[0]) if args else 1
+                if nth < 1:
+                    raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"HOROVOD_FAULT_SPEC: bad argument(s) {args!r} "
+                f"in rule {raw!r}")
+        rules.append(FaultRule(kind, point, nth, seconds, ranks))
+    return rules

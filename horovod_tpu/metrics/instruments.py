@@ -121,3 +121,24 @@ def stalled_tensors():
         "hvd_stalled_tensors",
         "Tensors currently past the stall-check deadline with ranks "
         "missing.", agg="max")
+
+
+def control_reconnects():
+    return get_registry().counter(
+        "hvd_control_reconnects_total",
+        "Successful worker-side control-plane reconnects (transparent "
+        "recovery from a dropped coordinator connection).")
+
+
+def heartbeat_misses():
+    return get_registry().counter(
+        "hvd_heartbeat_misses_total",
+        "Worker heartbeat intervals the coordinator observed as missed "
+        "(HOROVOD_HEARTBEAT_INTERVAL elapsed with no frame from a rank).")
+
+
+def frames_rejected():
+    return get_registry().counter(
+        "hvd_frames_rejected_total",
+        "Control-plane frames rejected for integrity violations "
+        "(CRC32/HMAC mismatch or an over-bound length prefix).")

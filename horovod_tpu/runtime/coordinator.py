@@ -26,31 +26,41 @@ What negotiation provides over the round-1 "SPMD program order" mode:
     id; steady-state ticks submit 4-byte ids instead of full request metadata
     and skip re-validation at the coordinator.
 
-Wire protocol (framed over one persistent TCP connection per worker):
-  frame = u32 payload_len | u8 msg_type | u32 seq | u32 rank |
-          [32-byte HMAC-SHA256 when a job secret is set] | payload
-Payloads are the RequestList/ResponseList codecs in `runtime/wire.py`.
-Address discovery: rank 0 binds an ephemeral port and publishes it through the
-launcher's HMAC KV store (``HVD_KV_ADDR``/``HVD_SECRET``) or, absent a
-launcher, through the jax.distributed coordinator's KV service.
+Wire protocol: framed over one persistent TCP connection per worker
+(CRC32-checked, size-bounded framing owned by `runtime/wire.py`
+send_frame/recv_frame). Payloads are the RequestList/ResponseList codecs in
+`runtime/wire.py`. Address discovery: rank 0 binds an ephemeral port and
+publishes it through the launcher's HMAC KV store
+(``HVD_KV_ADDR``/``HVD_SECRET``) or, absent a launcher, through the
+jax.distributed coordinator's KV service.
+
+Fault tolerance (docs/fault-tolerance.md): a dropped worker connection is no
+longer fatal. Workers reconnect with bounded exponential backoff and replay
+the in-flight request under its original ``seq``; the coordinator caches the
+last response per rank so a replay is answered idempotently instead of
+double-applying the request list. The coordinator declares a rank dead only
+after ``HOROVOD_RECONNECT_GRACE`` passes with no resume (or, for silent
+deaths where TCP never errors, after ``HOROVOD_HEARTBEAT_TIMEOUT`` with no
+frame), feeding the existing elastic ``rank_lost`` path.
 """
 
 from __future__ import annotations
 
-import hashlib
-import hmac
 import logging
 import os
 import re
 import socket
-import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from collections import OrderedDict
+
 from ..exceptions import RanksChangedError, ShutdownError, WorkerLostError
 from ..metrics import instruments
+from ..utils.env import env_float as _env_float
 from ..utils.timeline import Timeline
+from .. import faultinject
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 from . import wire
 from .wire import ReqMeta
@@ -69,6 +79,14 @@ MSG_DATA_RESP = 6
 # reply frame is sent, so it is safe to interleave with MSG_LIST/MSG_DATA
 # exchanges (their recv loops skip non-matching frame types)
 MSG_METRICS = 7
+# fire-and-forget worker liveness beacon, sent off-thread every
+# HOROVOD_HEARTBEAT_INTERVAL seconds so a worker stuck in a long compile (or
+# simply idle) still proves it is alive
+MSG_HEARTBEAT = 8
+# hello variant announcing a reconnect: payload carries the last seq whose
+# response the worker fully received; the serve loop answers replayed
+# requests from the coordinator's per-rank response cache
+MSG_RESUME = 9
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -77,49 +95,6 @@ EPOCH_SEQ_BASE = 1 << 20
 
 _FUSABLE = (int(RequestType.ALLREDUCE), int(RequestType.ADASUM),
             int(RequestType.ALLGATHER))
-
-
-# --------------------------------------------------------------------- frames
-def _send_frame(sock: socket.socket, secret: str, msg_type: int, seq: int,
-                rank: int, payload: bytes = b"") -> None:
-    head = struct.pack("<BIi", msg_type, seq, rank)
-    mac = (hmac.new(secret.encode(), head + payload, hashlib.sha256).digest()
-           if secret else b"")
-    frame = struct.pack("<I", len(payload)) + head + mac + payload
-    instruments.control_bytes().labels(direction="sent").inc(len(frame))
-    sock.sendall(frame)
-
-
-def _recv_exact(sock: socket.socket, n: int, stop: threading.Event) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        if stop.is_set():
-            raise ShutdownError("control plane shut down")
-        try:
-            chunk = sock.recv(n - len(buf))
-        except socket.timeout:
-            continue
-        if not chunk:
-            raise ConnectionError("control-plane peer closed connection")
-        buf += chunk
-    return buf
-
-
-def _recv_frame(sock: socket.socket, secret: str,
-                stop: threading.Event) -> Tuple[int, int, int, bytes]:
-    n = struct.unpack("<I", _recv_exact(sock, 4, stop))[0]
-    head = _recv_exact(sock, 9, stop)
-    msg_type, seq, rank = struct.unpack("<BIi", head)
-    mac = _recv_exact(sock, 32, stop) if secret else b""
-    payload = _recv_exact(sock, n, stop) if n else b""
-    if secret:
-        want = hmac.new(secret.encode(), head + payload,
-                        hashlib.sha256).digest()
-        if not hmac.compare_digest(mac, want):
-            raise ConnectionError("control-plane HMAC mismatch")
-    instruments.control_bytes().labels(direction="recv").inc(
-        4 + len(head) + len(mac) + len(payload))
-    return msg_type, seq, rank, payload
 
 
 # ---------------------------------------------------------------- coordinator
@@ -169,14 +144,36 @@ class CoordState:
         self.last_joined = -1
         self.bye = False
         self.shutdown_reason = ""
-        # response cache: name -> id; id -> {rank: that rank's last full
-        # ReqMeta}. Per-rank metas keep ragged allgathers cacheable (each
-        # rank's dim0 differs); a rank whose request params change simply
-        # misses its local sig cache and retransmits, refreshing its meta here.
-        self.cache_ids: Dict[str, int] = {}
-        self.cache_meta: List[Dict[int, ReqMeta]] = []
+        # response cache: name -> id (LRU-ordered; least recently touched
+        # first) and id -> {rank: that rank's last full ReqMeta}. Per-rank
+        # metas keep ragged allgathers cacheable (each rank's dim0 differs);
+        # a rank whose request params change simply misses its local sig
+        # cache and retransmits, refreshing its meta here. Ids come from a
+        # monotonic counter and are NEVER reused: a worker still holding an
+        # evicted id must never alias another tensor's metadata, so eviction
+        # invalidates (via the ResponseList ``invalid_ids`` block) instead
+        # of recycling.
+        self.cache_ids: "OrderedDict[str, int]" = OrderedDict()
+        self.cache_meta: Dict[int, Dict[int, ReqMeta]] = {}
+        self.next_cache_id = 0
         self.cache_hits = 0
         self.cache_misses = 0
+        # ---- reconnect/replay (docs/fault-tolerance.md): the last response
+        # handed to each rank, keyed by its seq, so a worker that lost the
+        # reply mid-flight can reconnect and replay the request without the
+        # coordinator double-applying it; inflight_* dedupes a replay that
+        # races the original serve thread (a second entry for the same
+        # (rank, seq) would double-count ``fetched`` and strand the barrier).
+        self.last_resp: Dict[int, Tuple[int, bytes]] = {}
+        self.inflight_seq: Dict[int, int] = {}
+        self.last_data_resp: Dict[int, Tuple[Tuple[int, int], bytes]] = {}
+        self.inflight_data: Dict[int, Tuple[int, int]] = {}
+        # ---- liveness: last frame time per rank, ranks inside the
+        # reconnect-grace window, and how many heartbeat intervals each rank
+        # has already been charged as missed
+        self.last_seen: Dict[int, float] = {}
+        self.disconnected: Dict[int, Tuple[float, str]] = {}
+        self._hb_miss_counts: Dict[int, int] = {}
         self.warned: set = set()
         # ---- elastic membership (docs/elastic.md). Non-elastic jobs keep
         # members == range(world) for life, so every len(self.members)
@@ -198,59 +195,91 @@ class CoordState:
         with self.cv:
             if self.bye:
                 return self._shutdown_bytes()
-            flags_cached_reqs_score = wire.decode_request_list(payload)
-            score = flags_cached_reqs_score[3]
-            if self.elastic:
-                if rank not in self.members:
-                    # prospective joiner: blocks until every current member
-                    # reaches a commit boundary, then enters under the bumped
-                    # epoch (re-rendezvous; docs/elastic.md)
-                    self.pending_joins.add(rank)
-                    self._maybe_admit_locked()
-                    while rank not in self.members:
-                        if self.bye:
-                            self.pending_joins.discard(rank)
-                            return self._shutdown_bytes()
-                        self.cv.wait(timeout=0.5)
-                    return self._ranks_changed_bytes()
-                if flags_cached_reqs_score[4] != self.epoch:
-                    # stale-epoch submission (queued before a reset): fail
-                    # fast instead of entering a barrier the current member
-                    # set can never complete
-                    return self._ranks_changed_bytes()
-                if flags_cached_reqs_score[0] & wire.REQ_COMMIT:
-                    self.committed.add(rank)
-                    self._maybe_admit_locked()
-                    if self.epoch != flags_cached_reqs_score[4]:
-                        # this commit admitted joiners; the frame itself is
-                        # now stale — sender re-syncs like everyone else
-                        return self._ranks_changed_bytes()
-            if score is not None and self.tuner is not None:
-                self.round_bytes += score[0]
-                self.round_seconds = max(self.round_seconds, score[1])
-            self.lists.setdefault(seq, {})[rank] = flags_cached_reqs_score[:3]
-            if len(self.lists[seq]) == len(self.members):
-                self.expected[seq] = len(self.members)
-                self.resps[seq] = self._negotiate(self.lists.pop(seq))
+            last = self.last_resp.get(rank)
+            if last is not None and last[0] == seq:
+                # replayed request after a reconnect: answer from the cached
+                # response instead of double-applying the request list
+                logger.warning("coordinator: replaying cached response for "
+                               "rank %s seq %s", rank, seq)
+                return last[1]
+            if self.inflight_seq.get(rank) == seq:
+                # a replay racing the original serve thread (still blocked
+                # in the barrier): wait for its result rather than entering
+                # the exchange twice
+                while True:
+                    if self.bye:
+                        return self._shutdown_bytes()
+                    last = self.last_resp.get(rank)
+                    if last is not None and last[0] == seq:
+                        return last[1]
+                    if self.inflight_seq.get(rank) != seq:
+                        break  # original died resultless; process normally
+                    self.cv.wait(timeout=0.5)
+            self.inflight_seq[rank] = seq
+            try:
+                data = self._exchange_locked(rank, seq, payload)
+            finally:
+                if self.inflight_seq.get(rank) == seq:
+                    del self.inflight_seq[rank]
                 self.cv.notify_all()
-            entry_epoch = self.epoch
-            while seq not in self.resps:
-                if self.bye:
-                    return self._shutdown_bytes()
-                if self.elastic and self.epoch != entry_epoch:
-                    # membership reset while blocked: withdraw our entry and
-                    # realign instead of waiting on a dead barrier
-                    if seq in self.lists:
-                        self.lists[seq].pop(rank, None)
-                    return self._ranks_changed_bytes()
-                self.cv.wait(timeout=0.5)
-            data = self.resps[seq]
-            self.fetched[seq] = self.fetched.get(seq, 0) + 1
-            if self.fetched[seq] >= self.expected.get(seq, self.world):
-                del self.resps[seq]
-                del self.fetched[seq]
-                self.expected.pop(seq, None)
+            self.last_resp[rank] = (seq, data)
             return data
+
+    def _exchange_locked(self, rank: int, seq: int, payload: bytes) -> bytes:
+        # runs under self.cv (the exchange() wrapper holds it)
+        flags_cached_reqs_score = wire.decode_request_list(payload)
+        score = flags_cached_reqs_score[3]
+        if self.elastic:
+            if rank not in self.members:
+                # prospective joiner: blocks until every current member
+                # reaches a commit boundary, then enters under the bumped
+                # epoch (re-rendezvous; docs/elastic.md)
+                self.pending_joins.add(rank)
+                self._maybe_admit_locked()
+                while rank not in self.members:
+                    if self.bye:
+                        self.pending_joins.discard(rank)
+                        return self._shutdown_bytes()
+                    self.cv.wait(timeout=0.5)
+                return self._ranks_changed_bytes()
+            if flags_cached_reqs_score[4] != self.epoch:
+                # stale-epoch submission (queued before a reset): fail
+                # fast instead of entering a barrier the current member
+                # set can never complete
+                return self._ranks_changed_bytes()
+            if flags_cached_reqs_score[0] & wire.REQ_COMMIT:
+                self.committed.add(rank)
+                self._maybe_admit_locked()
+                if self.epoch != flags_cached_reqs_score[4]:
+                    # this commit admitted joiners; the frame itself is
+                    # now stale — sender re-syncs like everyone else
+                    return self._ranks_changed_bytes()
+        if score is not None and self.tuner is not None:
+            self.round_bytes += score[0]
+            self.round_seconds = max(self.round_seconds, score[1])
+        self.lists.setdefault(seq, {})[rank] = flags_cached_reqs_score[:3]
+        if len(self.lists[seq]) == len(self.members):
+            self.expected[seq] = len(self.members)
+            self.resps[seq] = self._negotiate(self.lists.pop(seq))
+            self.cv.notify_all()
+        entry_epoch = self.epoch
+        while seq not in self.resps:
+            if self.bye:
+                return self._shutdown_bytes()
+            if self.elastic and self.epoch != entry_epoch:
+                # membership reset while blocked: withdraw our entry and
+                # realign instead of waiting on a dead barrier
+                if seq in self.lists:
+                    self.lists[seq].pop(rank, None)
+                return self._ranks_changed_bytes()
+            self.cv.wait(timeout=0.5)
+        data = self.resps[seq]
+        self.fetched[seq] = self.fetched.get(seq, 0) + 1
+        if self.fetched[seq] >= self.expected.get(seq, self.world):
+            del self.resps[seq]
+            del self.fetched[seq]
+            self.expected.pop(seq, None)
+        return data
 
     # ---- elastic membership (all under self.cv unless noted)
     def rank_lost(self, rank: int, reason: str) -> None:
@@ -262,10 +291,91 @@ class CoordState:
             if self.bye or rank not in self.members:
                 return
             self.members.discard(rank)
+            for per_rank in (self.disconnected, self.last_seen,
+                             self._hb_miss_counts, self.last_resp,
+                             self.inflight_seq, self.last_data_resp,
+                             self.inflight_data):
+                per_rank.pop(rank, None)
             instruments.elastic_rank_lost().inc()
             self._reset_locked(
                 f"worker lost: rank {rank} dropped its control-plane "
                 f"connection ({reason})")
+
+    # ---- liveness (docs/fault-tolerance.md)
+    def mark_alive(self, rank: int) -> None:
+        """Any frame from a rank proves it alive (heartbeats exist so idle
+        or long-compiling workers keep producing frames)."""
+        with self.cv:
+            self.last_seen[rank] = time.monotonic()
+
+    def rank_disconnected(self, rank: int, reason: str) -> None:
+        """A serve thread lost its connection. Not yet fatal: start the
+        reconnect-grace clock; :meth:`check_liveness` declares the rank lost
+        only if no resume arrives within HOROVOD_RECONNECT_GRACE."""
+        with self.cv:
+            if self.bye or rank not in self.members:
+                return
+            if rank in self.disconnected:
+                return
+            self.disconnected[rank] = (time.monotonic(), reason)
+            logger.warning(
+                "coordinator: rank %s disconnected (%s); waiting for a "
+                "resume within the reconnect grace window", rank, reason)
+
+    def rank_reconnected(self, rank: int, last_acked: int) -> None:
+        """MSG_RESUME arrived: cancel the grace clock and reset the
+        heartbeat ledger. The replayed request (if any) follows on the new
+        connection and is answered via the replay cache."""
+        with self.cv:
+            self.disconnected.pop(rank, None)
+            self._hb_miss_counts.pop(rank, None)
+            self.last_seen[rank] = time.monotonic()
+            logger.warning("coordinator: rank %s resumed its control-plane "
+                           "connection (last acked seq %s)", rank, last_acked)
+
+    def check_liveness(self, grace_s: float, hb_interval: float,
+                       hb_timeout: float) -> None:
+        """Periodic sweep (CoordinatorServer monitor thread): charge missed
+        heartbeat intervals and declare ranks dead — disconnected past the
+        grace window, or silent past HOROVOD_HEARTBEAT_TIMEOUT (the
+        silently-dead case where TCP never errors). Dead ranks feed the
+        elastic ``rank_lost`` path; non-elastic jobs shut down coordinated,
+        exactly as an observed connection loss used to."""
+        now = time.monotonic()
+        lost: List[Tuple[int, str]] = []
+        with self.cv:
+            if self.bye:
+                return
+            for rank, (t0, reason) in list(self.disconnected.items()):
+                if now - t0 > grace_s:
+                    lost.append((rank, f"no reconnect within the "
+                                 f"{grace_s:g}s grace window after: "
+                                 f"{reason}"))
+            if hb_interval > 0:
+                for rank, seen in list(self.last_seen.items()):
+                    # a rank with an exchange in flight is provably alive:
+                    # its serve thread is parked in the barrier and cannot
+                    # drain heartbeats queued behind the request frame
+                    if (rank == 0 or rank not in self.members
+                            or rank in self.disconnected
+                            or rank in self.inflight_seq
+                            or rank in self.inflight_data):
+                        continue
+                    age = now - seen
+                    misses = int(age // hb_interval)
+                    prev = self._hb_miss_counts.get(rank, 0)
+                    if misses > prev:
+                        instruments.heartbeat_misses().inc(misses - prev)
+                        self._hb_miss_counts[rank] = misses
+                    if hb_timeout > 0 and age > hb_timeout:
+                        lost.append((rank, f"no heartbeat for {age:.1f}s "
+                                     "(HOROVOD_HEARTBEAT_TIMEOUT="
+                                     f"{hb_timeout:g})"))
+        for rank, why in lost:
+            if self.elastic and rank > 0:
+                self.rank_lost(rank, why)
+            else:
+                self.set_bye(f"worker rank {rank} declared dead: {why}")
 
     def _maybe_admit_locked(self) -> None:
         if not self.pending_joins:
@@ -297,12 +407,17 @@ class CoordState:
         self.joined &= self.members
         self.last_joined = -1
         self.cache_ids.clear()
-        self.cache_meta = []
+        self.cache_meta.clear()  # next_cache_id stays monotonic: old ids
+        # must never alias tensors cached under the new epoch
         self.lists.clear()
         self.resps.clear()
         self.fetched.clear()
         self.expected.clear()
         self.data.clear()
+        # replay caches die with the epoch (seqs realign to epoch *
+        # EPOCH_SEQ_BASE, so no stale entry could match anyway)
+        self.last_resp.clear()
+        self.last_data_resp.clear()
         logger.warning("elastic: membership epoch %d (%s); members now %s",
                        self.epoch, reason, sorted(self.members))
         self._publish_members_locked()
@@ -341,40 +456,73 @@ class CoordState:
         """Aggregate one rank's allreduce/broadcast payload for (epoch, dseq)
         over the current member set; blocks until all members contribute.
         The reply carries the participant count so Average divides by the
-        epoch's actual world size."""
+        epoch's actual world size. Replays after a reconnect are answered
+        from the per-rank response cache, mirroring :meth:`exchange`."""
         (epoch, dseq, op, root, dtype, shape,
          raw) = wire.decode_data_request(payload)
+        key = (epoch, dseq)
         with self.cv:
             if self.bye:
                 return self._data_error_locked()
-            if (not self.elastic or rank not in self.members
-                    or epoch != self.epoch):
-                return self._ranks_changed_data_locked()
-            key = (epoch, dseq)
-            agg = self.data.get(key)
-            if agg is None:
-                agg = self.data[key] = {"parts": {}, "result": None,
-                                        "nparticipants": 0, "fetched": 0,
-                                        "expected": set(self.members)}
-            agg["parts"][rank] = (op, root, dtype, shape, raw)
-            if (agg["result"] is None
-                    and set(agg["parts"]) >= agg["expected"]):
-                agg["result"] = self._combine(agg)
-                agg["nparticipants"] = len(agg["parts"])
+            last = self.last_data_resp.get(rank)
+            if last is not None and last[0] == key:
+                logger.warning("coordinator: replaying cached data response "
+                               "for rank %s (epoch %s, dseq %s)",
+                               rank, epoch, dseq)
+                return last[1]
+            if self.inflight_data.get(rank) == key:
+                while True:
+                    if self.bye:
+                        return self._data_error_locked()
+                    last = self.last_data_resp.get(rank)
+                    if last is not None and last[0] == key:
+                        return last[1]
+                    if self.inflight_data.get(rank) != key:
+                        break
+                    self.cv.wait(timeout=0.5)
+            self.inflight_data[rank] = key
+            try:
+                data = self._data_exchange_locked(rank, key, op, root,
+                                                  dtype, shape, raw)
+            finally:
+                if self.inflight_data.get(rank) == key:
+                    del self.inflight_data[rank]
                 self.cv.notify_all()
-            while agg["result"] is None:
-                if self.bye:
-                    return self._data_error_locked()
-                if self.epoch != epoch:
-                    return self._ranks_changed_data_locked()
-                self.cv.wait(timeout=0.5)
-            out = wire.encode_data_result(wire.DATA_OK, epoch,
-                                          agg["nparticipants"], None,
-                                          agg["result"])
-            agg["fetched"] += 1
-            if agg["fetched"] >= agg["nparticipants"]:
-                self.data.pop(key, None)
-            return out
+            self.last_data_resp[rank] = (key, data)
+            return data
+
+    def _data_exchange_locked(self, rank: int, key: Tuple[int, int],
+                              op: int, root: int, dtype: str, shape,
+                              raw: bytes) -> bytes:
+        # runs under self.cv (the data_exchange() wrapper holds it)
+        epoch, dseq = key
+        if (not self.elastic or rank not in self.members
+                or epoch != self.epoch):
+            return self._ranks_changed_data_locked()
+        agg = self.data.get(key)
+        if agg is None:
+            agg = self.data[key] = {"parts": {}, "result": None,
+                                    "nparticipants": 0, "fetched": 0,
+                                    "expected": set(self.members)}
+        agg["parts"][rank] = (op, root, dtype, shape, raw)
+        if (agg["result"] is None
+                and set(agg["parts"]) >= agg["expected"]):
+            agg["result"] = self._combine(agg)
+            agg["nparticipants"] = len(agg["parts"])
+            self.cv.notify_all()
+        while agg["result"] is None:
+            if self.bye:
+                return self._data_error_locked()
+            if self.epoch != epoch:
+                return self._ranks_changed_data_locked()
+            self.cv.wait(timeout=0.5)
+        out = wire.encode_data_result(wire.DATA_OK, epoch,
+                                      agg["nparticipants"], None,
+                                      agg["result"])
+        agg["fetched"] += 1
+        if agg["fetched"] >= agg["nparticipants"]:
+            self.data.pop(key, None)
+        return out
 
     @staticmethod
     def _combine(agg: dict) -> bytes:
@@ -423,9 +571,8 @@ class CoordState:
 
     # ---- negotiation (single-threaded under self.cv)
     def _meta_of(self, rank: int, cid: int) -> Optional[ReqMeta]:
-        if 0 <= cid < len(self.cache_meta):
-            return self.cache_meta[cid].get(rank)
-        return None
+        metas = self.cache_meta.get(cid)
+        return None if metas is None else metas.get(rank)
 
     def _tune(self) -> Optional[Tuple[int, float]]:
         """Feed the round's aggregated score to the GP/EI and return the
@@ -452,6 +599,7 @@ class CoordState:
     def _negotiate(self, per_rank) -> bytes:
         flags = 0
         tuned = self._tune()
+        invalid: set = set()
         for rank, (rflags, cached, reqs) in per_rank.items():
             if rflags & wire.REQ_JOIN:
                 if rank not in self.joined:
@@ -462,7 +610,16 @@ class CoordState:
                 if m is not None:
                     self.cache_hits += 1
                     instruments.response_cache_hits().inc()
+                    if m.name in self.cache_ids:
+                        self.cache_ids.move_to_end(m.name)
                     self._add(rank, m)
+                else:
+                    # the id was evicted (LRU churn or stall invalidation)
+                    # after this rank cached it: report it in invalid_ids so
+                    # the rank forgets it and resubmits full metadata
+                    invalid.add(cid)
+                    self.cache_misses += 1
+                    instruments.response_cache_misses().inc()
             for m in reqs:
                 self.cache_misses += 1
                 instruments.response_cache_misses().inc()
@@ -482,7 +639,8 @@ class CoordState:
             self.last_joined = -1
             return wire.encode_response_list(flags, last, [], [], [],
                                              tuned=tuned, epoch=epoch,
-                                             members=emembers)
+                                             members=emembers,
+                                             invalid_ids=sorted(invalid))
 
         ready: List[str] = []
         warnings: List[str] = []
@@ -504,6 +662,13 @@ class CoordState:
                 self.warned.add(name)
                 warnings.append(
                     f"{name} (waiting on ranks {missing} for {int(waited)}s)")
+                # stall invalidation: drop the stalled tensor's cache entry
+                # so every rank renegotiates it from full metadata once the
+                # stall clears (a stale per-rank meta here could otherwise
+                # mask the divergence that caused the stall)
+                stale_cid = self.cache_ids.pop(name, None)
+                if stale_cid is not None:
+                    self.cache_meta.pop(stale_cid, None)
             if self.stall_shutdown_s and waited > self.stall_shutdown_s:
                 flags |= wire.RESP_SHUTDOWN
                 if not self.shutdown_reason:
@@ -585,7 +750,8 @@ class CoordState:
         return wire.encode_response_list(flags, self.last_joined, responses,
                                          assignments, warnings,
                                          self.shutdown_reason, tuned=tuned,
-                                         epoch=epoch, members=emembers)
+                                         epoch=epoch, members=emembers,
+                                         invalid_ids=sorted(invalid))
 
     def _add(self, rank: int, m: ReqMeta) -> None:
         p = self.table.get(m.name)
@@ -617,11 +783,19 @@ class CoordState:
     def _assign_cache_id(self, name: str, metas: Dict[int, ReqMeta]) -> int:
         cid = self.cache_ids.get(name)
         if cid is None:
-            if len(self.cache_meta) >= self.cache_capacity:
+            if self.cache_capacity <= 0:
                 return -1
-            cid = len(self.cache_meta)
-            self.cache_meta.append({})
+            while len(self.cache_ids) >= self.cache_capacity:
+                # evict the least recently negotiated name; workers holding
+                # its id learn via invalid_ids on their next submission
+                _, evicted = self.cache_ids.popitem(last=False)
+                self.cache_meta.pop(evicted, None)
+            cid = self.next_cache_id
+            self.next_cache_id += 1
             self.cache_ids[name] = cid
+            self.cache_meta[cid] = {}
+        else:
+            self.cache_ids.move_to_end(name)
         # refresh each participating rank's meta (a rank whose params changed
         # arrives here via the full-metadata path and is re-recorded)
         self.cache_meta[cid].update(metas)
@@ -743,6 +917,18 @@ class CoordinatorServer:
         self.state = state
         self.secret = secret
         self._stop = threading.Event()
+        # coordinator-side fault injection (rank 0 hosts the server)
+        self._faults = faultinject.for_rank(0)
+        # per-rank connection generation: a serve thread that loses its
+        # connection reports the loss only if no newer connection has taken
+        # over the rank — a stale thread unblocking late must not re-mark a
+        # reconnected rank as disconnected
+        self._conn_gen: Dict[int, int] = {}
+        self._gen_lock = threading.Lock()
+        # liveness knobs, read once (docs/fault-tolerance.md)
+        self._grace_s = _env_float("HOROVOD_RECONNECT_GRACE", 10.0)
+        self._hb_interval = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
+        self._hb_timeout = _env_float("HOROVOD_HEARTBEAT_TIMEOUT", 0.0)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
@@ -751,6 +937,9 @@ class CoordinatorServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="hvd_coord_accept", daemon=True)
         self._accept_thread.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="hvd_coord_liveness", daemon=True)
+        self._monitor_thread.start()
 
     def _accept_loop(self) -> None:
         self._sock.settimeout(0.5)
@@ -762,25 +951,49 @@ class CoordinatorServer:
             except OSError:
                 return
             conn.settimeout(0.5)
+            if self._faults is not None:
+                conn = self._faults.wrap(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              name="hvd_coord_conn", daemon=True).start()
 
-    def _serve(self, conn: socket.socket) -> None:
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.5):
+            try:
+                self.state.check_liveness(self._grace_s, self._hb_interval,
+                                          self._hb_timeout)
+            except Exception:
+                logger.debug("coordinator: liveness sweep failed",
+                             exc_info=True)
+
+    def _serve(self, conn) -> None:
         rank = -1
+        gen = 0
         try:
-            mt, _, rank, _ = _recv_frame(conn, self.secret, self._stop)
-            if mt != MSG_HELLO:
-                raise ConnectionError(f"expected HELLO, got {mt}")
+            mt, _, rank, payload = wire.recv_frame(conn, self.secret,
+                                                   self._stop)
+            if mt not in (MSG_HELLO, MSG_RESUME):
+                raise ConnectionError(f"expected HELLO/RESUME, got {mt}")
+            with self._gen_lock:
+                gen = self._conn_gen.get(rank, 0) + 1
+                self._conn_gen[rank] = gen
+            self.state.mark_alive(rank)
+            if mt == MSG_RESUME:
+                self.state.rank_reconnected(rank,
+                                            wire.decode_resume(payload))
             while True:
-                mt, seq, rank, payload = _recv_frame(conn, self.secret,
-                                                     self._stop)
+                mt, seq, rank, payload = wire.recv_frame(conn, self.secret,
+                                                         self._stop)
+                self.state.mark_alive(rank)
                 if mt == MSG_BYE:
                     self.state.set_bye()
                     return
+                if mt == MSG_HEARTBEAT:
+                    # liveness beacon: mark_alive above is the whole effect
+                    continue
                 if mt == MSG_DATA:
                     data = self.state.data_exchange(rank, payload)
-                    _send_frame(conn, self.secret, MSG_DATA_RESP, seq, 0,
-                                data)
+                    wire.send_frame(conn, self.secret, MSG_DATA_RESP, seq, 0,
+                                    data)
                     continue
                 if mt == MSG_METRICS:
                     # fire-and-forget: store the rank's snapshot for the
@@ -797,24 +1010,21 @@ class CoordinatorServer:
                 if mt != MSG_LIST:
                     raise ConnectionError(f"unexpected message type {mt}")
                 data = self.state.exchange(rank, seq, payload)
-                _send_frame(conn, self.secret, MSG_RESP, seq, 0, data)
+                wire.send_frame(conn, self.secret, MSG_RESP, seq, 0, data)
         except ShutdownError:
             pass
         except (ConnectionError, OSError) as exc:
-            if not self._stop.is_set():
-                if self.state.elastic and rank > 0:
-                    # elastic: losing a non-coordinator worker is survivable —
-                    # membership reset instead of job shutdown. Rank 0 hosts
-                    # this very coordinator, so its loss stays fatal.
-                    logger.warning("coordinator: rank %s connection lost "
-                                   "(%s); elastic membership reset",
-                                   rank, exc)
-                    self.state.rank_lost(rank, str(exc))
-                    return
-                logger.warning("coordinator: rank %s connection lost (%s); "
-                               "broadcasting shutdown", rank, exc)
-                self.state.set_bye(f"lost control-plane connection to rank "
-                                   f"{rank}: {exc}")
+            if self._stop.is_set() or rank < 0:
+                return
+            with self._gen_lock:
+                stale = self._conn_gen.get(rank, 0) != gen
+            if stale:
+                # the rank already resumed on a newer connection; this
+                # thread's late error says nothing about current liveness
+                return
+            logger.warning("coordinator: rank %s connection lost (%s); "
+                           "reconnect grace window open", rank, exc)
+            self.state.rank_disconnected(rank, str(exc))
         finally:
             try:
                 conn.close()
@@ -955,6 +1165,16 @@ class CoordController:
         self._score_bytes = 0
         self._score_busy = 0.0
         self._score_epoch: Optional[float] = None
+        # ---- fault tolerance (docs/fault-tolerance.md)
+        self._faults = faultinject.for_rank(self_rank)
+        self._last_acked = -1  # highest seq whose response fully arrived
+        self._reconnect_attempts = int(
+            _env_float("HOROVOD_RECONNECT_ATTEMPTS", 8))
+        self._reconnect_backoff = _env_float("HOROVOD_RECONNECT_BACKOFF",
+                                             0.05)
+        self._reconnect_backoff_max = _env_float(
+            "HOROVOD_RECONNECT_BACKOFF_MAX", 2.0)
+        self._hb_interval = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
         # ---- elastic membership (docs/elastic.md)
         self._elastic = os.environ.get("HVD_ELASTIC", "") not in ("", "0")
         self._epoch = 0 if self._elastic else -1
@@ -998,11 +1218,20 @@ class CoordController:
                 self._state, self._secret, host=bind)
             _publish(gen, f"{advertise}:{self._server.port}", self._secret)
             self._sock: Optional[socket.socket] = None
+            self._addr = "in-process"
+            self._host, self._port = "", 0
         else:
             self._state = None
             self._server = None
             addr, self._secret = _resolve(gen, start_timeout)
             host, port = addr.rsplit(":", 1)
+            # retained so the reconnect path can re-dial after a drop and so
+            # connection-loss errors can say who was unreachable
+            self._addr = addr
+            self._host, self._port = host, int(port)
+            if self._faults is not None:
+                self._faults.fire("connect")
+                self._faults.set_drop_callback(self._drop_connection)
             deadline = time.monotonic() + start_timeout
             last: Optional[Exception] = None
             while True:
@@ -1017,7 +1246,13 @@ class CoordController:
                             f"cannot reach coordinator at {addr}: {last}")
                     time.sleep(0.2)
             self._sock.settimeout(0.5)
-            _send_frame(self._sock, self._secret, MSG_HELLO, 0, self_rank)
+            if self._faults is not None:
+                self._sock = self._faults.wrap(self._sock)
+            wire.send_frame(self._sock, self._secret, MSG_HELLO, 0,
+                            self_rank)
+            if self._hb_interval > 0:
+                threading.Thread(target=self._heartbeat_loop,
+                                 name="hvd_heartbeat", daemon=True).start()
 
     # ------------------------------------------------------------- engine API
     def submit(self, entry: TensorTableEntry) -> int:
@@ -1060,6 +1295,8 @@ class CoordController:
     def tick(self):
         if self._stop.is_set():
             raise ShutdownError("control plane shut down")
+        if self._faults is not None:
+            self._faults.fire("tick")
         with self._lock:
             outbox, self._outbox = self._outbox, []
             flags = 0
@@ -1089,10 +1326,18 @@ class CoordController:
                                            epoch=epoch)
         try:
             data = self._exchange(seq, payload)
-        except (ConnectionError, OSError):
-            raise ShutdownError("control-plane connection lost")
-        (rflags, last_joined, responses, assignments, warnings,
-         reason, tuned, repoch, rmembers) = wire.decode_response_list(data)
+        except (ConnectionError, OSError) as exc:
+            # _exchange already retried through the reconnect path; landing
+            # here means the loss is unrecoverable — say exactly where the
+            # control plane died (satellite of docs/fault-tolerance.md)
+            raise ShutdownError(
+                f"control-plane connection lost (coordinator {self._addr}, "
+                f"rank {self._rank}, last sent seq {seq}, last acked seq "
+                f"{self._last_acked}, errno={getattr(exc, 'errno', None)}: "
+                f"{exc!r})")
+        (rflags, last_joined, responses, assignments, warnings, reason,
+         tuned, repoch, rmembers,
+         invalid_ids) = wire.decode_response_list(data)
         if rflags & wire.RESP_RANKS_CHANGED:
             self._apply_ranks_changed(repoch, rmembers or [], reason)
         for resp in responses:
@@ -1113,6 +1358,19 @@ class CoordController:
         handle_pairs: List[List[Tuple[int, int]]] = []
         join_released: List[int] = []
         with self._lock:
+            if invalid_ids:
+                # the coordinator evicted these cache ids (LRU churn or
+                # stall invalidation): forget them and resubmit the affected
+                # requests with full metadata on the next tick
+                dead = set(invalid_ids)
+                self._sig_cache = {sig: cid
+                                   for sig, cid in self._sig_cache.items()
+                                   if cid not in dead}
+                for req in self._inflight.values():
+                    if req.cached_id in dead:
+                        req.cached_id = -1
+                        if req not in self._outbox:
+                            self._outbox.append(req)
             for resp, cids in zip(responses, assignments):
                 pairs: List[Tuple[int, int]] = []
                 for name, cid in zip(resp.tensor_names, cids):
@@ -1155,15 +1413,108 @@ class CoordController:
         if self._rank == 0:
             assert self._state is not None
             return self._state.exchange(0, seq, payload)
-        assert self._sock is not None
-        with self._send_lock:
-            _send_frame(self._sock, self._secret, MSG_LIST, seq, self._rank,
-                        payload)
+        if self._faults is not None:
+            self._faults.fire("exchange")
+        data = self._request_reply(MSG_LIST, MSG_RESP, seq, payload)
+        self._last_acked = seq
+        return data
+
+    def _request_reply(self, msg_type: int, resp_type: int, frame_seq: int,
+                       payload: bytes) -> bytes:
+        """Worker-side request/reply over the control socket with
+        transparent reconnect: on connection loss, re-establish and re-send
+        the SAME frame under the SAME seq — the coordinator's replay cache
+        makes the retry idempotent (docs/fault-tolerance.md)."""
         while True:
-            mt, rseq, _, data = _recv_frame(self._sock, self._secret,
-                                            self._stop)
-            if mt == MSG_RESP and rseq == seq:
-                return data
+            try:
+                sock = self._sock
+                assert sock is not None
+                with self._send_lock:
+                    wire.send_frame(sock, self._secret, msg_type, frame_seq,
+                                    self._rank, payload)
+                while True:
+                    mt, rseq, _, data = wire.recv_frame(sock, self._secret,
+                                                        self._stop)
+                    if mt == resp_type and rseq == frame_seq:
+                        return data
+            except (ConnectionError, OSError) as exc:
+                if self._stop.is_set():
+                    raise ShutdownError("control plane shut down")
+                logger.warning("control plane: connection error on seq %s "
+                               "(%s); reconnecting to %s",
+                               frame_seq, exc, self._addr)
+                self._reconnect(exc, frame_seq)
+
+    def _drop_connection(self) -> None:
+        """faultinject conn_drop hook: sever the live control connection the
+        way a network partition would — the reconnect path must recover."""
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        """Off-thread liveness beacon (rank > 0): one MSG_HEARTBEAT every
+        HOROVOD_HEARTBEAT_INTERVAL seconds, so the coordinator can tell a
+        silently-dead worker from an idle one. Send errors are ignored —
+        the exchange path owns reconnects."""
+        while not self._stop.wait(self._hb_interval):
+            if self._faults is not None:
+                self._faults.fire("heartbeat")
+            try:
+                with self._send_lock:
+                    if self._bye_sent or self._sock is None:
+                        return
+                    wire.send_frame(self._sock, self._secret, MSG_HEARTBEAT,
+                                    0, self._rank)
+            except (ConnectionError, OSError):
+                pass
+
+    def _reconnect(self, why: Exception, seq: int) -> None:
+        """Bounded-exponential-backoff reconnect: fresh TCP connection plus
+        a MSG_RESUME handshake carrying the last seq whose response fully
+        arrived. The caller then re-sends its in-flight frame under the
+        original seq and the coordinator answers from its replay cache.
+        Raises a fully-attributed ShutdownError once attempts run out."""
+        backoff = self._reconnect_backoff
+        last: Exception = why
+        for attempt in range(1, self._reconnect_attempts + 1):
+            if self._stop.wait(backoff):
+                raise ShutdownError("control plane shut down")
+            backoff = min(backoff * 2, self._reconnect_backoff_max)
+            try:
+                sock = socket.create_connection((self._host, self._port),
+                                                timeout=5)
+                sock.settimeout(0.5)
+                if self._faults is not None:
+                    sock = self._faults.wrap(sock)
+                wire.send_frame(sock, self._secret, MSG_RESUME, 0,
+                                self._rank,
+                                wire.encode_resume(self._last_acked))
+            except (ConnectionError, OSError) as exc:
+                last = exc
+                continue
+            with self._send_lock:
+                old, self._sock = self._sock, sock
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            instruments.control_reconnects().inc()
+            logger.warning(
+                "control plane: reconnected to coordinator %s after %s "
+                "(attempt %d, replaying seq %s, last acked seq %s)",
+                self._addr, why, attempt, seq, self._last_acked)
+            return
+        raise ShutdownError(
+            f"control-plane connection lost (coordinator {self._addr}, "
+            f"rank {self._rank}, last sent seq {seq}, last acked seq "
+            f"{self._last_acked}, {self._reconnect_attempts} reconnect "
+            f"attempts failed, last error "
+            f"errno={getattr(last, 'errno', None)}: {last!r})")
 
     def push_metrics(self) -> None:
         """Ship this rank's registry snapshot to the coordinator as a
@@ -1178,8 +1529,8 @@ class CoordController:
             self._rank, time.time(), local_snapshot())
         try:
             with self._send_lock:
-                _send_frame(self._sock, self._secret, MSG_METRICS, 0,
-                            self._rank, payload)
+                wire.send_frame(self._sock, self._secret, MSG_METRICS, 0,
+                                self._rank, payload)
         except (ConnectionError, OSError):
             pass  # telemetry only; the control path will surface the loss
 
@@ -1251,17 +1602,16 @@ class CoordController:
                 assert self._state is not None
                 data = self._state.data_exchange(0, payload)
             else:
-                assert self._sock is not None
-                with self._send_lock:
-                    _send_frame(self._sock, self._secret, MSG_DATA,
-                                frame_seq, self._rank, payload)
-                while True:
-                    mt, rseq, _, data = _recv_frame(self._sock, self._secret,
-                                                    self._stop)
-                    if mt == MSG_DATA_RESP and rseq == frame_seq:
-                        break
-        except (ConnectionError, OSError):
-            raise ShutdownError("control-plane connection lost")
+                if self._faults is not None:
+                    self._faults.fire("exchange")
+                data = self._request_reply(MSG_DATA, MSG_DATA_RESP,
+                                           frame_seq, payload)
+        except (ConnectionError, OSError) as exc:
+            raise ShutdownError(
+                f"control-plane connection lost during data exchange "
+                f"(coordinator {self._addr}, rank {self._rank}, epoch "
+                f"{epoch}, dseq {dseq}, "
+                f"errno={getattr(exc, 'errno', None)}: {exc!r})")
         (status, repoch, nparticipants, rmembers,
          raw) = wire.decode_data_result(data)
         if status == wire.DATA_RANKS_CHANGED:
@@ -1290,8 +1640,8 @@ class CoordController:
                 self._state.set_bye()
             elif self._sock is not None:
                 try:
-                    _send_frame(self._sock, self._secret, MSG_BYE, 0,
-                                self._rank)
+                    wire.send_frame(self._sock, self._secret, MSG_BYE, 0,
+                                    self._rank)
                 except OSError:
                     pass
 

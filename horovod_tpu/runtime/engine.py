@@ -200,6 +200,9 @@ class Engine:
         instruments.response_cache_hits().inc(0)
         instruments.response_cache_misses().inc(0)
         instruments.engine_ticks().inc(0)
+        instruments.control_reconnects().inc(0)
+        instruments.heartbeat_misses().inc(0)
+        instruments.frames_rejected().inc(0)
         epoch_fn = getattr(self.controller, "epoch", None)
         instruments.elastic_epoch().set(
             max(0, epoch_fn()) if callable(epoch_fn) else 0)

@@ -36,6 +36,16 @@ class ResponseType(enum.IntEnum):
     ERROR = 6
 
 
+class Frame(NamedTuple):
+    """One decoded control-plane TCP frame (wire.recv_frame). Field order
+    matches the wire head so existing tuple-style unpacking keeps working."""
+
+    msg_type: int
+    seq: int
+    rank: int
+    payload: bytes
+
+
 class AlltoallvResult(NamedTuple):
     """Result of a ragged ``alltoall(tensor, splits)``: the gathered output
     plus the negotiated per-source row counts (later-horovod's

@@ -4,14 +4,117 @@ Mirrors `horovod_tpu/_core/wire.h` (the TPU-native replacement for the
 reference's FlatBuffers `wire/message.fbs`): little-endian, length-prefixed.
 Used to decode tick payloads from the C++ controller and to exchange
 request/response lists over the cross-process control plane.
+
+This module also owns the control-plane TCP framing (send_frame/recv_frame):
+  frame = u32 payload_len | u8 msg_type | u32 seq | i32 rank | u32 crc32 |
+          [32-byte HMAC-SHA256 when a job secret is set] | payload
+The CRC32 covers head+payload and rejects corrupted frames cheaply and
+unconditionally (the HMAC authenticates, but only when a secret is set);
+payload_len is bounded by ``HOROVOD_FRAME_LIMIT_MB`` so a corrupted length
+prefix raises a clear :class:`FrameError` instead of an allocation blowup.
+A rejected frame is connection-fatal by design: the stream position is
+unknowable after corruption, so "resync" means dropping the connection and
+letting the reconnect/replay path (docs/fault-tolerance.md) re-establish a
+clean stream.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
+import socket
 import struct
+import threading
+import zlib
 from typing import List, Optional, Tuple
 
-from .messages import Response, ResponseType
+from ..exceptions import ShutdownError
+from ..metrics import instruments
+from .messages import Frame, Response, ResponseType
+
+
+class FrameError(ConnectionError):
+    """A control-plane frame failed integrity checks (CRC/HMAC mismatch or
+    oversized length). Subclasses ConnectionError so every handler that
+    survives a peer reset also survives a rejected frame."""
+
+
+_HEAD = struct.Struct("<BIi")
+
+
+def _frame_limit() -> int:
+    v = os.environ.get("HOROVOD_FRAME_LIMIT_MB")
+    return (int(float(v)) if v else 1024) << 20
+
+
+def send_frame(sock: socket.socket, secret: str, msg_type: int, seq: int,
+               rank: int, payload: bytes = b"") -> None:
+    head = _HEAD.pack(msg_type, seq, rank)
+    crc = struct.pack("<I", zlib.crc32(head + payload) & 0xFFFFFFFF)
+    mac = (hmac.new(secret.encode(), head + payload, hashlib.sha256).digest()
+           if secret else b"")
+    frame = struct.pack("<I", len(payload)) + head + crc + mac + payload
+    instruments.control_bytes().labels(direction="sent").inc(len(frame))
+    sock.sendall(frame)
+
+
+def recv_exact(sock: socket.socket, n: int, stop: threading.Event) -> bytes:
+    """Loop reads to exactly ``n`` bytes (short reads are normal TCP
+    behavior, not an error); raises ConnectionError on EOF mid-frame and
+    ShutdownError once ``stop`` is set."""
+    buf = b""
+    while len(buf) < n:
+        if stop.is_set():
+            raise ShutdownError("control plane shut down")
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise ConnectionError("control-plane peer closed connection")
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket, secret: str,
+               stop: threading.Event) -> Frame:
+    n = struct.unpack("<I", recv_exact(sock, 4, stop))[0]
+    limit = _frame_limit()
+    if n > limit:
+        instruments.frames_rejected().inc()
+        raise FrameError(
+            f"control-plane frame declares {n} payload bytes, over the "
+            f"{limit}-byte bound (corrupted length prefix? raise "
+            "HOROVOD_FRAME_LIMIT_MB only if frames this large are expected)")
+    head = recv_exact(sock, _HEAD.size, stop)
+    msg_type, seq, rank = _HEAD.unpack(head)
+    crc = struct.unpack("<I", recv_exact(sock, 4, stop))[0]
+    mac = recv_exact(sock, 32, stop) if secret else b""
+    payload = recv_exact(sock, n, stop) if n else b""
+    if zlib.crc32(head + payload) & 0xFFFFFFFF != crc:
+        instruments.frames_rejected().inc()
+        raise FrameError("control-plane frame CRC32 mismatch "
+                         "(corrupted frame; dropping connection to resync)")
+    if secret:
+        want = hmac.new(secret.encode(), head + payload,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            instruments.frames_rejected().inc()
+            raise FrameError("control-plane HMAC mismatch")
+    instruments.control_bytes().labels(direction="recv").inc(
+        8 + len(head) + len(mac) + len(payload))
+    return Frame(msg_type, seq, rank, payload)
+
+
+def encode_resume(last_acked_seq: int) -> bytes:
+    """MSG_RESUME payload: the highest seq whose response this worker has
+    fully received, so the coordinator can log/prune its replay cache."""
+    return struct.pack("<q", last_acked_seq)
+
+
+def decode_resume(buf: bytes) -> int:
+    return struct.unpack("<q", buf[:8])[0] if len(buf) >= 8 else -1
 
 
 class Writer:
@@ -275,7 +378,8 @@ def encode_response_list(flags: int, last_joined: int,
                          shutdown_reason: str = "",
                          tuned: Optional[Tuple[int, float]] = None,
                          epoch: int = -1,
-                         members: Optional[List[int]] = None) -> bytes:
+                         members: Optional[List[int]] = None,
+                         invalid_ids: Optional[List[int]] = None) -> bytes:
     """``cache_assignments[i]`` parallels ``responses[i].tensor_names``:
     coordinator-assigned cache id per tensor (-1 = uncached).
     ``shutdown_reason`` distinguishes a normal end-of-job shutdown (empty)
@@ -283,7 +387,10 @@ def encode_response_list(flags: int, last_joined: int,
     autotuned (fusion_threshold, cycle_time_ms) so every rank applies the
     same parameters at the same tick. ``epoch``/``members`` carry the
     membership state on RESP_RANKS_CHANGED responses (elastic); -1/None on
-    ordinary ticks keeps old decoders byte-compatible."""
+    ordinary ticks keeps old decoders byte-compatible. ``invalid_ids`` are
+    cache ids submitted this tick that the coordinator no longer recognizes
+    (LRU-evicted or stall-invalidated): holders must drop the id and
+    resubmit full metadata."""
     w = Writer()
     w.u8(flags)
     w.str(shutdown_reason)
@@ -325,6 +432,9 @@ def encode_response_list(flags: int, last_joined: int,
     w.u32(0 if members is None else len(members))
     for r in (members or ()):
         w.i32(r)
+    w.u32(0 if invalid_ids is None else len(invalid_ids))
+    for cid in (invalid_ids or ()):
+        w.i32(cid)
     return w.getvalue()
 
 
@@ -370,8 +480,11 @@ def decode_response_list(buf: bytes):
     members: Optional[List[int]] = None
     if rd.remaining() >= 4:
         members = [rd.i32() for _ in range(rd.u32())]
+    invalid_ids: List[int] = []
+    if rd.remaining() >= 4:
+        invalid_ids = [rd.i32() for _ in range(rd.u32())]
     return (flags, last_joined, responses, assignments, warnings,
-            shutdown_reason, tuned, epoch, members)
+            shutdown_reason, tuned, epoch, members, invalid_ids)
 
 
 # --------------------------------------------------------------------------

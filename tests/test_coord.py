@@ -427,10 +427,11 @@ def test_mp_fused_allreduce_with_cache_hits():
 class TestCacheCapacity:
     def test_saturated_cache_stays_correct(self):
         """Reference test technique: loop more names than cache capacity
-        (`test/test_tensorflow.py` cache stress). Saturation must disable
-        caching for the overflow names, never corrupt negotiation."""
+        (`test/test_tensorflow.py` cache stress). Saturation evicts the
+        least recently negotiated name — every negotiation stays correct and
+        every name keeps getting a (fresh, never-reused) cache id."""
         st = make_state(cache_capacity=2)
-        cids_by_name = {}
+        seen_ids = []
         for round_ in range(2):
             for i in range(5):
                 name = f"t{i}"
@@ -438,19 +439,78 @@ class TestCacheCapacity:
                     st, {0: (0, [], [meta(name)]),
                          1: (0, [], [meta(name)])})
                 assert resps[0].tensor_names == [name]
-                cids_by_name.setdefault(name, []).append(cids[0])
-        # first two names got cache ids; overflow names get the -1
-        # "not cacheable" sentinel (clients only adopt ids >= 0)
-        assert cids_by_name["t0"] == [[0], [0]]
-        assert cids_by_name["t1"] == [[1], [1]]
-        for n in ("t2", "t3", "t4"):
-            assert cids_by_name[n] == [[-1], [-1]], (n, cids_by_name[n])
-        # cached names still serve the fast path after saturation
-        _, _, resps, cids, _ = negotiate(st, {0: (0, [0], []),
-                                              1: (0, [0], [])})
-        assert resps[0].tensor_names == ["t0"]
+                assert cids[0][0] >= 0, (name, cids)
+                seen_ids.append(cids[0][0])
+        # monotonic ids, never reused: an evicted id must not alias another
+        # tensor's metadata on a worker that still holds it
+        assert seen_ids == sorted(seen_ids)
+        assert len(set(seen_ids)) == len(seen_ids) == 10
+        assert len(st.cache_ids) == 2  # capacity respected throughout
+        # the survivors (most recently negotiated) still serve the fast path
+        live = st.cache_ids["t4"]
+        _, _, resps, cids, _ = negotiate(st, {0: (0, [live], []),
+                                              1: (0, [live], [])})
+        assert resps[0].tensor_names == ["t4"]
         hits, misses = st.cache_stats()
         assert hits == 2 and misses == 20
+
+    def test_churn_reports_invalid_ids_and_recovers(self):
+        """VERDICT item 4: loop 2x capacity, then present an evicted id —
+        the coordinator must answer with ``invalid_ids`` (so workers purge
+        their sig caches) and the name must renegotiate under a fresh id."""
+        st = make_state(cache_capacity=2)
+        first_cid = None
+        for round_ in range(2):
+            for i in range(4):  # 2x capacity
+                name = f"c{i}"
+                _, _, resps, cids, _ = negotiate(
+                    st, {0: (0, [], [meta(name)]),
+                         1: (0, [], [meta(name)])})
+                assert resps[0].tensor_names == [name]
+                if first_cid is None:
+                    first_cid = cids[0][0]
+        assert first_cid not in st.cache_meta  # c0's id was churned out
+        # a rank still holding the evicted id submits it: no negotiation for
+        # it happens, and the response tells the rank to forget the id
+        out = st._negotiate({0: (0, [first_cid], []), 1: (0, [], [])})
+        decoded = wire.decode_response_list(out)
+        resps, invalid = decoded[2], decoded[9]
+        assert invalid == [first_cid]
+        assert resps == []  # nothing ready: c0 has no metadata this round
+        # the fast path recovers: full metadata resubmission gets a fresh id
+        _, _, resps, cids, _ = negotiate(
+            st, {0: (0, [], [meta("c0")]), 1: (0, [], [meta("c0")])})
+        assert resps[0].tensor_names == ["c0"]
+        assert cids[0][0] >= 0 and cids[0][0] != first_cid
+
+    def test_stall_invalidation_drops_cache_entry(self):
+        """A stall warning invalidates the stalled tensor's cache entry:
+        ranks holding its id get invalid_ids on their next submission and
+        renegotiate from full metadata once the stall clears."""
+        import time as _time
+
+        st = make_state(cache_capacity=8, stall_warning_s=0.001)
+        _, _, resps, cids, _ = negotiate(
+            st, {0: (0, [], [meta("s")]), 1: (0, [], [meta("s")])})
+        cid = cids[0][0]
+        assert cid >= 0
+        # rank 0 re-submits via the cached id, rank 1 lags -> pending
+        negotiate(st, {0: (0, [cid], []), 1: (0, [], [])})
+        _time.sleep(0.01)
+        # next round observes the stall: warning + cache invalidation
+        _, _, _, _, warnings = negotiate(st, {0: (0, [], []),
+                                              1: (0, [], [])})
+        assert warnings and "s (waiting on ranks [1]" in warnings[0]
+        assert "s" not in st.cache_ids and cid not in st.cache_meta
+        # the stale id now comes back as invalid...
+        out = st._negotiate({0: (0, [cid], []), 1: (0, [], [])})
+        assert wire.decode_response_list(out)[9] == [cid]
+        # ...and a full resubmission negotiates under a fresh id (rank 0's
+        # pending meta from the stalled round is still in the table)
+        _, _, resps, cids, _ = negotiate(
+            st, {0: (0, [], [meta("s")]), 1: (0, [], [meta("s")])})
+        assert resps[0].tensor_names == ["s"]
+        assert cids[0][0] >= 0 and cids[0][0] != cid
 
 
 def _worker_op_matrix():

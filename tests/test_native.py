@@ -188,13 +188,16 @@ def test_wire_request_response_roundtrip_randomized():
                  if rng.randint(2) else None)
         members = ([int(x) for x in rng.randint(0, 16, rng.randint(0, 4))]
                    if rng.randint(2) else [])
+        invalid = ([int(x) for x in rng.randint(0, 1000, rng.randint(0, 4))]
+                   if rng.randint(2) else [])
         buf = wire.encode_response_list(3, -1, resps, cids, warns, reason,
                                         tuned=tuned, epoch=epoch,
-                                        members=members)
+                                        members=members, invalid_ids=invalid)
         (f2, last2, r2, c2, w2, reason2, t2,
-         e2, m2) = wire.decode_response_list(buf)
+         e2, m2, inv2) = wire.decode_response_list(buf)
         assert (f2, reason2, last2, w2, t2) == (3, reason, -1, warns, tuned)
         assert (e2, m2) == (epoch, members)
+        assert inv2 == invalid
         assert c2 == cids
         for a, b in zip(r2, resps):
             assert a.response_type == b.response_type
