@@ -37,11 +37,13 @@ def parse_args(argv=None):
                    help="write the final aggregated runtime-metrics snapshot "
                         "(hvd.metrics(), docs/metrics.md) as JSON to PATH")
     p.add_argument("--chaos", metavar="SPEC", default=None,
-                   help="inject control-plane faults while benchmarking: a "
+                   help="inject faults while benchmarking: a "
                         "HOROVOD_FAULT_SPEC string, e.g. "
-                        "'conn_drop@tick:100;corrupt@frame:50' "
+                        "'conn_drop@tick:100;corrupt@frame:50' for the "
+                        "control plane or 'nan@grad:50' / "
+                        "'hang@collective:2:50' for the data-plane guards "
                         "(docs/fault-tolerance.md). Measures throughput "
-                        "under reconnect/replay recovery")
+                        "with recovery on the path")
     return p.parse_args(argv)
 
 

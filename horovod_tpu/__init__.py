@@ -50,10 +50,13 @@ from .basics import (  # noqa: F401
     xla_built,
 )
 from .exceptions import (  # noqa: F401
+    CollectiveTimeoutError,
     DuplicateNameError,
     HorovodError,
     HorovodInternalError,
+    NonFiniteError,
     NotInitializedError,
+    ParameterDesyncError,
     RanksChangedError,
     ShutdownError,
     WorkerLostError,
@@ -89,9 +92,11 @@ from .optim.distributed import (  # noqa: F401
     grad,
 )
 from . import callbacks  # noqa: F401
-from .callbacks import MetricsCallback  # noqa: F401
+from .callbacks import ConsistencyCheckCallback, MetricsCallback  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import elastic  # noqa: F401
+from . import integrity  # noqa: F401
+from .integrity import ConsistencyAuditor, GradGuard  # noqa: F401
 # NOTE: this import makes the *function* shadow the `horovod_tpu.metrics`
 # module as a package attribute (hvd.metrics() returns the aggregated
 # snapshot). The module stays importable as `from horovod_tpu.metrics

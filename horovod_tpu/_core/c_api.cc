@@ -6,6 +6,7 @@
 // controller, receives wire-encoded ResponseLists, executes the fused XLA
 // collective, and reports completion + throughput scores back for autotuning.
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -69,6 +70,14 @@ int64_t hvd_core_create(int32_t world, int64_t fusion_threshold_bytes,
   opts.fusion_enabled = fusion_enabled != 0;
   opts.local_only = local_only != 0;
   opts.self_rank = self_rank;
+  // read here rather than threaded through the C ABI: the create signature
+  // is shared with older prebuilt libraries (see native.py rebuild-on-
+  // missing-symbol), and the knob is process-wide anyway
+  if (const char* ct = std::getenv("HOROVOD_COLLECTIVE_TIMEOUT")) {
+    char* end = nullptr;
+    double v = std::strtod(ct, &end);
+    if (end != ct && v > 0) opts.collective_timeout_s = v;
+  }
   core->controller = std::make_unique<Controller>(opts);
   core->timeline = std::make_unique<TimelineWriter>(
       timeline_path ? timeline_path : "");

@@ -268,8 +268,40 @@ TickResult Controller::Tick(int64_t now_us) {
     if (all_in) {
       ready.push_back(name);
     } else {
-      still_waiting.push_back(name);
       double waited_s = (now_us - st.first_seen_us) / 1e6;
+      if (opts_.collective_timeout_s > 0 &&
+          waited_s > opts_.collective_timeout_s) {
+        // enforced watchdog: fail every submitted handle with an error
+        // naming the missing ranks (message format shared with the Python
+        // controllers; the engine keys CollectiveTimeoutError off the
+        // "collective timeout" prefix)
+        std::ostringstream msg;
+        msg << "collective timeout: tensor '" << name << "' waited "
+            << static_cast<int64_t>(waited_s) << "s on ranks [";
+        bool first = true;
+        for (int32_t r : active) {
+          if (!st.by_rank.count(r)) {
+            if (!first) msg << ", ";
+            msg << r;
+            first = false;
+          }
+        }
+        msg << "] (HOROVOD_COLLECTIVE_TIMEOUT=" << opts_.collective_timeout_s
+            << "s exceeded)";
+        Response resp;
+        resp.type = ResponseType::ERROR;
+        resp.names = {name};
+        resp.error_message = msg.str();
+        std::vector<std::pair<int32_t, int64_t>> rhs;
+        for (auto& kv : st.by_rank)
+          rhs.push_back({kv.first, kv.second.handle});
+        std::sort(rhs.begin(), rhs.end());
+        out.responses.push_back(std::move(resp));
+        out.handles.push_back(std::move(rhs));
+        table_.erase(it);
+        continue;
+      }
+      still_waiting.push_back(name);
       if (waited_s > opts_.stall_warning_s && !st.stall_warned) {
         st.stall_warned = true;
         out.stall_warnings.push_back(name);

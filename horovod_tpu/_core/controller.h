@@ -47,6 +47,10 @@ struct ControllerOptions {
   int64_t fusion_threshold_bytes = 64ll * 1024 * 1024;  // operations.cc:404
   double stall_warning_s = 60.0;   // stall_inspector.h:75
   double stall_shutdown_s = 0.0;   // stall_inspector.h:80
+  // enforced watchdog (HOROVOD_COLLECTIVE_TIMEOUT): >0 fails a tensor still
+  // missing ranks after this many seconds with an ERROR response naming
+  // them, instead of warning forever. 0 keeps warn-only stall inspection.
+  double collective_timeout_s = 0.0;
   size_t cache_capacity = 1024;    // HOROVOD_CACHE_CAPACITY
   bool fusion_enabled = true;
   // multiprocess mode: only self_rank submits to this process's table

@@ -184,6 +184,25 @@ class CommitStateCallback(Callback):
             self.state.commit()
 
 
+class ConsistencyCheckCallback(Callback):
+    """Run the cross-rank parameter consistency auditor
+    (:class:`~.integrity.ConsistencyAuditor`, docs/fault-tolerance.md)
+    every N batches. Collective: install it on EVERY rank, with the same
+    interval, or the audit's broadcast/allreduce will desynchronize the
+    ranks it exists to protect. With ``interval=None`` the
+    ``HOROVOD_CONSISTENCY_INTERVAL`` knob decides (0 disables)."""
+
+    def __init__(self, interval: Optional[int] = None,
+                 policy: Optional[str] = None, root_rank: int = 0):
+        from .integrity import ConsistencyAuditor
+
+        self.auditor = ConsistencyAuditor(interval=interval, policy=policy,
+                                          root_rank=root_rank)
+
+    def on_batch_end(self, batch, state):
+        state["params"] = self.auditor.maybe_audit(state["params"])
+
+
 class MetricsCallback(Callback):
     """Dump the aggregated runtime-metrics snapshot (docs/metrics.md) as JSON
     at epoch boundaries, on the aggregating rank only. The file is rewritten

@@ -74,17 +74,15 @@ def _load_image(path: str, image_size: Optional[int]) -> np.ndarray:
             arr = arr.astype(np.float32)
             # float fixtures are taken at face value as [0, 1]; a float
             # array of 0-255 pixel values would silently train 255x out of
-            # range, so complain loudly (1.5 leaves headroom for slightly
+            # range, so fail loudly (1.5 leaves headroom for slightly
             # out-of-gamut normalized data while catching 0-255 scales)
             amax = float(arr.max()) if arr.size else 0.0
             if amax > 1.5:
-                import warnings
-
-                warnings.warn(
+                raise ValueError(
                     f"{path}: float .npy fixture has max value {amax:.3g} "
                     "but float fixtures are NOT rescaled — expected [0, 1] "
                     "data (store uint8 for 0-255 pixel data, or divide by "
-                    "255 before saving)", RuntimeWarning, stacklevel=2)
+                    "255 before saving)")
     else:
         from PIL import Image
 

@@ -60,3 +60,25 @@ class NotInitializedError(HorovodError):
 
     Mirrors `horovod/common/operations.cc:660-663` (NOT_INITIALIZED_ERROR).
     """
+
+
+class NonFiniteError(HorovodInternalError):
+    """A gradient (or allreduce input) contained NaN/Inf under
+    ``HOROVOD_GRAD_GUARD=abort`` (docs/fault-tolerance.md, data-plane
+    integrity). The message names the offending tensors, the ranks that
+    produced them and the optimizer step."""
+
+
+class ParameterDesyncError(HorovodInternalError):
+    """Replica parameters diverged across ranks and the consistency
+    auditor runs under ``HOROVOD_CONSISTENCY_POLICY=abort``. The message
+    lists the divergent tensors and the ranks whose digests differ from
+    the root's (docs/fault-tolerance.md)."""
+
+
+class CollectiveTimeoutError(HorovodInternalError):
+    """A collective stalled past ``HOROVOD_COLLECTIVE_TIMEOUT``: some
+    ranks submitted the tensor and the remainder never arrived. Raised
+    from ``synchronize()`` on the ranks that did submit, naming the
+    tensor and the missing ranks — the enforced form of the stall
+    inspector's warning (stall_inspector.h:75)."""

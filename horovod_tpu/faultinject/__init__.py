@@ -22,14 +22,23 @@ unset the layer costs one dict lookup and adds nothing to the hot path.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import threading
+from typing import Dict, Optional, Tuple
 
 from .injector import FaultSocket, Injector
 from .spec import FaultRule, parse_spec
 
-__all__ = ["FaultRule", "FaultSocket", "Injector", "parse_spec", "for_rank"]
+__all__ = ["FaultRule", "FaultSocket", "Injector", "parse_spec", "for_rank",
+           "shared_for_rank", "reset_shared"]
 
 ENV_VAR = "HOROVOD_FAULT_SPEC"
+
+# long-lived injectors for callers that re-resolve per event (the integrity
+# layer, collective enqueue): hit counters must survive across calls, unlike
+# the fresh instance for_rank() hands a controller that keeps its own ref.
+# Keyed on (rank, spec text) so a monkeypatched spec starts fresh counters.
+_shared: Dict[Tuple[int, str], Injector] = {}
+_shared_lock = threading.Lock()
 
 
 def for_rank(rank: int) -> Optional[Injector]:
@@ -40,3 +49,27 @@ def for_rank(rank: int) -> Optional[Injector]:
         return None
     inj = Injector(parse_spec(text), rank)
     return inj if inj.active() else None
+
+
+def shared_for_rank(rank: int) -> Optional[Injector]:
+    """Like :func:`for_rank` but returns one cached injector per
+    (rank, spec) for the process's lifetime, so per-event callers get
+    cumulative hit counting. Cleared on ``hvd.shutdown()``."""
+    text = os.environ.get(ENV_VAR, "").strip()
+    if not text:
+        return None
+    key = (rank, text)
+    with _shared_lock:
+        inj = _shared.get(key)
+        if inj is None:
+            inj = Injector(parse_spec(text), rank)
+            _shared[key] = inj
+    return inj if inj.active() else None
+
+
+def reset_shared() -> None:
+    """Drop cached injectors (and their hit counters); a shutdown/re-init
+    cycle replays specs from hit 1, mirroring the auto-name counter reset
+    in `ops/collective_ops.py`."""
+    with _shared_lock:
+        _shared.clear()

@@ -57,11 +57,14 @@ class Injector:
         return fired
 
     def fire(self, point: str) -> None:
-        """Named-point hook (tick/exchange/connect/heartbeat). Only
-        ``delay`` and ``conn_drop`` are meaningful outside the socket
-        wrapper; frame-granular kinds are ignored here."""
+        """Named-point hook (tick/exchange/connect/heartbeat/collective).
+        Only ``delay``/``hang`` and ``conn_drop`` are meaningful outside
+        the socket wrapper; frame-granular kinds are ignored here. Data-
+        plane kinds (``nan``/``desync``) are queried via
+        :meth:`actions_for` by the integrity layer, which owns the
+        tensors being poisoned."""
         for kind, seconds in self.actions_for(point):
-            if kind == "delay":
+            if kind in ("delay", "hang"):
                 time.sleep(seconds)
             elif kind == "conn_drop" and self._drop_cb is not None:
                 self._drop_cb()

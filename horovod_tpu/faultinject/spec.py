@@ -15,13 +15,23 @@ A spec is a semicolon-separated list of rules, each of the form::
     - ``partial``    split the frame into byte-sized writes (exercises the
       receiver's loop-to-declared-length read path; the frame arrives
       intact)
+    - ``nan``        poison the step's gradients with NaN at point
+      ``grad`` (drives the HOROVOD_GRAD_GUARD pillar; integrity/gradguard)
+    - ``desync``     perturb one parameter leaf on this rank at point
+      ``param`` (drives the consistency auditor's detect/heal path)
+    - ``hang``       hold this rank's collective submission for ``arg``
+      seconds at point ``collective`` — a deterministic wedge; pair with
+      HOROVOD_COLLECTIVE_TIMEOUT so the watchdog fires on the peers
 * ``point`` — a named injection site. Frame-granular kinds fire inside the
   wrapped socket at point ``frame`` (one hit per sent frame); ``tick``,
   ``exchange``, ``connect`` and ``heartbeat`` are explicit hooks in
-  `runtime/coordinator.py`.
-* ``arg`` — for ``delay`` the sleep in seconds, with an optional second
-  arg restricting it to the Nth hit (default: every hit). For every other
-  kind the 1-based hit index at which the rule fires once (default 1).
+  `runtime/coordinator.py`; ``grad`` is hit once per guarded optimizer
+  step, ``param`` once per consistency audit, ``collective`` once per
+  enqueued collective (`ops/collective_ops.py`).
+* ``arg`` — for ``delay`` and ``hang`` the sleep in seconds, with an
+  optional second arg restricting it to the Nth hit (default: every hit).
+  For every other kind the 1-based hit index at which the rule fires once
+  (default 1).
 * ``#ranks`` — optional comma list of ranks the rule applies to
   (default: every rank).
 
@@ -34,11 +44,15 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial")
+KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial",
+         "nan", "desync", "hang")
 
 # kinds applied to outgoing frames by the FaultSocket wrapper (as opposed to
 # the named fire() hooks in controller code)
 FRAME_KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial")
+
+# kinds that carry a duration as their first argument
+_TIMED_KINDS = ("delay", "hang")
 
 
 class FaultRule:
@@ -51,14 +65,14 @@ class FaultRule:
         self.kind = kind
         self.point = point
         self.nth = nth            # 1-based hit index; None = every hit
-        self.seconds = seconds    # only meaningful for kind == "delay"
+        self.seconds = seconds    # only meaningful for delay/hang
         self.ranks = None if ranks is None else frozenset(ranks)
 
     def applies_to(self, rank: int) -> bool:
         return self.ranks is None or rank in self.ranks
 
     def __repr__(self):
-        extra = f":{self.seconds}" if self.kind == "delay" else ""
+        extra = f":{self.seconds}" if self.kind in _TIMED_KINDS else ""
         nth = f":{self.nth}" if self.nth is not None else ""
         ranks = ("" if self.ranks is None
                  else "#" + ",".join(str(r) for r in sorted(self.ranks)))
@@ -95,7 +109,7 @@ def parse_spec(text: str) -> List[FaultRule]:
                 f"HOROVOD_FAULT_SPEC: rule {raw!r} names no point")
         args = parts[1:]
         try:
-            if kind == "delay":
+            if kind in _TIMED_KINDS:
                 if not args:
                     raise ValueError
                 seconds = float(args[0])

@@ -1,0 +1,31 @@
+"""Data-plane integrity guard (docs/fault-tolerance.md).
+
+PR 4 hardened the *control plane* (reconnect, heartbeats, CRC frames);
+this package guards the *data plane* — the gradients, parameters and
+collectives the control plane faithfully schedules:
+
+* :class:`GradGuard` — non-finite gradient detection with a cross-rank
+  agreement bit and the ``HOROVOD_GRAD_GUARD=off|skip|zero|abort``
+  policy, wired into ``optim/distributed.py`` / ``ops/collective_ops.py``.
+* :class:`ConsistencyAuditor` — periodic cross-rank parameter digest
+  comparison (``HOROVOD_CONSISTENCY_INTERVAL``) with
+  ``HOROVOD_CONSISTENCY_POLICY=warn|heal|abort`` (heal re-broadcasts from
+  the root through the existing broadcast path).
+* the collective watchdog — ``HOROVOD_COLLECTIVE_TIMEOUT`` promotes the
+  stall inspector's warning into an enforced
+  :class:`~..exceptions.CollectiveTimeoutError` naming the tensor and the
+  missing ranks, and feeds the elastic ``rank_lost`` path
+  (`runtime/pycontroller.py` / `runtime/coordinator.py` — the watchdog
+  lives in the controllers because only they see all ranks' submissions).
+
+All three pillars are drivable from the fault harness: ``nan@grad``,
+``desync@param`` and ``hang@collective`` in ``HOROVOD_FAULT_SPEC``.
+"""
+
+from __future__ import annotations
+
+from .auditor import ConsistencyAuditor, param_digest
+from .gradguard import (OK, SKIP, GradGuard, default_guard, precheck_entry)
+
+__all__ = ["GradGuard", "ConsistencyAuditor", "param_digest",
+           "default_guard", "precheck_entry", "OK", "SKIP"]

@@ -1,0 +1,228 @@
+"""GradGuard: non-finite gradient detection with cross-rank agreement.
+
+One rank emitting a NaN/Inf gradient poisons the allreduce for every rank
+(sum/avg of anything with NaN is NaN), and without agreement the ranks
+would then disagree on whether to apply the step — the exact replica-
+divergence failure the consistency auditor exists to catch. GradGuard
+closes the loop *before* the gradient allreduce:
+
+1. **Local detect** — one fused ``isfinite``-all reduction per gradient
+   leaf (inexact dtypes only; integers cannot be non-finite).
+2. **Cross-rank agreement** — a single small flag allreduce (int32 vector,
+   one entry per leaf) so every rank sees the same verdict. Each rank
+   contributes a rank bit per offending leaf, so the verdict also names
+   the offenders (exact for ranks < 31; larger ranks share bit 31).
+3. **Policy** (``HOROVOD_GRAD_GUARD``):
+   * ``off``   (default) — no checks, no flag allreduce, zero cost.
+   * ``skip``  — drop the optimizer step on EVERY rank (dynamic-loss-
+     scale style): replicas stay in lockstep, the batch is lost.
+   * ``zero``  — nullify only the offending tensors on every rank and
+     apply the rest of the step.
+   * ``abort`` — raise :class:`~..exceptions.NonFiniteError` naming
+     tensor/rank/step on every rank.
+
+Counters: ``hvd_grad_nonfinite_total`` (offending tensors observed
+locally), ``hvd_steps_skipped_total`` (global skip verdicts).
+
+Fault hook: ``nan@grad`` in ``HOROVOD_FAULT_SPEC`` poisons the first leaf
+with NaN right before detection, so the whole pillar is drivable from the
+chaos harness (docs/fault-tolerance.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import List, Tuple
+
+import numpy as np
+
+from .. import basics, faultinject
+from ..exceptions import NonFiniteError
+from ..metrics import instruments
+
+logger = logging.getLogger("horovod_tpu")
+
+ENV_POLICY = "HOROVOD_GRAD_GUARD"
+POLICIES = ("off", "skip", "zero", "abort")
+
+#: verdicts returned by :meth:`GradGuard.apply`
+OK, SKIP = "ok", "skip"
+
+
+def policy_from_env() -> str:
+    """Resolve ``HOROVOD_GRAD_GUARD``; unknown values fail loudly (a typo
+    silently disabling the guard would defeat its purpose)."""
+    policy = os.environ.get(ENV_POLICY, "off").strip().lower() or "off"
+    if policy not in POLICIES:
+        raise ValueError(
+            f"{ENV_POLICY}={policy!r} is not a valid policy; expected one "
+            f"of {POLICIES}")
+    return policy
+
+
+def decode_rank_mask(mask: int, world: int) -> List[str]:
+    """Human-readable rank list from an agreement bitmask. Bit 31 is the
+    shared overflow bit for ranks >= 31 (int32 flag vector)."""
+    ranks: List[str] = [str(r) for r in range(min(world, 31))
+                        if mask & (1 << r)]
+    if mask & (1 << 31) or (world > 31 and mask < 0):
+        ranks.append(">=31")
+    return ranks
+
+
+def _rank_bit(rank: int) -> np.int32:
+    # ranks past 30 share the sign bit; the verdict stays correct, only
+    # the offender attribution coarsens
+    return np.int32(1) << np.int32(min(rank, 31))
+
+
+class GradGuard:
+    """Per-rank guard instance; ``policy=None`` re-reads the env knob on
+    every :meth:`apply` so tests can monkeypatch it per scenario."""
+
+    def __init__(self, policy: "str | None" = None, prefix: str = "grad"):
+        if policy is not None and policy not in POLICIES:
+            raise ValueError(f"invalid GradGuard policy {policy!r}; "
+                             f"expected one of {POLICIES}")
+        self._policy = policy
+        self._prefix = prefix
+        self._step = 0
+
+    def _resolve_policy(self) -> str:
+        return self._policy if self._policy is not None else policy_from_env()
+
+    # ------------------------------------------------------------------ apply
+    def apply(self, grads, prefix: "str | None" = None) -> Tuple[str, "object"]:
+        """Inspect a gradient pytree before it enters the allreduce.
+
+        Returns ``(verdict, grads)``: verdict ``"skip"`` means the caller
+        must drop the optimizer step globally (all ranks agree); ``"ok"``
+        means proceed with the (possibly leaf-zeroed) gradients. Raises
+        :class:`NonFiniteError` under the ``abort`` policy. With policy
+        ``off`` this is a no-op returning the input untouched.
+        """
+        policy = self._resolve_policy()
+        if policy == "off":
+            return OK, grads
+        import jax
+        import jax.numpy as jnp
+
+        self._step += 1
+        prefix = prefix if prefix is not None else self._prefix
+        pairs, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        if not pairs:
+            return OK, grads
+        paths, leaves = zip(*pairs)
+        leaves = list(leaves)
+
+        # chaos harness: nan@grad poisons this rank's first inexact leaf
+        rank = basics.rank()
+        inj = faultinject.shared_for_rank(rank)
+        if inj is not None:
+            for kind, _ in inj.actions_for("grad"):
+                if kind == "nan":
+                    for i, leaf in enumerate(leaves):
+                        if jnp.issubdtype(jnp.asarray(leaf).dtype,
+                                          jnp.inexact):
+                            leaves[i] = jnp.full_like(jnp.asarray(leaf),
+                                                      jnp.nan)
+                            break
+
+        # local detect: one fused boolean per leaf, a single host sync
+        checks = [jnp.logical_not(jnp.all(jnp.isfinite(jnp.asarray(l))))
+                  if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+                  else jnp.asarray(False) for l in leaves]
+        bad_local = np.asarray(jnp.stack(checks))
+        n_bad = int(bad_local.sum())
+        if n_bad:
+            instruments.grad_nonfinite().inc(n_bad)
+
+        # cross-rank agreement: every rank contributes its rank bit per
+        # offending leaf; the summed int32 vector is the global verdict
+        # (every rank participates every guarded step — the flag exchange
+        # IS the agreement, there is no fast path that desyncs it)
+        if basics.size() > 1:
+            from ..ops import collective_ops as ops
+
+            contrib = np.where(bad_local, _rank_bit(rank),
+                               np.int32(0)).astype(np.int32)
+            mask = np.asarray(ops.allreduce(
+                contrib, name=f"{prefix}.__gradguard__", op=basics.Sum))
+        else:
+            mask = np.where(bad_local, _rank_bit(rank),
+                            np.int32(0)).astype(np.int32)
+        poisoned = mask != 0
+        if not poisoned.any():
+            return OK, grads
+
+        names = [prefix + jax.tree_util.keystr(p)
+                 for p, hit in zip(paths, poisoned) if hit]
+        combined = int(np.bitwise_or.reduce(mask[poisoned]))
+        offenders = decode_rank_mask(combined, basics.size())
+        detail = (f"non-finite gradients at step {self._step}: "
+                  f"tensor(s) {names} from rank(s) {offenders}")
+        if policy == "abort":
+            raise NonFiniteError(
+                f"{detail} (HOROVOD_GRAD_GUARD=abort; use skip/zero to "
+                "continue training through transient NaN/Inf)")
+        if policy == "skip":
+            instruments.steps_skipped().inc()
+            logger.warning("gradguard: skipping optimizer step — %s", detail)
+            return SKIP, grads
+        # zero: nullify only the offending leaves, apply the rest
+        logger.warning("gradguard: zeroing offending tensor(s) — %s", detail)
+        leaves = [jnp.zeros_like(jnp.asarray(l)) if hit else l
+                  for l, hit in zip(leaves, poisoned)]
+        return OK, jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------- per-rank singletons
+# In the in-process thread cluster each rank thread needs its own step
+# counter and injector hits; keyed by thread rank, reset with the engine.
+_guards: dict = {}
+_guards_lock = threading.Lock()
+
+
+def default_guard() -> GradGuard:
+    rank = basics.rank() if basics.is_initialized() else 0
+    with _guards_lock:
+        g = _guards.get(rank)
+        if g is None:
+            g = _guards[rank] = GradGuard()
+        return g
+
+
+def _reset_guards() -> None:
+    with _guards_lock:
+        _guards.clear()
+    faultinject.reset_shared()
+
+
+basics.register_shutdown_hook(_reset_guards)
+
+
+def precheck_entry(entry) -> None:
+    """Enqueue-side fast-fail for raw collective calls: under the
+    ``abort`` policy, a non-finite ALLREDUCE/ADASUM input raises
+    :class:`NonFiniteError` on the producing rank *before* it can poison
+    peers. Unlike the optimizer-path guard this is a local verdict (no
+    agreement round) — peers that already submitted the name will hit the
+    collective watchdog instead of hanging (docs/fault-tolerance.md).
+    Costs nothing unless HOROVOD_GRAD_GUARD=abort."""
+    if policy_from_env() != "abort":
+        return
+    from ..runtime.messages import RequestType
+
+    if entry.request_type not in (RequestType.ALLREDUCE, RequestType.ADASUM):
+        return
+    arr = entry.array
+    if not np.issubdtype(np.asarray(arr).dtype, np.inexact):
+        return
+    import jax.numpy as jnp
+
+    if not bool(jnp.all(jnp.isfinite(arr))):
+        raise NonFiniteError(
+            f"non-finite values in tensor {entry.tensor_name!r} submitted "
+            f"by rank {entry.rank} (HOROVOD_GRAD_GUARD=abort)")

@@ -142,3 +142,39 @@ def frames_rejected():
         "hvd_frames_rejected_total",
         "Control-plane frames rejected for integrity violations "
         "(CRC32/HMAC mismatch or an over-bound length prefix).")
+
+
+def grad_nonfinite():
+    return get_registry().counter(
+        "hvd_grad_nonfinite_total",
+        "Gradient tensors this rank observed with NaN/Inf values before "
+        "allreduce (HOROVOD_GRAD_GUARD detection, any policy but off).")
+
+
+def steps_skipped():
+    return get_registry().counter(
+        "hvd_steps_skipped_total",
+        "Optimizer steps dropped globally because some rank's gradients "
+        "were non-finite (HOROVOD_GRAD_GUARD=skip).")
+
+
+def param_desync():
+    return get_registry().counter(
+        "hvd_param_desync_total",
+        "Parameter tensors whose cross-rank digest diverged from the "
+        "root's (consistency auditor, HOROVOD_CONSISTENCY_INTERVAL).")
+
+
+def integrity_heals():
+    return get_registry().counter(
+        "hvd_integrity_heals_total",
+        "Self-heal re-broadcasts of the full parameter set from the root "
+        "after a digest divergence (HOROVOD_CONSISTENCY_POLICY=heal).")
+
+
+def collective_timeouts():
+    return get_registry().counter(
+        "hvd_collective_timeouts_total",
+        "Collectives forcibly failed after stalling past "
+        "HOROVOD_COLLECTIVE_TIMEOUT (enforced watchdog; each firing also "
+        "names the missing ranks in the CollectiveTimeoutError).")

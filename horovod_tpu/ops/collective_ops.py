@@ -19,7 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .. import basics
+from .. import basics, faultinject
 from ..basics import Adasum, Average, Sum
 from ..runtime.messages import AlltoallvResult, RequestType, TensorTableEntry
 from . import compression as _compression
@@ -61,6 +61,12 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
              callback=None, splits=None, wire: str = "") -> int:
     eng = basics._engine()
     r = basics.rank()
+    # chaos harness: hang@collective / delay@collective hold THIS rank's
+    # submission; with HOROVOD_COLLECTIVE_TIMEOUT set, peers waiting on the
+    # name get CollectiveTimeoutError instead of hanging forever
+    inj = faultinject.shared_for_rank(r)
+    if inj is not None:
+        inj.fire("collective")
     entry = TensorTableEntry(
         tensor_name=name,
         rank=r,
@@ -74,6 +80,9 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
         splits=splits,
         compression=wire,
     )
+    from ..integrity import precheck_entry
+
+    precheck_entry(entry)
     return eng.enqueue(entry)
 
 

@@ -34,7 +34,7 @@ from ..ops.compression import Compression
 
 def allreduce_gradients(grads, op: int = Average,
                         compression=None, prefix: str = "grad",
-                        sparse_as_dense: bool = False):
+                        sparse_as_dense: bool = False, _guard: bool = True):
     """Average a gradient pytree across ranks through the engine: one named
     async allreduce per leaf, all in flight simultaneously (the hook-overlap
     pattern of `torch/__init__.py:115-150`), then drained in order.
@@ -43,9 +43,23 @@ def allreduce_gradients(grads, op: int = Average,
     the two-allgather path (`tensorflow/__init__.py:75-91`); pass
     ``sparse_as_dense=True`` to densify them first
     (`_keras/__init__.py:50-53`).
+
+    Under ``HOROVOD_GRAD_GUARD`` (integrity/gradguard.py) the pytree is
+    checked for NaN/Inf before anything hits the wire; on a global
+    ``skip`` verdict the returned gradients are all-zero — this surface
+    has no optimizer step to drop, so a skipped step degrades to a no-op
+    update. ``DistributedOptimizer`` pre-applies the guard (and truly
+    drops the step) and disables it here via ``_guard=False``.
     """
     from ..ops import sparse as _sparse
 
+    if _guard:
+        from .. import integrity
+
+        verdict, grads = integrity.default_guard().apply(grads,
+                                                         prefix=prefix)
+        if verdict == integrity.SKIP:
+            return jax.tree_util.tree_map(jnp.zeros_like, grads)
     if compression is None:
         compression = _compression.from_env()
     is_sparse = lambda x: isinstance(x, _sparse.IndexedSlices)  # noqa: E731
@@ -214,11 +228,22 @@ class DistributedOptimizer(_GradAccumulation):
         if not communicate:
             zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
             return zero, state
+        # GradGuard before error feedback: a poisoned step must not leak
+        # NaN into the EF residual, and a global skip leaves the residual
+        # exactly as it was (the step never happened on any rank)
+        from .. import integrity
+
+        verdict, grads = integrity.default_guard().apply(grads,
+                                                         prefix=self._prefix)
+        if verdict == integrity.SKIP:
+            zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
+            return zero, state
         if self._error_feedback:
             grads = self._apply_error_feedback(grads)
         grads = allreduce_gradients(
             grads, op=self._op, compression=self._compression,
-            prefix=self._prefix, sparse_as_dense=self._sparse_as_dense)
+            prefix=self._prefix, sparse_as_dense=self._sparse_as_dense,
+            _guard=False)
         # optax transformations tree_map over leaves, which would scale an
         # IndexedSlices' indices/dense_shape too (TF optimizers handle
         # IndexedSlices natively; optax does not) — densify the gathered

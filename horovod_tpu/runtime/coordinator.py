@@ -134,6 +134,10 @@ class CoordState:
         self.tuned: Optional[Tuple[int, float]] = None
         self.stall_warning_s = stall_warning_s
         self.stall_shutdown_s = stall_shutdown_s
+        # enforced watchdog (docs/fault-tolerance.md): 0 keeps the
+        # historical warn-only stall inspector
+        self.collective_timeout_s = _env_float(
+            "HOROVOD_COLLECTIVE_TIMEOUT", 0.0)
         self.cv = threading.Condition()
         self.lists: Dict[int, Dict[int, Tuple[int, List[int], List[ReqMeta]]]] = {}
         self.resps: Dict[int, bytes] = {}
@@ -644,6 +648,7 @@ class CoordState:
 
         ready: List[str] = []
         warnings: List[str] = []
+        timed_out: List[Tuple[str, List[int], float]] = []
         n_stalled = 0
         for name, p in sorted(self.table.items(),
                               key=lambda kv: kv[1].order_idx):
@@ -656,6 +661,37 @@ class CoordState:
                 continue
             waited = now - p.first_t
             missing = sorted(active - have)
+            if (self.collective_timeout_s
+                    and waited > self.collective_timeout_s):
+                if self.elastic and all(r > 0 for r in missing):
+                    # counted here because no ERROR response reaches the
+                    # engines: the reset speaks RESP_RANKS_CHANGED instead
+                    instruments.collective_timeouts().inc()
+                    # the unresponsive ranks are treated as lost: the
+                    # membership reset releases every blocked barrier with
+                    # RESP_RANKS_CHANGED, feeding the same re-rendezvous
+                    # path a dropped connection would (docs/elastic.md).
+                    # Rank 0 hosts this coordinator and cannot be dropped;
+                    # a timeout naming it falls through to the error path.
+                    logger.warning(
+                        "coordinator: collective timeout on tensor '%s' "
+                        "(waited %ds on ranks %s); declaring them lost",
+                        name, int(waited), missing)
+                    for r in missing:
+                        self.rank_lost(
+                            r, f"collective timeout: tensor '{name}' "
+                               f"waited {int(waited)}s "
+                               f"(HOROVOD_COLLECTIVE_TIMEOUT="
+                               f"{self.collective_timeout_s:g}s exceeded)")
+                    return self._ranks_changed_bytes()
+                timed_out.append((name, missing, waited))
+                self.warned.discard(name)
+                # invalidate like a stall: the next negotiation of this
+                # name must start from full metadata
+                stale_cid = self.cache_ids.pop(name, None)
+                if stale_cid is not None:
+                    self.cache_meta.pop(stale_cid, None)
+                continue
             if waited > self.stall_warning_s:
                 n_stalled += 1
             if waited > self.stall_warning_s and name not in self.warned:
@@ -682,6 +718,16 @@ class CoordState:
         singles = []
         responses: List[Response] = []
         assignments: List[List[int]] = []
+        for name, missing, waited in timed_out:
+            self.table.pop(name, None)
+            responses.append(Response(
+                ResponseType.ERROR, [name],
+                error_message=(
+                    f"collective timeout: tensor '{name}' waited "
+                    f"{int(waited)}s on ranks {missing} "
+                    f"(HOROVOD_COLLECTIVE_TIMEOUT="
+                    f"{self.collective_timeout_s:g}s exceeded)")))
+            assignments.append([-1])
         for name in ready:
             p = self.table.pop(name)
             err = self._validate(name, p.metas, active)

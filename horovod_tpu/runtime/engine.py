@@ -51,7 +51,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..exceptions import (DuplicateNameError, RanksChangedError,
+from ..exceptions import (CollectiveTimeoutError, DuplicateNameError,
+                          HorovodInternalError, RanksChangedError,
                           ShutdownError)
 from ..metrics import instruments
 from ..utils.env import env_float as _env_float, env_on as _env_on
@@ -203,6 +204,11 @@ class Engine:
         instruments.control_reconnects().inc(0)
         instruments.heartbeat_misses().inc(0)
         instruments.frames_rejected().inc(0)
+        instruments.grad_nonfinite().inc(0)
+        instruments.steps_skipped().inc(0)
+        instruments.param_desync().inc(0)
+        instruments.integrity_heals().inc(0)
+        instruments.collective_timeouts().inc(0)
         epoch_fn = getattr(self.controller, "epoch", None)
         instruments.elastic_epoch().set(
             max(0, epoch_fn()) if callable(epoch_fn) else 0)
@@ -485,11 +491,21 @@ class Engine:
             ebr[r].sort(key=lambda e: name_order[e.tensor_name])
 
         if resp.response_type == ResponseType.ERROR:
+            # enforced-watchdog errors surface as a dedicated type so
+            # callers can catch them apart from generic negotiation errors
+            # (mirrors the "stall shutdown" prefix idiom in tick())
+            msg = resp.error_message or ""
+            if msg.startswith("collective timeout"):
+                error_cls = CollectiveTimeoutError
+                instruments.collective_timeouts().inc()
+            else:
+                error_cls = HorovodInternalError
             for es in ebr.values():
                 for e in es:
                     self._fire_callback(e, False, resp.error_message)
                     self.handles.mark_done(e.handle, False,
-                                           error=resp.error_message)
+                                           error=resp.error_message,
+                                           error_cls=error_cls)
             return
 
         for n in resp.tensor_names:

@@ -11,6 +11,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..metrics import instruments
+from ..utils.env import env_float as _env_float
 from ..utils.timeline import Timeline
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 
@@ -55,6 +56,11 @@ class PyController:
         self._threshold = fusion_threshold
         self._stall_warning_s = stall_warning_s
         self._stall_shutdown_s = stall_shutdown_s
+        # enforced watchdog (read here, not a ctor arg: the ctor kwargs are
+        # shared verbatim with NativeController, whose C++ signature is
+        # fixed; 0 keeps the historical warn-only stall inspector)
+        self._collective_timeout_s = _env_float(
+            "HOROVOD_COLLECTIVE_TIMEOUT", 0.0)
         self._fusion_enabled = fusion_enabled
         self._cycle_ms = cycle_time_ms
         self._timeline = Timeline(timeline_path)
@@ -245,6 +251,7 @@ class PyController:
             ready, waiting = [], []
             stall_warnings: List[str] = []
             stall_shutdown = False
+            timed_out: List[Tuple[str, Dict[int, _Meta], List[int], float]] = []
             n_stalled = 0
             for name in self._order:
                 st = self._table.get(name)
@@ -256,15 +263,23 @@ class PyController:
                     # stall of the same tensor warns again
                     self._warned.discard(name)
                 else:
-                    waiting.append(name)
                     waited = now - min(m.enqueue_t for m in st.values())
+                    missing = sorted(active - set(st.keys()))
+                    if (self._collective_timeout_s
+                            and waited > self._collective_timeout_s):
+                        # enforced watchdog: fail the submitted handles with
+                        # a named error instead of warning forever
+                        timed_out.append((name, self._table.pop(name),
+                                          missing, waited))
+                        self._warned.discard(name)
+                        continue
+                    waiting.append(name)
                     if waited > self._stall_warning_s:
                         n_stalled += 1
                         if name not in self._warned:
                             self._warned.add(name)
                             # same shape as the coordinated stall report:
                             # name the ranks this tensor is still waiting on
-                            missing = sorted(active - set(st.keys()))
                             stall_warnings.append(
                                 f"{name} (waiting on ranks {missing} for "
                                 f"{int(waited)}s)")
@@ -272,12 +287,25 @@ class PyController:
                         stall_shutdown = True
             instruments.stalled_tensors().set(n_stalled)
             self._order = waiting
-            if not ready and not stall_warnings and not stall_shutdown:
+            if (not ready and not stall_warnings and not stall_shutdown
+                    and not timed_out):
                 return None
 
             singles = []
             responses: List[Response] = []
             handle_pairs: List[List[Tuple[int, int]]] = []
+            for name, st, missing, waited in timed_out:
+                # hvd_collective_timeouts_total is counted in the engine's
+                # ERROR-perform path, uniformly across controller kinds
+                responses.append(Response(
+                    ResponseType.ERROR, [name],
+                    error_message=(
+                        f"collective timeout: tensor '{name}' waited "
+                        f"{int(waited)}s on ranks {missing} "
+                        f"(HOROVOD_COLLECTIVE_TIMEOUT="
+                        f"{self._collective_timeout_s:g}s exceeded)")))
+                handle_pairs.append(sorted((r, m.handle)
+                                           for r, m in st.items()))
             for name in ready:
                 st = self._table.pop(name)
                 pairs = sorted((r, m.handle) for r, m in st.items())

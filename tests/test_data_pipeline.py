@@ -101,7 +101,7 @@ def test_npy_fixture_fallback(tmp_path):
         cdir = tmp_path / f"c{i % 2}"
         cdir.mkdir(exist_ok=True)
         np.save(cdir / f"a_{i}.npy",
-                np.full((8, 8, 3), float(i), np.float32))
+                np.full((8, 8, 3), float(i) / 4.0, np.float32))
     ld = ShardedImageFolder(str(tmp_path), batch_size=2, image_size=8,
                             rank=0, size=1, shuffle=False)
     (x, y), (x2, y2) = list(ld)
@@ -155,3 +155,31 @@ def test_feeds_spmd_train_step(image_folder):
     # grad allreduce -> both ranks hold identical, non-trivial weights
     np.testing.assert_array_equal(res[0], res[1])
     assert np.abs(res[0]).max() > 0
+
+
+def test_npy_float_out_of_range_fails_loudly(tmp_path):
+    """A float .npy holding 0-255 pixel values is NOT rescaled — silently
+    training 255x out of range — so loading must raise, naming the file and
+    the fix (ISSUE 5 satellite: upgrade from a RuntimeWarning to an error)."""
+    from horovod_tpu.data import _load_image
+
+    cdir = tmp_path / "c0"
+    cdir.mkdir()
+    bad = cdir / "scaled_0_255.npy"
+    np.save(bad, np.full((8, 8, 3), 200.0, np.float32))
+    with pytest.raises(ValueError, match=r"NOT rescaled.*divide by.*255"):
+        _load_image(str(bad), 8)
+    # the error surfaces through the batch iterator too, not just the helper
+    np.save(cdir / "also_bad.npy", np.full((8, 8, 3), 99.0, np.float32))
+    ld = ShardedImageFolder(str(tmp_path), batch_size=2, image_size=8,
+                            rank=0, size=1, shuffle=False)
+    with pytest.raises(ValueError, match="NOT rescaled"):
+        list(ld)
+    # while well-formed fixtures still load: [0,1] floats at face value,
+    # integer dtypes rescaled by dtype
+    ok_f = cdir / "ok_float.npy"
+    np.save(ok_f, np.full((8, 8, 3), 0.25, np.float32))
+    assert _load_image(str(ok_f), 8).max() == pytest.approx(0.25)
+    ok_u8 = cdir / "ok_uint8.npy"
+    np.save(ok_u8, np.full((8, 8, 3), 51, np.uint8))
+    assert _load_image(str(ok_u8), 8).max() == pytest.approx(0.2)

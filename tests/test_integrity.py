@@ -1,0 +1,403 @@
+"""Data-plane integrity guard (`horovod_tpu.integrity`, ISSUE 5).
+
+Three pillars, each tested unit-level and end to end through the chaos
+harness (``HOROVOD_FAULT_SPEC``):
+
+* GradGuard — non-finite gradient detection with cross-rank agreement and
+  the off/skip/zero/abort policies (``HOROVOD_GRAD_GUARD``).
+* ConsistencyAuditor — periodic cross-rank parameter digest comparison
+  with warn/heal/abort policies (``HOROVOD_CONSISTENCY_*``).
+* Collective watchdog — ``HOROVOD_COLLECTIVE_TIMEOUT`` turning a wedged
+  collective into :class:`CollectiveTimeoutError` naming the missing
+  ranks (enforced-timeout path is also covered per-controller in
+  `tests/test_stall.py`).
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faultinject, testing
+from horovod_tpu.integrity import auditor as auditor_mod
+from horovod_tpu.integrity import gradguard
+from horovod_tpu.integrity import (ConsistencyAuditor, GradGuard,
+                                   param_digest)
+from horovod_tpu.metrics import instruments
+
+
+# --------------------------------------------------------------------- units
+
+
+def test_gradguard_policy_validation(monkeypatch):
+    """Typos in HOROVOD_GRAD_GUARD must fail loudly — a silently-disabled
+    guard is worse than no guard."""
+    monkeypatch.setenv("HOROVOD_GRAD_GUARD", "skipp")
+    with pytest.raises(ValueError, match="HOROVOD_GRAD_GUARD.*skipp"):
+        gradguard.policy_from_env()
+    monkeypatch.setenv("HOROVOD_GRAD_GUARD", "Zero")  # case-insensitive
+    assert gradguard.policy_from_env() == "zero"
+    monkeypatch.delenv("HOROVOD_GRAD_GUARD")
+    assert gradguard.policy_from_env() == "off"
+    with pytest.raises(ValueError, match="invalid GradGuard policy"):
+        GradGuard(policy="bogus")
+
+
+def test_consistency_knob_validation(monkeypatch):
+    monkeypatch.setenv("HOROVOD_CONSISTENCY_POLICY", "fix")
+    with pytest.raises(ValueError, match="HOROVOD_CONSISTENCY_POLICY"):
+        auditor_mod.policy_from_env()
+    monkeypatch.setenv("HOROVOD_CONSISTENCY_INTERVAL", "often")
+    with pytest.raises(ValueError, match="HOROVOD_CONSISTENCY_INTERVAL"):
+        auditor_mod.interval_from_env()
+    monkeypatch.setenv("HOROVOD_CONSISTENCY_INTERVAL", "25")
+    assert auditor_mod.interval_from_env() == 25
+    monkeypatch.delenv("HOROVOD_CONSISTENCY_INTERVAL")
+    assert auditor_mod.interval_from_env() == 0  # disabled by default
+    with pytest.raises(ValueError, match="invalid consistency policy"):
+        ConsistencyAuditor(policy="fix")
+
+
+def test_decode_rank_mask():
+    """The agreement bitmask names offenders exactly for ranks < 31 and
+    coarsens to '>=31' via the shared sign bit beyond that."""
+    assert gradguard.decode_rank_mask(0b101, world=8) == ["0", "2"]
+    assert gradguard.decode_rank_mask(1 << 7, world=8) == ["7"]
+    # a 40-rank job: rank 35 contributes bit 31 (int32 sign bit)
+    overflow = int(np.int32(1) << np.int32(31))
+    got = gradguard.decode_rank_mask(overflow, world=40)
+    assert got == [">=31"]
+    mixed = (1 << 3) | overflow
+    assert gradguard.decode_rank_mask(mixed, world=40) == ["3", ">=31"]
+
+
+def test_param_digest_exact():
+    """The digest is bit-exact: identical trees agree, a single-ULP flip
+    disagrees, and the layout is 4 int32 words per leaf."""
+    params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "b": np.zeros((4,), np.float32)}
+    d1 = param_digest(params)
+    d2 = param_digest({"w": params["w"].copy(), "b": params["b"].copy()})
+    assert d1.dtype == np.int32 and d1.shape == (4 * 2,)
+    np.testing.assert_array_equal(d1, d2)
+    flipped = {"w": params["w"].copy(), "b": params["b"].copy()}
+    flipped["w"][1, 2] = np.nextafter(flipped["w"][1, 2], np.float32(1e9))
+    assert (param_digest(flipped) != d1).any()
+    # integer leaves digest too (opt-state step counters etc.)
+    di = param_digest({"n": np.int64(7)})
+    assert di.shape == (4,)
+
+
+def test_fault_spec_parses_integrity_kinds():
+    """`nan@grad`, `desync@param` and `hang@collective` are first-class
+    HOROVOD_FAULT_SPEC kinds."""
+    rules = faultinject.parse_spec(
+        "nan@grad:3#1;desync@param;hang@collective:2.5:1#0,2")
+    assert [(r.kind, r.point) for r in rules] == [
+        ("nan", "grad"), ("desync", "param"), ("hang", "collective")]
+    assert rules[0].nth == 3 and rules[0].applies_to(1)
+    assert not rules[0].applies_to(0)
+    assert rules[1].nth == 1          # non-timed kinds default to hit 1
+    assert rules[2].seconds == 2.5 and rules[2].nth == 1
+    with pytest.raises(ValueError, match="bad rule"):
+        faultinject.parse_spec("nanify@grad")
+    with pytest.raises(ValueError, match="bad argument"):
+        faultinject.parse_spec("hang@collective")  # hang requires seconds
+
+
+def test_shared_injector_caching(monkeypatch):
+    """shared_for_rank returns ONE injector per (rank, spec) so hit
+    counters accumulate across call sites; reset_shared starts over."""
+    faultinject.reset_shared()
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "nan@grad:2")
+    a = faultinject.shared_for_rank(0)
+    assert a is faultinject.shared_for_rank(0)
+    assert a is not faultinject.shared_for_rank(1)
+    assert a.actions_for("grad") == []          # hit 1: not yet
+    assert a.actions_for("grad") == [("nan", 0.0)]  # hit 2 fires
+    # a different spec text gets a fresh injector (fresh counters)
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "nan@grad:3")
+    assert faultinject.shared_for_rank(0) is not a
+    faultinject.reset_shared()
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "nan@grad:2")
+    assert faultinject.shared_for_rank(0) is not a
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "")
+    assert faultinject.shared_for_rank(0) is None
+    faultinject.reset_shared()
+
+
+# ------------------------------------------------------- gradguard policies
+
+
+def test_gradguard_skip_agreement():
+    """One rank's NaN leaf produces a SKIP verdict on EVERY rank (the
+    agreement allreduce), keeping replicas in lockstep."""
+    import jax.numpy as jnp
+
+    before = instruments.steps_skipped().value
+
+    def fn():
+        r = hvd.rank()
+        grads = {"w": jnp.ones((4,)),
+                 "b": jnp.full((2,), jnp.nan) if r == 1 else jnp.ones((2,))}
+        guard = GradGuard(policy="skip")
+        verdict, _ = guard.apply(grads, prefix="t")
+        return verdict
+
+    assert testing.run_cluster(fn, np=2) == ["skip", "skip"]
+    # one skip per rank (the counter is per-process but both thread-ranks
+    # count their own verdict)
+    assert instruments.steps_skipped().value >= before + 2
+
+
+def test_gradguard_zero_policy_zeroes_only_offenders():
+    """zero nullifies ONLY the offending leaves — on every rank, so the
+    subsequent allreduce stays finite — and applies the rest."""
+    import jax.numpy as jnp
+
+    before = instruments.grad_nonfinite().value
+
+    def fn():
+        r = hvd.rank()
+        grads = {"b": jnp.full((2,), jnp.inf) if r == 1 else jnp.ones((2,)),
+                 "w": jnp.ones((4,)) * (r + 1)}
+        verdict, out = GradGuard(policy="zero").apply(grads, prefix="t")
+        assert verdict == "ok"
+        return np.asarray(out["b"]), np.asarray(out["w"])
+
+    for r, (b, w) in enumerate(testing.run_cluster(fn, np=2)):
+        np.testing.assert_array_equal(b, np.zeros((2,)))   # zeroed everywhere
+        np.testing.assert_array_equal(w, np.full((4,), r + 1.0))  # untouched
+    assert instruments.grad_nonfinite().value == before + 1  # rank 1's leaf
+
+
+def test_gradguard_abort_names_offender():
+    def fn():
+        import jax.numpy as jnp
+
+        r = hvd.rank()
+        grads = {"w": jnp.full((4,), jnp.nan) if r == 1 else jnp.ones((4,))}
+        # the verdict is global: BOTH ranks raise, naming rank 1
+        with pytest.raises(hvd.NonFiniteError, match=r"rank\(s\) \['1'\]"):
+            GradGuard(policy="abort").apply(grads, prefix="t")
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_gradguard_off_is_free():
+    """policy off returns the input identity — no flag allreduce, so it
+    must not even require a cluster step."""
+    grads = {"w": np.full((4,), np.nan)}
+    verdict, out = GradGuard(policy="off").apply(grads)
+    assert verdict == "ok" and out is grads
+
+
+def test_precheck_abort_fast_fails_raw_collective(monkeypatch):
+    """HOROVOD_GRAD_GUARD=abort also guards RAW allreduce calls at the
+    enqueue boundary, before a NaN can poison peers."""
+    monkeypatch.setenv("HOROVOD_GRAD_GUARD", "abort")
+
+    def fn():
+        with pytest.raises(hvd.NonFiniteError,
+                           match="submitted by rank"):
+            hvd.allreduce(np.full((4,), np.nan, np.float32),
+                          name="poisoned", op=hvd.Sum)
+        # the guard is per-tensor: a clean allreduce still works
+        out = hvd.allreduce(np.ones((4,), np.float32), name="clean",
+                            op=hvd.Sum)
+        return np.asarray(out)
+
+    for r in testing.run_cluster(fn, np=2):
+        np.testing.assert_allclose(r, np.full((4,), 2.0))
+
+
+# ------------------------------------------- end-to-end: nan@grad + skip
+
+
+def test_nan_injection_skips_steps_and_converges(monkeypatch):
+    """ISSUE 5 acceptance: a training run with `nan@grad` injected under
+    HOROVOD_GRAD_GUARD=skip converges anyway, with a nonzero
+    hvd_steps_skipped_total and replicas still in lockstep."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    monkeypatch.setenv("HOROVOD_GRAD_GUARD", "skip")
+    # rank 1's gradients are poisoned at guarded step 3 (once)
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "nan@grad:3#1")
+    skipped_before = instruments.steps_skipped().value
+
+    def fn():
+        params = {"w": jnp.zeros((4,))}
+        target = jnp.asarray([1.0, -2.0, 3.0, 0.5])
+        tx = hvd.DistributedOptimizer(optax.sgd(0.3))
+        opt = tx.init(params)
+
+        def loss_fn(p):
+            return jnp.mean((p["w"] - target) ** 2)
+
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        first = None
+        for _ in range(30):
+            loss, grads = grad_fn(params)
+            first = loss if first is None else first
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+        return float(first), float(loss_fn(params)), np.asarray(params["w"])
+
+    results = testing.run_cluster(fn, np=2)
+    # the poisoned step was dropped on BOTH ranks...
+    assert instruments.steps_skipped().value >= skipped_before + 2
+    # ...and training still converged, replicas identical
+    np.testing.assert_array_equal(results[0][2], results[1][2])
+    for first, final, w in results:
+        assert final < first * 0.05, (first, final)
+        np.testing.assert_allclose(w, [1.0, -2.0, 3.0, 0.5], atol=0.1)
+
+
+# --------------------------------------- end-to-end: desync@param + heal
+
+
+def test_desync_injection_heals(monkeypatch, caplog):
+    """ISSUE 5 acceptance: `desync@param` under HOROVOD_CONSISTENCY_POLICY
+    =heal — the audit detects the diverged rank, re-broadcasts from the
+    root, and post-heal digests match bit-exactly."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "desync@param#1")
+    desync_before = instruments.param_desync().value
+    heals_before = instruments.integrity_heals().value
+
+    def fn():
+        params = {"w": jnp.asarray([1.0, 2.0]), "b": jnp.asarray([3.0])}
+        aud = ConsistencyAuditor(interval=1, policy="heal")
+        params = aud.maybe_audit(params)        # audit 1: rank 1 desyncs
+        # post-heal: a second audit must be clean (the digests agree) —
+        # audit() raising under abort would fail this test
+        clean = ConsistencyAuditor(interval=1, policy="abort")
+        params = clean.maybe_audit(params)
+        return param_digest(params), np.asarray(params["w"])
+
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        results = testing.run_cluster(fn, np=2)
+    (d0, w0), (d1, w1) = results
+    np.testing.assert_array_equal(d0, d1)             # digests match
+    np.testing.assert_array_equal(w0, [1.0, 2.0])     # root's values won
+    np.testing.assert_array_equal(w1, [1.0, 2.0])
+    assert instruments.param_desync().value > desync_before
+    assert instruments.integrity_heals().value > heals_before
+    assert any("healing" in rec.getMessage() for rec in caplog.records)
+
+
+def test_auditor_warn_reports_but_does_not_touch(caplog):
+    before = instruments.param_desync().value
+
+    def fn():
+        import jax.numpy as jnp
+
+        r = hvd.rank()
+        params = {"w": jnp.asarray([1.0 + r, 2.0])}  # rank 1 diverged
+        out = ConsistencyAuditor(interval=1, policy="warn").maybe_audit(
+            params)
+        return np.asarray(out["w"])
+
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        res = testing.run_cluster(fn, np=2)
+    np.testing.assert_array_equal(res[0], [1.0, 2.0])
+    np.testing.assert_array_equal(res[1], [2.0, 2.0])  # NOT healed
+    assert instruments.param_desync().value > before
+    assert any("NO LONGER equivalent" in rec.getMessage()
+               for rec in caplog.records)
+
+
+def test_auditor_abort_names_leaf_and_rank():
+    def fn():
+        import jax.numpy as jnp
+
+        r = hvd.rank()
+        params = {"w": jnp.asarray([1.0, 2.0]),
+                  "b": jnp.asarray([3.0 + r])}    # rank 1's 'b' diverged
+        with pytest.raises(
+                hvd.ParameterDesyncError,
+                match=r"param\['b'\].*rank\(s\) \['1'\]"):
+            ConsistencyAuditor(interval=1, policy="abort").maybe_audit(
+                params)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+
+
+def test_auditor_interval_and_clean_pass():
+    """The interval gates audits; clean replicas audit silently and the
+    params come back untouched."""
+    before = instruments.param_desync().value
+
+    def fn():
+        import jax.numpy as jnp
+
+        params = {"w": jnp.asarray([5.0, 6.0])}
+        aud = ConsistencyAuditor(interval=3, policy="abort")
+        for _ in range(7):
+            params = aud.maybe_audit(params)
+        return aud._audits
+
+    assert testing.run_cluster(fn, np=2) == [2, 2]  # steps 3 and 6
+    assert instruments.param_desync().value == before
+
+
+def test_consistency_callback_wires_auditor():
+    """ConsistencyCheckCallback drives the auditor from the Callback
+    train-loop protocol, healing state['params'] in place."""
+
+    def fn():
+        import jax.numpy as jnp
+
+        r = hvd.rank()
+        cb = hvd.ConsistencyCheckCallback(interval=1, policy="heal")
+        state = {"params": {"w": jnp.asarray([7.0 + r])}}  # rank 1 diverged
+        cb.on_batch_end(0, state)
+        return np.asarray(state["params"]["w"])
+
+    res = testing.run_cluster(fn, np=2)
+    np.testing.assert_array_equal(res[0], [7.0])
+    np.testing.assert_array_equal(res[1], [7.0])      # healed to root's
+
+
+# ------------------------------------- end-to-end: hang@collective + watchdog
+
+
+@pytest.mark.parametrize("native", ["1", "0"])
+def test_hang_injection_trips_watchdog(monkeypatch, native):
+    """ISSUE 5 acceptance: `hang@collective` wedges one rank's submission;
+    HOROVOD_COLLECTIVE_TIMEOUT fails the collective on the waiting rank
+    with CollectiveTimeoutError naming the tensor and the missing rank."""
+    monkeypatch.setenv("HVD_TPU_NATIVE", native)
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "30")
+    monkeypatch.setenv("HOROVOD_COLLECTIVE_TIMEOUT", "0.5")
+    # rank 1 sleeps 1.5s before its 2nd collective submission
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "hang@collective:1.5:2#1")
+    before = instruments.collective_timeouts().value
+
+    def fn():
+        out = hvd.allreduce(np.ones((2,), np.float32), name="warmup",
+                            op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), np.full((2,), 2.0))
+        # rank 0 submits immediately and waits on wedged rank 1; rank 1's
+        # own (late) submission then waits on the already-failed peer —
+        # both observe the watchdog error naming tensor + missing ranks
+        if hvd.rank() == 0:
+            with pytest.raises(hvd.CollectiveTimeoutError,
+                               match=r"'wedged'.*ranks \[1\]"):
+                hvd.allreduce(np.ones((2,), np.float32), name="wedged",
+                              op=hvd.Sum)
+        else:
+            with pytest.raises(hvd.CollectiveTimeoutError,
+                               match=r"'wedged'"):
+                hvd.allreduce(np.ones((2,), np.float32), name="wedged",
+                              op=hvd.Sum)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
+    assert instruments.collective_timeouts().value > before

@@ -243,3 +243,15 @@ def test_tf_eager_alltoallv_grad():
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_tf_alltoall_symbolic_splits_rejected_eagerly():
+    """A graph-mode (symbolic) splits tensor has no concrete values to read
+    in eager mode; the binding must fail with an actionable ValueError
+    pointing at tf.function instead of numpy's opaque conversion error
+    (regression for ISSUE 5 satellite)."""
+    g = tf.Graph()
+    with g.as_default():
+        sym = tf.compat.v1.placeholder(tf.int32, shape=(2,))
+    with pytest.raises(ValueError, match="concrete in eager mode.*tf.function"):
+        hvd.alltoall(tf.ones((4, 2)), splits=sym, name="tf_sym_splits")

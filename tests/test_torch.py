@@ -544,3 +544,23 @@ def test_torch_alltoallv_grad():
         return True
 
     assert all(testing.run_cluster(fn, np=2))
+
+
+def test_torch_allgather_rejects_zero_dim():
+    """A 0-dim scalar has no dim 0 to concatenate (or to narrow in the
+    backward); both the async surface and the differentiable wrapper must
+    reject it up front with an actionable message instead of failing deep
+    inside autograd (regression for ISSUE 5 satellite)."""
+    with pytest.raises(ValueError, match="0-dim scalar.*reshape"):
+        hvd.allgather_async(torch.tensor(3.0), name="t_scalar_async")
+    with pytest.raises(ValueError, match="0-dim scalar.*reshape"):
+        hvd.allgather(torch.tensor(3.0, requires_grad=True),
+                      name="t_scalar_grad")
+    # 1-dim tensors remain accepted end to end
+    def fn():
+        g = hvd.allgather(torch.full((1,), float(hvd.rank())),
+                          name="t_scalar_fixed")
+        assert g.shape == (2,)
+        return True
+
+    assert all(testing.run_cluster(fn, np=2))
