@@ -44,6 +44,12 @@ def parse_args(argv=None):
                         "'hang@collective:2:50' for the data-plane guards "
                         "(docs/fault-tolerance.md). Measures throughput "
                         "with recovery on the path")
+    p.add_argument("--trace", metavar="PATH", default=None,
+                   help="enable distributed tracing (sets HOROVOD_TRACE): "
+                        "rank 0 writes one merged Chrome trace to PATH at "
+                        "shutdown; analyze with bin/hvdprof report PATH "
+                        "(docs/tracing.md). Adds a per-iteration device "
+                        "sync so STEP spans bound real step time")
     return p.parse_args(argv)
 
 
@@ -53,6 +59,10 @@ def main(argv=None):
         # must land before hvd.init(): the controller builds its injector
         # (and wraps its control socket) at connect time
         os.environ["HOROVOD_FAULT_SPEC"] = args.chaos
+    if args.trace:
+        # also before hvd.init(): the engine activates the tracer (and the
+        # worker runs its clock handshake) during init
+        os.environ["HOROVOD_TRACE"] = args.trace
     import jax
     import jax.numpy as jnp
     import optax
@@ -160,11 +170,21 @@ def main(argv=None):
     # (every dispatch returns instantly; the wait lands on the final sync),
     # so the error bar comes from a short second pass that syncs per round —
     # its spread includes sync jitter, making the bar conservative.
+    tracer = hvd.tracing.active() if args.trace else None
     t0 = time.perf_counter()
     for _ in range(num_rounds):
         for _ in range(iters_per_round):
+            if tracer is not None:
+                sp = tracer.begin_block(hvd.tracing.K_STEP, hvd.rank(),
+                                        "STEP", hvd.tracing.clock.trace_us())
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, images, labels)
+            if tracer is not None:
+                # per-iteration sync so the STEP span bounds real device
+                # time, not async-dispatch time (skews throughput; the
+                # --trace help text says so)
+                float(loss)
+                tracer.end_block(sp, hvd.tracing.clock.trace_us())
     float(loss)
     total = time.perf_counter() - t0
     mean = batch * iters_per_round * num_rounds / total
@@ -209,6 +229,12 @@ def main(argv=None):
             json.dump(hvd.metrics(), f, indent=2, sort_keys=True)
         print(f"# metrics snapshot written to {args.metrics_dump}",
               file=sys.stderr)
+
+    if args.trace:
+        # the merged Chrome trace is written by rank 0 inside shutdown()
+        hvd.shutdown()
+        print(f"# trace written; analyze with: bin/hvdprof report "
+              f"{args.trace}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -7,8 +7,18 @@ on the device planes — the "xplane op breakdown" the perf docs cite.
 
     LM_PROFILE=/tmp/lmprof python benchmarks/lm_bench.py
     python benchmarks/xplane_summary.py /tmp/lmprof [top_n]
+
+``--host-trace PATH`` additionally (or instead) ingests the merged
+host-side Chrome trace written by the distributed tracer
+(``HOROVOD_TRACE``, docs/tracing.md) and prints the same exposed-comm %
+breakdown that ``bin/hvdprof report`` gives — so one command covers both
+the device-op view and the cross-rank critical-path view:
+
+    python benchmarks/xplane_summary.py /tmp/lmprof --host-trace hvd_trace.json
+    python benchmarks/xplane_summary.py --host-trace hvd_trace.json
 """
 
+import argparse
 import glob
 import os
 import sys
@@ -63,5 +73,44 @@ def summarize(root, top_n=25):
           f"{total:>10.2f}")
 
 
+def summarize_host_trace(path, top_n=10):
+    """Exposed-comm breakdown of a merged ``HOROVOD_TRACE`` Chrome trace —
+    the same report ``bin/hvdprof report`` prints, inlined here so the
+    device-op table and the host critical path come out of one command."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from horovod_tpu.tracing import analyzer
+
+    report = analyzer.analyze(path, top=top_n)
+    print(analyzer.format_report(report, path=path))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("root", nargs="?", default=None,
+                   help="directory holding .xplane.pb files from a "
+                        "jax.profiler trace")
+    p.add_argument("top_n", nargs="?", type=int, default=25,
+                   help="rows in the per-op table (default 25)")
+    p.add_argument("--host-trace", metavar="PATH", default=None,
+                   help="merged Chrome trace from HOROVOD_TRACE; prints the "
+                        "hvdprof exposed-comm %% breakdown after (or instead "
+                        "of) the device-op table")
+    args = p.parse_args(argv)
+    if args.root is None and args.host_trace is None:
+        p.error("need an xplane root, --host-trace, or both")
+    return args
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.root is not None:
+        summarize(args.root, args.top_n)
+    if args.host_trace is not None:
+        if args.root is not None:
+            print()
+        summarize_host_trace(args.host_trace)
+
+
 if __name__ == "__main__":
-    summarize(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 25)
+    main()

@@ -252,6 +252,73 @@ def check_nan_skip() -> None:
           f"({r.stdout.strip().splitlines()[-1]})")
 
 
+def check_trace_capture() -> None:
+    """Distributed-tracing smoke (docs/tracing.md): a real 2-process
+    training job with HOROVOD_TRACE set must leave ONE merged strictly-valid
+    Chrome trace on rank 0, and ``bin/hvdprof`` must parse it with a nonzero
+    wire span count — proof both ranks' spans crossed the control plane and
+    survived the merge."""
+    import json
+    import tempfile
+
+    trace = os.path.join(tempfile.mkdtemp(prefix="hvd_trace_smoke_"),
+                         "trace.json")
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from horovod_tpu.run.api import run\n"
+        "def fn():\n"
+        "    import jax, optax\n"
+        "    import jax.numpy as jnp\n"
+        "    import horovod_tpu as hvd\n"
+        "    hvd.init()\n"
+        "    params = {'w': jnp.zeros((64,))}\n"
+        "    tx = hvd.DistributedOptimizer(optax.sgd(0.1))\n"
+        "    opt = tx.init(params)\n"
+        "    loss_fn = lambda p: jnp.mean(p['w'] ** 2)\n"
+        "    grad_fn = jax.jit(jax.grad(loss_fn))\n"
+        "    for _ in range(4):\n"
+        "        grads = grad_fn(params)\n"
+        "        updates, opt = tx.update(grads, opt, params)\n"
+        "        params = optax.apply_updates(params, updates)\n"
+        "    hvd.shutdown()\n"
+        "    return True\n"
+        "env = {\n"
+        "    'JAX_PLATFORMS': 'cpu',\n"
+        "    'PALLAS_AXON_POOL_IPS': '',\n"
+        # host-wire data plane: the only cross-process eager path on CPU
+        "    'HVD_ELASTIC': '1',\n"
+        f"    'HOROVOD_TRACE': {trace!r},\n"
+        "    'HOROVOD_TRACE_INTERVAL': '0.2',\n"
+        f"    'PYTHONPATH': {REPO!r},\n"
+        "}\n"
+        "assert all(run(fn, np=2, env=env, start_timeout=120))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"trace-capture smoke job failed:\n{r.stderr[-2000:]}")
+    assert os.path.exists(trace), f"no merged trace at {trace}"
+    hvdprof = os.path.join(REPO, "bin", "hvdprof")
+    v = subprocess.run([sys.executable, hvdprof, "validate", trace],
+                       capture_output=True, text=True, timeout=60)
+    assert v.returncode == 0, (
+        f"hvdprof validate rejected the merged trace:\n{v.stderr[-2000:]}"
+        f"\n{v.stdout[-2000:]}")
+    p = subprocess.run([sys.executable, hvdprof, "report", trace, "--json"],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, (
+        f"hvdprof report failed:\n{p.stderr[-2000:]}")
+    report = json.loads(p.stdout)
+    wire = report["counts"]["wire_spans"]
+    assert wire > 0, f"merged trace has no wire spans: {report['counts']}"
+    ranks = sorted(int(k) for k in report["ranks"])
+    assert ranks == [0, 1], f"expected spans from both ranks, got {ranks}"
+    print(f"ok: trace capture merged {report['counts']['events']} events "
+          f"({wire} wire spans) from ranks {ranks}; hvdprof parses it")
+
+
 def main():
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
@@ -260,8 +327,9 @@ def main():
     check_metrics_endpoint()
     check_chaos_reconnect()
     check_nan_skip()
+    check_trace_capture()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
-          "+ chaos reconnect + nan skip-step valid")
+          "+ chaos reconnect + nan skip-step + trace capture valid")
 
 
 if __name__ == "__main__":

@@ -104,6 +104,7 @@ from .integrity import ConsistencyAuditor, GradGuard  # noqa: F401
 from .metrics import metrics  # noqa: F401
 from . import parallel  # noqa: F401
 from . import spmd  # noqa: F401
+from . import tracing  # noqa: F401
 from .run.api import run  # noqa: F401
 
 __version__ = "0.1.0"

@@ -280,6 +280,7 @@ def shutdown() -> None:
     with _init_lock:
         if not _state.initialized:
             return
+        mode, rank0, world = _state.mode, _state.rank0, _state.size
         if _state.engine is not None:
             _state.engine.shutdown()
         _state = _GlobalState()
@@ -287,6 +288,14 @@ def shutdown() -> None:
 
         stop_server()
         clear_reports()
+        # engine shutdown already pushed/drained the final span batches;
+        # rank 0 (or the single process) now owns writing the merged trace
+        from . import tracing
+
+        out = tracing.finalize(mode=mode, rank=rank0, world_size=world)
+        if out:
+            logger.info("merged trace written to %s (hvdprof report %s)",
+                        out, out)
     for fn in _shutdown_hooks:
         try:
             fn()

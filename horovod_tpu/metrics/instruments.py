@@ -178,3 +178,26 @@ def collective_timeouts():
         "Collectives forcibly failed after stalling past "
         "HOROVOD_COLLECTIVE_TIMEOUT (enforced watchdog; each firing also "
         "names the missing ranks in the CollectiveTimeoutError).")
+
+
+def exposed_comm_seconds():
+    return get_registry().gauge(
+        "hvd_exposed_comm_seconds",
+        "Cumulative wall time this rank spent blocked in synchronize() "
+        "waiting on collective results — communication NOT hidden behind "
+        "compute (the hvdprof exposed-communication headline).", agg="sum")
+
+
+def straggler_skew_seconds():
+    return get_registry().gauge(
+        "hvd_straggler_skew_seconds",
+        "Enqueue-time spread (slowest minus fastest rank) observed at the "
+        "most recent negotiation a tensor became ready — how long fast "
+        "ranks waited for the straggler.", agg="max")
+
+
+def trace_dropped_events():
+    return get_registry().counter(
+        "hvd_trace_dropped_events_total",
+        "Trace spans dropped because the HOROVOD_TRACE_BUFFER ring (or "
+        "rank 0's merge store) was full.")

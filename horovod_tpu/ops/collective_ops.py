@@ -233,5 +233,23 @@ def poll(handle: int) -> bool:
 
 def synchronize(handle: int):
     """Block until the async op completes; raises HorovodInternalError on
-    negotiation/execution failure (`torch/mpi_ops.py:476-492`)."""
-    return basics._engine().handles.synchronize(handle)
+    negotiation/execution failure (`torch/mpi_ops.py:476-492`).
+
+    The blocked wall time here is communication the step could NOT hide
+    behind compute — it accumulates into hvd_exposed_comm_seconds and, when
+    tracing is on, becomes a WAIT span (docs/tracing.md)."""
+    import time
+
+    from .. import tracing as _tracing
+    from ..metrics import instruments
+
+    tr = _tracing.active()
+    t0u = _tracing.clock.trace_us() if tr is not None else 0
+    t0 = time.perf_counter()
+    try:
+        return basics._engine().handles.synchronize(handle)
+    finally:
+        dt = time.perf_counter() - t0
+        instruments.exposed_comm_seconds().inc(dt)
+        if tr is not None:
+            tr.add_wait(basics.rank(), t0u, t0u + int(dt * 1e6))

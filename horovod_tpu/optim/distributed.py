@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import basics
+from .. import tracing as _tracing
 from ..basics import Adasum, Average, Sum
 from ..ops import collective_ops as ops
 from ..ops import compression as _compression
@@ -70,6 +71,10 @@ def allreduce_gradients(grads, op: int = Average,
         return _sparse.densify_tree(grads) if sparse_as_dense else grads
     pairs, treedef = jax.tree_util.tree_flatten_with_path(
         grads, is_leaf=is_sparse)
+    tr = _tracing.active()
+    launch_span = (tr.begin_block(_tracing.K_PHASE, basics.rank(),
+                                  "GRAD_LAUNCH", _tracing.clock.trace_us())
+                   if tr is not None else None)
     started = []
     for path, leaf in pairs:
         name = prefix + jax.tree_util.keystr(path)
@@ -90,13 +95,24 @@ def allreduce_gradients(grads, op: int = Average,
                         ops.allreduce_async(comp, name=name, op=op,
                                             compression=compression),
                         ctx))
+    if tr is not None:
+        # launch vs drain phases make backward/wire overlap visible in the
+        # merged trace: wire spans overlapping GRAD_LAUNCH are hidden comm,
+        # wire spans inside GRAD_DRAIN are exposed
+        tr.end_block(launch_span, _tracing.clock.trace_us())
+        drain_span = tr.begin_block(_tracing.K_PHASE, basics.rank(),
+                                    "GRAD_DRAIN", _tracing.clock.trace_us())
     outs = []
-    for kind, h, meta in started:
-        if kind == "sparse":
-            outs.append(_sparse.synchronize_sparse(
-                h, op=op, dense_shape=meta.dense_shape))
-        else:
-            outs.append(compression.decompress(ops.synchronize(h), meta))
+    try:
+        for kind, h, meta in started:
+            if kind == "sparse":
+                outs.append(_sparse.synchronize_sparse(
+                    h, op=op, dense_shape=meta.dense_shape))
+            else:
+                outs.append(compression.decompress(ops.synchronize(h), meta))
+    finally:
+        if tr is not None:
+            tr.end_block(drain_span, _tracing.clock.trace_us())
     return jax.tree_util.tree_unflatten(treedef, outs)
 
 
@@ -228,6 +244,17 @@ class DistributedOptimizer(_GradAccumulation):
         if not communicate:
             zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
             return zero, state
+        tr = _tracing.active()
+        step_span = (tr.begin_block(_tracing.K_STEP, basics.rank(), "STEP",
+                                    _tracing.clock.trace_us())
+                     if tr is not None else None)
+        try:
+            return self._communicating_update(grads, state, params)
+        finally:
+            if tr is not None:
+                tr.end_block(step_span, _tracing.clock.trace_us())
+
+    def _communicating_update(self, grads, state, params):
         # GradGuard before error feedback: a poisoned step must not leak
         # NaN into the EF residual, and a global skip leaves the residual
         # exactly as it was (the step never happened on any rank)

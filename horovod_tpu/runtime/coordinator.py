@@ -87,6 +87,15 @@ MSG_HEARTBEAT = 8
 # response the worker fully received; the serve loop answers replayed
 # requests from the coordinator's per-rank response cache
 MSG_RESUME = 9
+# fire-and-forget trace-span batch (worker ring-buffer drain -> rank 0's
+# merged Chrome trace, docs/tracing.md); same interleaving contract as
+# MSG_METRICS
+MSG_TRACE = 10
+# trace clock handshake: worker sends its local timestamp, rank 0 replies
+# with its own trace clock + the job's trace id; the worker derives a
+# min-RTT NTP-style offset so spans from every rank share one timeline
+MSG_CLOCK = 11
+MSG_CLOCK_RESP = 12
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -101,12 +110,15 @@ _FUSABLE = (int(RequestType.ALLREDUCE), int(RequestType.ADASUM),
 class _Pending:
     """Coordinator-side state for one named tensor still being negotiated."""
 
-    __slots__ = ("metas", "first_t", "order_idx")
+    __slots__ = ("metas", "first_t", "order_idx", "arrivals")
 
     def __init__(self, order_idx: int):
         self.metas: Dict[int, ReqMeta] = {}
         self.first_t = time.monotonic()
         self.order_idx = order_idx
+        # first-arrival time per rank: the spread when the tensor becomes
+        # ready is the straggler skew (hvd_straggler_skew_seconds)
+        self.arrivals: Dict[int, float] = {}
 
 
 class CoordState:
@@ -650,11 +662,15 @@ class CoordState:
         warnings: List[str] = []
         timed_out: List[Tuple[str, List[int], float]] = []
         n_stalled = 0
+        max_skew = -1.0
         for name, p in sorted(self.table.items(),
                               key=lambda kv: kv[1].order_idx):
             have = set(p.metas)
             if active <= have:
                 ready.append(name)
+                if len(p.arrivals) > 1:
+                    max_skew = max(max_skew, max(p.arrivals.values())
+                                   - min(p.arrivals.values()))
                 # completed: re-arm the stall inspector so a second stall of
                 # the same tensor warns again
                 self.warned.discard(name)
@@ -714,6 +730,8 @@ class CoordState:
                         "SECONDS exceeded, stall_inspector.h:80)")
 
         instruments.stalled_tensors().set(n_stalled)
+        if max_skew >= 0:
+            instruments.straggler_skew_seconds().set(max_skew)
 
         singles = []
         responses: List[Response] = []
@@ -806,6 +824,7 @@ class CoordState:
             self.order_ctr += 1
             self.table[m.name] = p
         p.metas[rank] = m
+        p.arrivals.setdefault(rank, time.monotonic())
 
     @staticmethod
     def _nbytes(m: ReqMeta) -> int:
@@ -1053,6 +1072,29 @@ class CoordinatorServer:
                         logger.debug("coordinator: bad metrics report from "
                                      "rank %s", rank, exc_info=True)
                     continue
+                if mt == MSG_TRACE:
+                    # fire-and-forget: merge the rank's completed spans into
+                    # rank 0's trace store; no reply frame
+                    from .. import tracing as _tracing
+
+                    try:
+                        _, spans = wire.decode_trace_batch(payload)
+                        _tracing.store_batch(spans)
+                    except Exception:
+                        logger.debug("coordinator: bad trace batch from "
+                                     "rank %s", rank, exc_info=True)
+                    continue
+                if mt == MSG_CLOCK:
+                    # clock-offset probe: answer immediately with rank 0's
+                    # trace clock and the job trace id (latency here IS the
+                    # measurement, so no queuing behind state locks)
+                    from .. import tracing as _tracing
+
+                    reply = wire.encode_clock_reply(
+                        _tracing.clock.trace_us(), _tracing.ensure_trace_id())
+                    wire.send_frame(conn, self.secret, MSG_CLOCK_RESP, seq,
+                                    0, reply)
+                    continue
                 if mt != MSG_LIST:
                     raise ConnectionError(f"unexpected message type {mt}")
                 data = self.state.exchange(rank, seq, payload)
@@ -1296,6 +1338,15 @@ class CoordController:
                 self._sock = self._faults.wrap(self._sock)
             wire.send_frame(self._sock, self._secret, MSG_HELLO, 0,
                             self_rank)
+            # trace clock handshake before the heartbeat thread exists: the
+            # socket is quiet, so probe RTTs measure the wire, not queuing
+            from .. import tracing as _tracing
+            if _tracing.active() is not None:
+                try:
+                    self._sync_trace_clock()
+                except Exception:
+                    logger.debug("trace clock sync failed; spans stay in "
+                                 "the local timebase", exc_info=True)
             if self._hb_interval > 0:
                 threading.Thread(target=self._heartbeat_loop,
                                  name="hvd_heartbeat", daemon=True).start()
@@ -1580,6 +1631,49 @@ class CoordController:
         except (ConnectionError, OSError):
             pass  # telemetry only; the control path will surface the loss
 
+    def push_traces(self) -> None:
+        """Ship this rank's completed trace spans as a fire-and-forget
+        MSG_TRACE frame (engine loop calls this every
+        HOROVOD_TRACE_INTERVAL seconds). Rank 0 owns the merge store, so it
+        drains locally instead of going over the wire."""
+        from .. import tracing as _tracing
+
+        tr = _tracing.active()
+        if tr is None:
+            return
+        if self._rank == 0 or self._sock is None:
+            _tracing.flush_local()
+            return
+        spans = tr.drain()
+        if not spans:
+            return
+        payload = wire.encode_trace_batch(self._rank, spans)
+        try:
+            with self._send_lock:
+                wire.send_frame(self._sock, self._secret, MSG_TRACE, 0,
+                                self._rank, payload)
+        except (ConnectionError, OSError):
+            pass  # telemetry only; the drained batch is lost, not the job
+
+    def _sync_trace_clock(self, rounds: int = 5) -> None:
+        """NTP-style offset handshake against rank 0 (docs/tracing.md):
+        each probe carries the local trace timestamp, the reply carries
+        rank 0's; the minimum-RTT sample wins. The reply also distributes
+        the job's globally-unique trace id."""
+        from .. import tracing as _tracing
+
+        def probe(t_local_us):
+            data = self._request_reply(MSG_CLOCK, MSG_CLOCK_RESP, 0,
+                                       wire.encode_clock_probe(t_local_us))
+            server_us, tid = wire.decode_clock_reply(data)
+            if tid:
+                _tracing.set_trace_id(tid)
+            return server_us
+
+        off = _tracing.clock.sync_offset(probe, rounds=rounds)
+        logger.info("trace clock: rank %d offset to rank 0 is %d us",
+                    self._rank, off)
+
     # -------------------------------------------------------------- elastic
     def commit(self) -> None:
         """Mark a commit boundary: REQ_COMMIT rides the next request frame.
@@ -1620,6 +1714,12 @@ class CoordController:
             self._outbox.clear()
             self._ranks_changed_reason = reason or "cluster membership changed"
         self._timeline.epoch_marker(epoch)
+        from .. import tracing as _tracing
+        tr = _tracing.active()
+        if tr is not None:
+            # the merged trace shows exactly which spans straddled the reset
+            tr.add_mark(self._rank, f"EPOCH_{epoch}",
+                        _tracing.clock.trace_us())
         msg = (f"membership epoch {epoch}: members {self._members}"
                + (f" ({reason})" if reason else ""))
         if "lost" in (reason or ""):
@@ -1692,6 +1792,12 @@ class CoordController:
                     pass
 
     def shutdown(self) -> List[int]:
+        # final span drain must beat the BYE: after it the socket dies and
+        # anything still in the ring would never reach rank 0's merged trace
+        try:
+            self.push_traces()
+        except Exception:
+            pass
         self._send_bye()
         self._stop.set()
         with self._lock:

@@ -55,6 +55,7 @@ from ..exceptions import (CollectiveTimeoutError, DuplicateNameError,
                           HorovodInternalError, RanksChangedError,
                           ShutdownError)
 from ..metrics import instruments
+from .. import tracing as _tracing
 from ..utils.env import env_float as _env_float, env_on as _env_on
 from .executor import Executor
 from .handles import HandleManager
@@ -196,6 +197,12 @@ class Engine:
         # process registry and has nothing to ship
         self._metrics_interval = _env_float("HOROVOD_METRICS_INTERVAL", 5.0)
         self._metrics_next_push = time.monotonic() + self._metrics_interval
+        # distributed tracing (docs/tracing.md): active only when
+        # HOROVOD_TRACE names a merged-output path; otherwise active() stays
+        # None and every instrumentation site is one attribute read
+        _tracing.maybe_activate()
+        self._trace_interval = _env_float("HOROVOD_TRACE_INTERVAL", 2.0)
+        self._trace_next_push = time.monotonic() + self._trace_interval
         # pre-touch the catalog's unlabeled series (inc(0) materializes the
         # child) so /metrics renders them at 0 before the first negotiation
         instruments.response_cache_hits().inc(0)
@@ -209,6 +216,7 @@ class Engine:
         instruments.param_desync().inc(0)
         instruments.integrity_heals().inc(0)
         instruments.collective_timeouts().inc(0)
+        instruments.trace_dropped_events().inc(0)
         epoch_fn = getattr(self.controller, "epoch", None)
         instruments.elastic_epoch().set(
             max(0, epoch_fn()) if callable(epoch_fn) else 0)
@@ -262,6 +270,13 @@ class Engine:
                 else:
                     self._pending[ch] = entry
                     self._wake.notify_all()
+        if fail is None:
+            tr = _tracing.active()
+            if tr is not None:
+                tr.begin_collective(
+                    entry.rank, entry.tensor_name, entry.request_type.name,
+                    int(entry.array.size) * entry.array.dtype.itemsize,
+                    _tracing.clock.trace_us())
         if fail is not None:
             # the completion contract covers submit-time failures too, and
             # callbacks must never run under the engine lock (they may call
@@ -328,6 +343,10 @@ class Engine:
                     push = getattr(self.controller, "push_metrics", None)
                     if push is not None:
                         push()
+                if (_tracing.active() is not None
+                        and now >= self._trace_next_push):
+                    self._trace_next_push = now + self._trace_interval
+                    self._flush_traces()
                 if getattr(self.controller, "coordinated", False):
                     # coordinated autotune delivers tuned cycle time inside
                     # the tick's ResponseList; pick it up even on idle ticks
@@ -415,11 +434,26 @@ class Engine:
                 self._finish_drain(*drained)
                 return
 
+    def _flush_traces(self) -> None:
+        """Ship this cadence's completed spans: coordinated controllers push
+        an MSG_TRACE batch to rank 0; everything else shares the process-
+        local merge store and drains straight into it."""
+        push = getattr(self.controller, "push_traces", None)
+        if push is not None:
+            push()
+        else:
+            _tracing.flush_local()
+
     def _drain_locked(self):
         """Under the engine lock: stop the controller, snapshot and clear
         everything outstanding. Returns (entries, join_users) for
         `_finish_drain`, which must run with the lock RELEASED — user
         completion callbacks may call back into engine APIs."""
+        if _tracing.active() is not None:
+            try:
+                self._flush_traces()
+            except Exception:
+                pass
         self.controller.shutdown()
         entries = list(self._pending.values())
         self._pending.clear()
@@ -490,6 +524,15 @@ class Engine:
         for r in ebr:
             ebr[r].sort(key=lambda e: name_order[e.tensor_name])
 
+        tr = _tracing.active()
+        if tr is not None:
+            # the response arriving IS the end of negotiation for every
+            # tensor it fuses
+            t_neg = _tracing.clock.trace_us()
+            for e in entries:
+                tr.mark(e.rank, e.tensor_name, _tracing.T_NEG, t_neg)
+                tr.set_fused(e.rank, e.tensor_name, len(entries))
+
         if resp.response_type == ResponseType.ERROR:
             # enforced-watchdog errors surface as a dedicated type so
             # callers can catch them apart from generic negotiation errors
@@ -506,6 +549,9 @@ class Engine:
                     self.handles.mark_done(e.handle, False,
                                            error=resp.error_message,
                                            error_cls=error_cls)
+                    if tr is not None:
+                        tr.finish(e.rank, e.tensor_name,
+                                  _tracing.clock.trace_us())
             return
 
         for n in resp.tensor_names:
@@ -514,8 +560,16 @@ class Engine:
         nbytes = sum(int(e.array.size) * e.array.dtype.itemsize
                      for es in ebr.values() for e in es)
         exact_bytes = nbytes
+        if tr is not None:
+            t_ws = _tracing.clock.trace_us()
+            for e in entries:
+                tr.mark(e.rank, e.tensor_name, _tracing.T_WIRE_START, t_ws)
         try:
             results = self._executor.execute(resp, ebr)
+            if tr is not None:
+                t_we = _tracing.clock.trace_us()
+                for e in entries:
+                    tr.mark(e.rank, e.tensor_name, _tracing.T_WIRE_END, t_we)
             if self._executor.last_wire_mode:
                 # quantized wire: score the bytes actually moved (int8
                 # payload + scales; last_wire_bytes is one rank's
@@ -532,6 +586,9 @@ class Engine:
                     # the time synchronize() unblocks
                     self._fire_callback(e, True, out)
                     self.handles.mark_done(e.handle, True, result=out)
+                    if tr is not None:
+                        tr.finish(e.rank, e.tensor_name,
+                                  _tracing.clock.trace_us())
         except RanksChangedError as exc:
             # membership changed under this response's data exchange: fail
             # its handles with the reset error and re-raise so the loop
@@ -542,6 +599,9 @@ class Engine:
                     self._fire_callback(e, False, msg)
                     self.handles.mark_done(e.handle, False, error=msg,
                                            error_cls=type(exc))
+                    if tr is not None:
+                        tr.finish(e.rank, e.tensor_name,
+                                  _tracing.clock.trace_us())
             raise
         except Exception as exc:  # surface execution errors on every handle
             msg = f"{type(exc).__name__}: {exc}"
@@ -549,6 +609,9 @@ class Engine:
                 for e in es:
                     self._fire_callback(e, False, msg)
                     self.handles.mark_done(e.handle, False, error=msg)
+                    if tr is not None:
+                        tr.finish(e.rank, e.tensor_name,
+                                  _tracing.clock.trace_us())
         finally:
             for n in resp.tensor_names:
                 self.controller.timeline_op_end(n)

@@ -253,12 +253,18 @@ class PyController:
             stall_shutdown = False
             timed_out: List[Tuple[str, Dict[int, _Meta], List[int], float]] = []
             n_stalled = 0
+            max_skew = -1.0
             for name in self._order:
                 st = self._table.get(name)
                 if st is None:
                     continue
                 if active <= set(st.keys()):
                     ready.append(name)
+                    if len(st) > 1:
+                        # enqueue-time spread at readiness = how long the
+                        # fast ranks waited on the straggler for this tensor
+                        ts = [m.enqueue_t for m in st.values()]
+                        max_skew = max(max_skew, max(ts) - min(ts))
                     # completed: re-arm the stall inspector so a second
                     # stall of the same tensor warns again
                     self._warned.discard(name)
@@ -286,6 +292,8 @@ class PyController:
                     if self._stall_shutdown_s and waited > self._stall_shutdown_s:
                         stall_shutdown = True
             instruments.stalled_tensors().set(n_stalled)
+            if max_skew >= 0:
+                instruments.straggler_skew_seconds().set(max_skew)
             self._order = waiting
             if (not ready and not stall_warnings and not stall_shutdown
                     and not timed_out):

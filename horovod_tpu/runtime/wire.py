@@ -602,6 +602,71 @@ def decode_metrics_report(buf: bytes):
     return rank, timestamp, snapshot
 
 
+# --------------------------------------------------------------------------
+# Trace span batches (MSG_TRACE frames): completed collective-lifecycle spans
+# drained from a worker's ring buffer, shipped fire-and-forget like metrics
+# reports and merged by rank 0 into one Chrome trace (docs/tracing.md). The
+# clock-probe payloads (MSG_CLOCK / MSG_CLOCK_RESP) carry the NTP-style
+# offset handshake that aligns every rank's trace timebase to rank 0's.
+# --------------------------------------------------------------------------
+
+def encode_trace_batch(rank: int, spans) -> bytes:
+    from ..tracing.spans import NUM_TS
+    w = Writer()
+    w.i32(rank)
+    w.u32(len(spans))
+    for s in spans:
+        w.u8(s.kind)
+        w.i32(s.rank)
+        w.str(s.name)
+        w.str(s.op)
+        w.i64(s.span_id)
+        w.i64(s.nbytes)
+        w.i32(s.fused)
+        for i in range(NUM_TS):
+            w.i64(s.ts[i])
+    return w.getvalue()
+
+
+def decode_trace_batch(buf: bytes):
+    """Returns (sender_rank, [Span])."""
+    from ..tracing.spans import NUM_TS, Span
+    rd = Reader(buf)
+    sender = rd.i32()
+    spans = []
+    for _ in range(rd.u32()):
+        kind = rd.u8()
+        rank = rd.i32()
+        name = rd.str()
+        op = rd.str()
+        span_id = rd.i64()
+        nbytes = rd.i64()
+        fused = rd.i32()
+        ts = [rd.i64() for _ in range(NUM_TS)]
+        spans.append(Span(kind, rank, name, op=op, span_id=span_id,
+                          nbytes=nbytes, fused=fused, ts=ts))
+    return sender, spans
+
+
+def encode_clock_probe(t_local_us: int) -> bytes:
+    return struct.pack("<q", t_local_us)
+
+
+def decode_clock_probe(buf: bytes) -> int:
+    return struct.unpack("<q", buf[:8])[0] if len(buf) >= 8 else 0
+
+
+def encode_clock_reply(server_trace_us: int, trace_id: int) -> bytes:
+    return struct.pack("<qq", server_trace_us, trace_id)
+
+
+def decode_clock_reply(buf: bytes):
+    """Returns (server_trace_us, trace_id)."""
+    if len(buf) >= 16:
+        return struct.unpack("<qq", buf[:16])
+    return 0, 0
+
+
 def encode_data_result(status: int, epoch: int, nparticipants: int,
                        members: Optional[List[int]],
                        payload: bytes) -> bytes:

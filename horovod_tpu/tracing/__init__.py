@@ -1,0 +1,187 @@
+# Copyright 2018 Uber Technologies, Inc. All Rights Reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+# ==============================================================================
+"""Cross-rank distributed tracing.
+
+Per-tensor collective lifecycle spans (enqueue → negotiate → wire →
+dequeue-done) recorded on every rank in a rank-0-aligned monotonic
+timebase, shipped to the coordinator over ``MSG_TRACE`` frames, and
+merged by rank 0 into one strictly-valid Chrome/Perfetto trace at the
+path named by ``HOROVOD_TRACE``. ``bin/hvdprof`` analyzes the merged
+file; see :mod:`horovod_tpu.tracing.analyzer`.
+
+The whole subsystem is a no-op unless ``HOROVOD_TRACE`` is set:
+``active()`` returns ``None`` and the engine's hot path does a single
+attribute read per instrumentation site, allocating nothing.
+"""
+
+import os
+import threading
+from collections import deque
+
+from . import clock  # noqa: F401  (re-exported for callers)
+from .spans import (  # noqa: F401
+    K_COLLECTIVE, K_MARK, K_PHASE, K_STEP, K_WAIT,
+    NUM_TS, T_DONE, T_ENQ, T_NEG, T_WIRE_END, T_WIRE_START,
+    Span, SpanRecorder, allocation_count, buffer_capacity,
+)
+
+_lock = threading.Lock()
+_tracer = None        # SpanRecorder when HOROVOD_TRACE is set
+_path = None          # merged-trace output path
+_trace_id = 0         # rank 0 generates; workers learn it via MSG_CLOCK
+_store = deque()      # rank 0 / local: completed spans from every rank
+_store_cap = 0
+
+
+def _resolve_path():
+    raw = os.environ.get("HOROVOD_TRACE", "").strip()
+    if not raw:
+        return None
+    if raw in ("1", "true", "True"):
+        return "hvd_trace.json"
+    return raw
+
+
+def active():
+    """The process tracer, or None when tracing is off (the fast path)."""
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def maybe_activate():
+    """Install the tracer iff ``HOROVOD_TRACE`` is set. Idempotent."""
+    global _tracer, _path, _store_cap
+    path = _resolve_path()
+    if path is None:
+        return None
+    with _lock:
+        if _tracer is None:
+            _path = path
+            _tracer = SpanRecorder()
+            # Rank 0 aggregates every rank's spans; give the merged store
+            # more headroom than one rank's ring.
+            _store_cap = buffer_capacity() * 8
+        return _tracer
+
+
+def trace_path():
+    return _path
+
+
+def ensure_trace_id() -> int:
+    """Rank 0: lazily mint the globally-unique trace id."""
+    global _trace_id
+    with _lock:
+        if _trace_id == 0:
+            _trace_id = (int.from_bytes(os.urandom(6), "big") << 16) \
+                | (os.getpid() & 0xFFFF)
+        return _trace_id
+
+
+def set_trace_id(tid: int) -> None:
+    """Workers: install the trace id learned from rank 0's handshake."""
+    global _trace_id
+    with _lock:
+        _trace_id = int(tid)
+
+
+def trace_id() -> int:
+    return _trace_id
+
+
+def store_batch(span_list) -> None:
+    """Accept a batch of completed spans (local drain or MSG_TRACE)."""
+    global _store
+    if not span_list:
+        return
+    with _lock:
+        overflow = len(_store) + len(span_list) - _store_cap
+        if _store_cap and overflow > 0:
+            from ..metrics import instruments
+            for _ in range(min(overflow, len(_store))):
+                _store.popleft()
+            instruments.trace_dropped_events().inc(overflow)
+            span_list = span_list[-_store_cap:]
+        _store.extend(span_list)
+
+
+def store_size() -> int:
+    with _lock:
+        return len(_store)
+
+
+def flush_local() -> None:
+    """Drain the tracer's ring straight into the local merged store.
+
+    Used by rank 0 and by uncoordinated controllers, where there is no
+    wire to ship spans over — same clock, same process, so spans go
+    directly where MSG_TRACE batches would land.
+    """
+    tr = _tracer
+    if tr is not None:
+        store_batch(tr.drain())
+
+
+def drain_store():
+    with _lock:
+        out = list(_store)
+        _store.clear()
+    return out
+
+
+def finalize(mode="standalone", rank=0, world_size=None):
+    """Write the merged trace (if this process owns one) and reset.
+
+    Rank 0 — and any single-process mode — writes ``HOROVOD_TRACE``
+    itself; a multiprocess worker that somehow still holds local spans
+    (uncoordinated fallback) writes ``<path>.rank<N>`` instead of
+    clobbering the merged file. Returns the written path or None.
+    """
+    global _tracer, _path, _trace_id, _store_cap
+    tr = _tracer
+    if tr is None:
+        return None
+    flush_local()
+    spans = drain_store()
+    path = _path
+    out = None
+    if spans and path:
+        from .writer import write_merged
+        if rank != 0 and mode == "multiprocess":
+            path = "%s.rank%d" % (path, rank)
+        out = write_merged(path, spans, trace_id=_trace_id,
+                           world_size=world_size)
+    with _lock:
+        _tracer = None
+        _path = None
+        _trace_id = 0
+        _store_cap = 0
+    clock.reset()
+    return out
+
+
+def reset_for_tests() -> None:
+    """Hard reset of all module state (unit tests only)."""
+    global _tracer, _path, _trace_id, _store_cap
+    with _lock:
+        _tracer = None
+        _path = None
+        _trace_id = 0
+        _store_cap = 0
+        _store.clear()
+    clock.reset()

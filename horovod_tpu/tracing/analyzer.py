@@ -1,0 +1,226 @@
+# Copyright 2018 Uber Technologies, Inc. All Rights Reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+# ==============================================================================
+"""Critical-path analysis over a merged cross-rank trace.
+
+Consumes the Chrome-trace JSON written by
+:func:`horovod_tpu.tracing.writer.write_merged` (either the
+``{"traceEvents": [...]}`` object or a bare event array) and produces the
+numbers ``hvdprof`` reports: per-step breakdown (compute vs negotiation
+vs wire vs straggler wait), exposed-communication %, per-rank skew, and
+the top-k slowest tensors.
+"""
+
+import json
+from collections import defaultdict
+
+from .writer import EV_DEQUEUE, EV_NEGOTIATE, EV_STEP, EV_WAIT, EV_WIRE
+
+_PHASE_NAMES = (EV_NEGOTIATE, EV_WIRE, EV_DEQUEUE)
+
+
+def load_events(path):
+    """Load trace events from a merged-object or bare-array trace file."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        raise ValueError("unrecognized trace document in %s" % path)
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list in %s" % path)
+    return events
+
+
+def union_us(intervals):
+    """Total covered microseconds of possibly-overlapping (ts, dur) spans.
+
+    Negotiation windows of concurrently in-flight tensors overlap heavily;
+    summing raw durations would overcount, so merge first.
+    """
+    ivs = sorted((ts, ts + max(0, dur)) for ts, dur in intervals)
+    total = 0
+    cur_a = cur_b = None
+    for a, b in ivs:
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                total += cur_b - cur_a
+            cur_a, cur_b = a, b
+        elif b > cur_b:
+            cur_b = b
+    if cur_b is not None:
+        total += cur_b - cur_a
+    return total
+
+
+def analyze(path, top=10):
+    """Build the hvdprof report dict from a merged trace file."""
+    events = load_events(path)
+    xs = [e for e in events if e.get("ph") == "X"]
+
+    # rank -> phase name -> [(ts, dur)]
+    by_rank = defaultdict(lambda: defaultdict(list))
+    # (tensor, per-rank occurrence idx) -> {rank: negotiate-start ts}
+    neg_starts = defaultdict(dict)
+    occ = defaultdict(int)  # (rank, tensor) -> occurrence counter
+    # span_id -> accumulated lifecycle; tensor aggregation after
+    span_dur = defaultdict(int)
+    span_tensor = {}
+    wire_spans = 0
+
+    for e in xs:
+        rank = e.get("pid", 0)
+        name = e.get("name", "")
+        ts = e.get("ts", 0)
+        dur = e.get("dur", 0)
+        by_rank[rank][name].append((ts, dur))
+        args = e.get("args") or {}
+        tensor = args.get("tensor")
+        if name == EV_WIRE:
+            wire_spans += 1
+        if name in _PHASE_NAMES and tensor is not None:
+            sid = args.get("span_id", "%s/%s" % (rank, tensor))
+            span_dur[sid] += dur
+            span_tensor[sid] = tensor
+        if name == EV_NEGOTIATE and tensor is not None:
+            key = (rank, tensor)
+            neg_starts[(tensor, occ[key])][rank] = ts
+            occ[key] += 1
+
+    ranks = {}
+    tot_step = tot_wait = 0
+    for rank in sorted(by_rank):
+        groups = by_rank[rank]
+        step_us = sum(d for _, d in groups.get(EV_STEP, []))
+        neg_us = union_us(groups.get(EV_NEGOTIATE, []))
+        wire_us = union_us(groups.get(EV_WIRE, []))
+        deq_us = union_us(groups.get(EV_DEQUEUE, []))
+        wait_us = union_us(groups.get(EV_WAIT, []))
+        compute_us = max(0, step_us - wait_us)
+        ranks[rank] = {
+            "steps": len(groups.get(EV_STEP, [])),
+            "step_us": step_us,
+            "compute_us": compute_us,
+            "negotiate_us": neg_us,
+            "wire_us": wire_us,
+            "dequeue_us": deq_us,
+            "wait_us": wait_us,
+            "exposed_comm_pct":
+                (100.0 * wait_us / step_us) if step_us else 0.0,
+        }
+        tot_step += step_us
+        tot_wait += wait_us
+
+    # Straggler skew: for every (tensor, occurrence) group seen on >1 rank,
+    # the spread of negotiation-start times is how long the fastest rank
+    # sat waiting for the slowest.
+    lags = defaultdict(list)  # rank -> [lag_us]
+    max_skew = 0
+    for starts in neg_starts.values():
+        if len(starts) < 2:
+            continue
+        lo = min(starts.values())
+        max_skew = max(max_skew, max(starts.values()) - lo)
+        for rank, ts in starts.items():
+            lags[rank].append(ts - lo)
+    skew = {}
+    for rank in sorted(lags):
+        vals = lags[rank]
+        skew[rank] = {"mean_us": sum(vals) / len(vals),
+                      "max_us": max(vals), "samples": len(vals)}
+
+    # Top-k slowest tensors by total lifecycle time.
+    per_tensor = defaultdict(lambda: [0, 0])  # tensor -> [total_us, count]
+    for sid, dur in span_dur.items():
+        agg = per_tensor[span_tensor[sid]]
+        agg[0] += dur
+        agg[1] += 1
+    slowest = sorted(
+        ({"tensor": t, "total_us": v[0], "count": v[1],
+          "mean_us": v[0] / v[1]}
+         for t, v in per_tensor.items()),
+        key=lambda r: -r["total_us"])[:top]
+
+    return {
+        "ranks": ranks,
+        "overall": {
+            "exposed_comm_pct":
+                (100.0 * tot_wait / tot_step) if tot_step else 0.0,
+            "step_s": tot_step / 1e6,
+            "wait_s": tot_wait / 1e6,
+            "max_skew_us": max_skew,
+        },
+        "skew": skew,
+        "slowest": slowest,
+        "counts": {
+            "events": len(events),
+            "x_events": len(xs),
+            "wire_spans": wire_spans,
+        },
+    }
+
+
+def _fmt_us(us):
+    if us >= 1e6:
+        return "%.3f s" % (us / 1e6)
+    if us >= 1e3:
+        return "%.3f ms" % (us / 1e3)
+    return "%d us" % us
+
+
+def format_report(report, path=""):
+    """Render the analyze() dict as the hvdprof text report."""
+    lines = []
+    if path:
+        lines.append("trace: %s" % path)
+    c = report["counts"]
+    lines.append("events: %d total, %d spans, %d wire spans"
+                 % (c["events"], c["x_events"], c["wire_spans"]))
+    lines.append("")
+    lines.append("per-rank step breakdown")
+    lines.append("  %-4s %5s %12s %12s %12s %12s %12s %8s"
+                 % ("rank", "steps", "step", "compute", "negotiate",
+                    "wire", "wait", "exposed"))
+    for rank in sorted(report["ranks"]):
+        r = report["ranks"][rank]
+        lines.append("  %-4d %5d %12s %12s %12s %12s %12s %7.1f%%"
+                     % (rank, r["steps"], _fmt_us(r["step_us"]),
+                        _fmt_us(r["compute_us"]), _fmt_us(r["negotiate_us"]),
+                        _fmt_us(r["wire_us"]), _fmt_us(r["wait_us"]),
+                        r["exposed_comm_pct"]))
+    o = report["overall"]
+    lines.append("")
+    lines.append("exposed communication: %.1f%% of step time (%s wait / %s "
+                 "step)" % (o["exposed_comm_pct"], _fmt_us(o["wait_s"] * 1e6),
+                            _fmt_us(o["step_s"] * 1e6)))
+    if report["skew"]:
+        lines.append("")
+        lines.append("per-rank straggler skew (lag behind fastest rank at "
+                     "enqueue)")
+        for rank in sorted(report["skew"]):
+            s = report["skew"][rank]
+            lines.append("  rank %-4d mean %10s  max %10s  (%d collectives)"
+                         % (rank, _fmt_us(s["mean_us"]), _fmt_us(s["max_us"]),
+                            s["samples"]))
+        lines.append("  max cross-rank skew: %s" % _fmt_us(o["max_skew_us"]))
+    if report["slowest"]:
+        lines.append("")
+        lines.append("slowest tensors (total lifecycle time)")
+        for r in report["slowest"]:
+            lines.append("  %-40s total %10s  mean %10s  x%d"
+                         % (r["tensor"][:40], _fmt_us(r["total_us"]),
+                            _fmt_us(r["mean_us"]), r["count"]))
+    return "\n".join(lines)

@@ -1,0 +1,75 @@
+# Copyright 2018 Uber Technologies, Inc. All Rights Reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+# ==============================================================================
+"""``hvdprof`` — critical-path profiler CLI over merged hvd traces.
+
+Usage::
+
+    hvdprof report  trace.json [--top N] [--json]
+    hvdprof validate trace.json
+"""
+
+import argparse
+import json
+import sys
+
+from . import analyzer
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="hvdprof",
+        description="Analyze a merged horovod_tpu trace (HOROVOD_TRACE "
+                    "output): per-step breakdown, exposed-communication %, "
+                    "per-rank skew, slowest tensors.")
+    sub = p.add_subparsers(dest="cmd")
+    rep = sub.add_parser("report", help="print the critical-path report")
+    rep.add_argument("trace", help="merged trace JSON file")
+    rep.add_argument("--top", type=int, default=10,
+                     help="how many slowest tensors to list")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the raw report dict as JSON")
+    val = sub.add_parser("validate",
+                         help="check the file parses as Chrome-trace JSON")
+    val.add_argument("trace")
+    return p
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    if args.cmd is None:
+        _build_parser().print_help()
+        return 2
+    if args.cmd == "validate":
+        try:
+            events = analyzer.load_events(args.trace)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print("invalid trace %s: %s" % (args.trace, e), file=sys.stderr)
+            return 1
+        print("ok: %s (%d events)" % (args.trace, len(events)))
+        return 0
+    try:
+        report = analyzer.analyze(args.trace, top=args.top)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("failed to analyze %s: %s" % (args.trace, e), file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(analyzer.format_report(report, path=args.trace))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

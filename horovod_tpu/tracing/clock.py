@@ -1,0 +1,98 @@
+# Copyright 2018 Uber Technologies, Inc. All Rights Reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+# ==============================================================================
+"""Monotonic trace clock with cross-rank offset alignment.
+
+All trace timestamps are microseconds in a timebase anchored once per
+process: wall-clock at import plus a ``time.perf_counter_ns`` delta.
+Because the delta is monotonic, timestamps can never go backwards even
+if the system wall clock steps (NTP slew, manual adjustment) — the wall
+origin only fixes the epoch so traces from different processes land in
+the same ballpark before offset correction.
+
+``trace_us()`` additionally applies the rank-0 offset learned by the
+NTP-style handshake in :func:`compute_offset_us`, so spans recorded on
+different hosts align on a shared timeline.
+"""
+
+import time
+
+# Anchored once at import; everything after is pure perf_counter deltas.
+_PERF_ORIGIN_NS = time.perf_counter_ns()
+_WALL_ORIGIN_US = int(time.time() * 1e6)
+
+# Offset (us) added to local_us() to land on rank 0's timeline.
+_offset_us = 0
+
+
+def local_us() -> int:
+    """Monotonic microseconds in this process's local timebase."""
+    return _WALL_ORIGIN_US + (time.perf_counter_ns() - _PERF_ORIGIN_NS) // 1000
+
+
+def trace_us() -> int:
+    """Monotonic microseconds aligned to rank 0's timeline."""
+    return local_us() + _offset_us
+
+
+def offset_us() -> int:
+    return _offset_us
+
+
+def set_offset_us(offset: int) -> None:
+    global _offset_us
+    _offset_us = int(offset)
+
+
+def reset() -> None:
+    """Drop any learned offset (tests / re-init)."""
+    set_offset_us(0)
+
+
+def compute_offset_us(samples) -> int:
+    """Pick the clock offset from ``(t0, server_us, t1)`` probe samples.
+
+    Classic NTP estimate: for each round trip, assume the server stamped
+    its reply halfway through, so ``offset = server - (t0 + t1) / 2``.
+    The sample with the smallest round-trip time carries the least queuing
+    noise, so its offset estimate wins.
+    """
+    best_rtt = None
+    best_off = 0
+    for t0, server_us, t1 in samples:
+        rtt = t1 - t0
+        if rtt < 0:
+            continue
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt = rtt
+            best_off = server_us - (t0 + t1) // 2
+    return int(best_off)
+
+
+def sync_offset(probe, rounds: int = 5) -> int:
+    """Run ``rounds`` probes against rank 0 and install the best offset.
+
+    ``probe`` is a callable taking the local send timestamp (us) and
+    returning the server's ``trace_us`` at reply time. Returns the
+    installed offset.
+    """
+    samples = []
+    for _ in range(max(1, rounds)):
+        t0 = local_us()
+        server_us = probe(t0)
+        t1 = local_us()
+        samples.append((t0, server_us, t1))
+    off = compute_offset_us(samples)
+    set_offset_us(off)
+    return off

@@ -1,0 +1,209 @@
+# Copyright 2018 Uber Technologies, Inc. All Rights Reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+# ==============================================================================
+"""Span records and the bounded per-process span recorder.
+
+A span is one row of the collective lifecycle: for ``K_COLLECTIVE`` the
+five timestamps are enqueue → negotiated → wire-start → wire-end → done;
+block kinds (step / phase / wait) use only the first two slots. All
+timestamps are :func:`horovod_tpu.tracing.clock.trace_us` microseconds.
+
+Completed spans land in a ring buffer capped by ``HOROVOD_TRACE_BUFFER``;
+overflow drops the oldest span and bumps ``hvd_trace_dropped_events_total``
+rather than growing without bound.
+"""
+
+import os
+import threading
+from collections import deque
+
+# Span kinds.
+K_COLLECTIVE = 0
+K_STEP = 1
+K_PHASE = 2
+K_WAIT = 3
+K_MARK = 4
+
+# Timestamp slots for K_COLLECTIVE spans.
+T_ENQ = 0
+T_NEG = 1
+T_WIRE_START = 2
+T_WIRE_END = 3
+T_DONE = 4
+
+NUM_TS = 5
+
+DEFAULT_BUFFER = 65536
+
+# Tracks every span-record allocation so the no-op fast path can be
+# asserted: with tracing disabled this must not move.
+_allocations = 0
+
+
+def allocation_count() -> int:
+    return _allocations
+
+
+class Span:
+    __slots__ = ("kind", "rank", "name", "op", "span_id", "nbytes", "fused",
+                 "ts")
+
+    def __init__(self, kind, rank, name, op="", span_id=0, nbytes=0, fused=0,
+                 ts=None):
+        self.kind = kind
+        self.rank = rank
+        self.name = name
+        self.op = op
+        self.span_id = span_id
+        self.nbytes = nbytes
+        self.fused = fused
+        self.ts = ts if ts is not None else [0] * NUM_TS
+
+    def __repr__(self):
+        return ("Span(kind=%d, rank=%d, name=%r, op=%r, id=%d, ts=%r)"
+                % (self.kind, self.rank, self.name, self.op, self.span_id,
+                   self.ts))
+
+
+def buffer_capacity() -> int:
+    try:
+        cap = int(os.environ.get("HOROVOD_TRACE_BUFFER", DEFAULT_BUFFER))
+    except ValueError:
+        cap = DEFAULT_BUFFER
+    return max(1, cap)
+
+
+class SpanRecorder:
+    """Per-process recorder: open spans by (rank, name), ring of completed.
+
+    Thread-safe; every controller/engine thread funnels through the one
+    process-wide instance installed by :mod:`horovod_tpu.tracing`.
+    """
+
+    def __init__(self, capacity=None):
+        self._cap = capacity if capacity is not None else buffer_capacity()
+        self._open = {}          # (rank, name) -> Span, in-flight collectives
+        self._done = deque()     # completed spans, ring-bounded by _cap
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._dropped_cb = None  # lazily bound metrics counter
+
+    # -- internals ---------------------------------------------------------
+
+    def _alloc_id(self, rank):
+        # Globally unique across ranks: rank in the high bits, a local
+        # counter below. Rank 0's handshake distributes the trace id, so
+        # span ids only need per-trace uniqueness.
+        self._next_id += 1
+        return ((rank + 1) << 40) | self._next_id
+
+    def _push(self, span):
+        if len(self._done) >= self._cap:
+            self._done.popleft()
+            self._count_drop()
+        self._done.append(span)
+
+    def _count_drop(self):
+        if self._dropped_cb is None:
+            from ..metrics import instruments
+            self._dropped_cb = instruments.trace_dropped_events()
+        self._dropped_cb.inc()
+
+    # -- collective lifecycle ---------------------------------------------
+
+    def begin_collective(self, rank, name, op, nbytes, t):
+        global _allocations
+        with self._lock:
+            _allocations += 1
+            span = Span(K_COLLECTIVE, rank, name, op=op,
+                        span_id=self._alloc_id(rank), nbytes=nbytes)
+            span.ts[T_ENQ] = t
+            # A duplicate in-flight name means the previous span never
+            # finished (error path); push what we have rather than leak.
+            prev = self._open.pop((rank, name), None)
+            if prev is not None:
+                self._push(prev)
+            self._open[(rank, name)] = span
+
+    def mark(self, rank, name, slot, t):
+        with self._lock:
+            span = self._open.get((rank, name))
+            if span is not None and span.ts[slot] == 0:
+                span.ts[slot] = t
+
+    def set_fused(self, rank, name, fused):
+        with self._lock:
+            span = self._open.get((rank, name))
+            if span is not None:
+                span.fused = fused
+
+    def finish(self, rank, name, t):
+        with self._lock:
+            span = self._open.pop((rank, name), None)
+            if span is not None:
+                span.ts[T_DONE] = t
+                self._push(span)
+
+    def abort(self, rank, name):
+        with self._lock:
+            self._open.pop((rank, name), None)
+
+    # -- block spans (step / phase / wait) --------------------------------
+
+    def begin_block(self, kind, rank, name, t):
+        global _allocations
+        with self._lock:
+            _allocations += 1
+            span = Span(kind, rank, name, span_id=self._alloc_id(rank))
+            span.ts[0] = t
+            return span
+
+    def end_block(self, span, t):
+        span.ts[1] = t
+        with self._lock:
+            self._push(span)
+
+    def add_wait(self, rank, t0, t1):
+        global _allocations
+        with self._lock:
+            _allocations += 1
+            span = Span(K_WAIT, rank, "WAIT", span_id=self._alloc_id(rank))
+            span.ts[0] = t0
+            span.ts[1] = t1
+            self._push(span)
+
+    def add_mark(self, rank, name, t):
+        global _allocations
+        with self._lock:
+            _allocations += 1
+            span = Span(K_MARK, rank, name, span_id=self._alloc_id(rank))
+            span.ts[0] = t
+            self._push(span)
+
+    # -- draining ---------------------------------------------------------
+
+    def drain(self):
+        """Pop all completed spans (in-flight ones stay open)."""
+        with self._lock:
+            out = list(self._done)
+            self._done.clear()
+        return out
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._done)
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open)

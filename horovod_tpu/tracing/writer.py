@@ -1,0 +1,176 @@
+# Copyright 2018 Uber Technologies, Inc. All Rights Reserved.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+# ==============================================================================
+"""Chrome-trace output: the streaming event writer and the merged-trace
+serializer.
+
+``ChromeTraceWriter`` is the queue-fed writer thread previously embedded
+in :class:`horovod_tpu.utils.timeline.Timeline`; the timeline now holds
+one of these as a thin adapter. ``write_merged`` turns a batch of
+cross-rank spans into one strictly-valid Chrome/Perfetto JSON object —
+valid by construction because it is a single ``json.dump``.
+"""
+
+import json
+import queue
+import threading
+
+from . import spans as S
+
+# Event names shared with the analyzer.
+EV_NEGOTIATE = "NEGOTIATE"
+EV_WIRE = "WIRE"
+EV_DEQUEUE = "DEQUEUE"
+EV_WAIT = "WAIT"
+EV_STEP = "STEP"
+
+
+class ChromeTraceWriter:
+    """Streaming Chrome-trace array writer fed through a queue.
+
+    Keeps the file one valid JSON array at all times once :meth:`close`
+    appends ``]`` (comma before every event after the first); batches the
+    flush to queue-empty boundaries to keep the hot path off the disk.
+    """
+
+    def __init__(self, path):
+        self._q = queue.Queue()
+        self._wrote_event = False
+        self._f = open(path, "w")
+        self._f.write("[\n")
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd_tpu_trace_writer", daemon=True)
+        self._thread.start()
+
+    def emit(self, ev: dict) -> None:
+        self._q.put(ev)
+
+    def _loop(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            while True:
+                if self._wrote_event:
+                    self._f.write(",\n")
+                self._f.write(json.dumps(ev))
+                self._wrote_event = True
+                try:
+                    ev = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if ev is None:
+                    self._f.flush()
+                    return
+            self._f.flush()
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=2)
+        # the writer never leaves a trailing comma, so closing the array
+        # yields strictly valid Chrome-trace JSON ("[]" when no events fired)
+        self._f.write("\n]\n")
+        self._f.close()
+
+
+def _tid_allocator():
+    tids = {}
+
+    def tid_for(name):
+        t = tids.get(name)
+        if t is None:
+            t = len(tids) + 1
+            tids[name] = t
+        return t
+
+    return tids, tid_for
+
+
+def spans_to_events(span_list, trace_id=0):
+    """Expand spans into Chrome-trace events: pid = rank, tid per tensor.
+
+    Collective spans become up to three complete ("X") events — NEGOTIATE,
+    WIRE, DEQUEUE — sharing the span id; block spans one "X" each; marks
+    become instant events. Slots never filled (error paths) are skipped,
+    so partial lifecycles still render.
+    """
+    events = []
+    ranks = set()
+    tid_maps = {}  # rank -> (dict, fn)
+
+    def tid_for(rank, name):
+        if rank not in tid_maps:
+            tid_maps[rank] = _tid_allocator()
+        return tid_maps[rank][1](name)
+
+    hex_trace = "0x%x" % trace_id
+
+    for sp in span_list:
+        ranks.add(sp.rank)
+        if sp.kind == S.K_COLLECTIVE:
+            tid = tid_for(sp.rank, sp.name)
+            args = {"tensor": sp.name, "op": sp.op, "nbytes": sp.nbytes,
+                    "fused": sp.fused, "span_id": "0x%x" % sp.span_id,
+                    "trace_id": hex_trace}
+            phases = ((EV_NEGOTIATE, S.T_ENQ, S.T_NEG),
+                      (EV_WIRE, S.T_WIRE_START, S.T_WIRE_END),
+                      (EV_DEQUEUE, S.T_WIRE_END, S.T_DONE))
+            for pname, b, e in phases:
+                t0, t1 = sp.ts[b], sp.ts[e]
+                if t0 <= 0 or t1 < t0:
+                    continue
+                events.append({"name": pname, "ph": "X", "pid": sp.rank,
+                               "tid": tid, "ts": t0, "dur": t1 - t0,
+                               "args": args})
+        elif sp.kind in (S.K_STEP, S.K_PHASE, S.K_WAIT):
+            t0, t1 = sp.ts[0], sp.ts[1]
+            if t0 <= 0 or t1 < t0:
+                continue
+            events.append({"name": sp.name, "ph": "X", "pid": sp.rank,
+                           "tid": 0, "ts": t0, "dur": t1 - t0,
+                           "args": {"span_id": "0x%x" % sp.span_id}})
+        elif sp.kind == S.K_MARK:
+            events.append({"name": sp.name, "ph": "i", "pid": sp.rank,
+                           "tid": 0, "ts": sp.ts[0], "s": "g"})
+
+    # Metadata: name every rank's process and tensor thread so Perfetto
+    # labels the rows.
+    meta = []
+    for rank in sorted(ranks):
+        meta.append({"name": "process_name", "ph": "M", "pid": rank,
+                     "args": {"name": "rank %d" % rank}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": rank, "tid": 0,
+                     "args": {"name": "step"}})
+        if rank in tid_maps:
+            for tname, tid in tid_maps[rank][0].items():
+                meta.append({"name": "thread_name", "ph": "M", "pid": rank,
+                             "tid": tid, "args": {"name": tname}})
+    return meta + events
+
+
+def write_merged(path, span_list, trace_id=0, world_size=None):
+    """Write one merged Chrome-trace JSON object for all ranks' spans."""
+    doc = {
+        "traceEvents": spans_to_events(span_list, trace_id=trace_id),
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "trace_id": "0x%x" % trace_id,
+            "producer": "horovod_tpu.tracing",
+        },
+    }
+    if world_size is not None:
+        doc["metadata"]["world_size"] = world_size
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

@@ -8,16 +8,25 @@ dedicated writer thread fed through a queue (`timeline.h:47-75`). Enabled via
 (`operations.cc:400`). Device-side detail comes from ``jax.profiler`` traces —
 see :func:`trace_device` — replacing the CUDA-event replay of
 `cuda_operations.cc:77-93`.
+
+The Timeline is now a thin adapter over the tracing subsystem's primitives:
+the queue-fed writer thread lives in
+:class:`horovod_tpu.tracing.writer.ChromeTraceWriter`, and all timestamps
+come from :func:`horovod_tpu.tracing.clock.trace_us` — one monotonic
+(``time.perf_counter_ns``-anchored) clock for every begin/end pair, so a
+span's end can never precede its begin even if the system wall clock steps
+between the two (the old ``time.time()`` stamps could go backward under NTP
+slew). Cross-rank span correlation lives in :mod:`horovod_tpu.tracing`
+(docs/tracing.md); this file keeps the per-rank activity surface.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import queue
-import threading
-import time
 from typing import Optional
+
+from ..tracing import clock as _clock
+from ..tracing.writer import ChromeTraceWriter
 
 
 class Timeline:
@@ -28,17 +37,11 @@ class Timeline:
         self._enabled = bool(path)
         self._mark_cycles = os.environ.get(
             "HOROVOD_TIMELINE_MARK_CYCLES", "") in ("1", "true", "True")
-        self._q: "queue.Queue" = queue.Queue()
         self._tid = {}
         self._next_tid = 1
-        self._writer = None
-        self._wrote_event = False
+        self._writer: Optional[ChromeTraceWriter] = None
         if self._enabled:
-            self._f = open(path, "w")
-            self._f.write("[\n")
-            self._writer = threading.Thread(
-                target=self._writer_loop, name="hvd_tpu_timeline", daemon=True)
-            self._writer.start()
+            self._writer = ChromeTraceWriter(path)
 
     @property
     def enabled(self) -> bool:
@@ -46,32 +49,12 @@ class Timeline:
 
     def _emit(self, ev: dict) -> None:
         if self._enabled:
-            self._q.put(ev)
-
-    def _writer_loop(self) -> None:
-        # Comma BEFORE every event after the first keeps the file one valid
-        # JSON array at all times once close() appends "]"; batching the
-        # flush to queue-empty boundaries keeps the hot path off the disk.
-        while True:
-            ev = self._q.get()
-            if ev is None:
-                return
-            while True:
-                if self._wrote_event:
-                    self._f.write(",\n")
-                self._f.write(json.dumps(ev))
-                self._wrote_event = True
-                try:
-                    ev = self._q.get_nowait()
-                except queue.Empty:
-                    break
-                if ev is None:
-                    self._f.flush()
-                    return
-            self._f.flush()
+            self._writer.emit(ev)
 
     def _ts(self) -> int:
-        return int(time.time() * 1e6)
+        # single monotonic clock for every begin/end pair (shared with the
+        # distributed-tracing spans so both land on one timeline)
+        return _clock.trace_us()
 
     def _tensor_tid(self, name: str) -> int:
         t = self._tid.get(name)
@@ -136,13 +119,7 @@ class Timeline:
     def close(self) -> None:
         if not self._enabled:
             return
-        self._q.put(None)
-        if self._writer is not None:
-            self._writer.join(timeout=2)
-        # the writer never leaves a trailing comma, so closing the array
-        # yields strictly valid Chrome-trace JSON ("[]" when no events fired)
-        self._f.write("\n]\n")
-        self._f.close()
+        self._writer.close()
         self._enabled = False
 
 
