@@ -50,6 +50,19 @@ def parse_args(argv=None):
                         "shutdown; analyze with bin/hvdprof report PATH "
                         "(docs/tracing.md). Adds a per-iteration device "
                         "sync so STEP spans bound real step time")
+    p.add_argument("--history", metavar="PATH", default=None,
+                   help="append this run's result to a schema-versioned "
+                        "JSONL perf history (benchmarks/history.py)")
+    p.add_argument("--check-regression", action="store_true",
+                   help="with --history: compare this run against the "
+                        "recorded trajectory BEFORE appending; exit 3 when "
+                        "it falls below the tolerance floor")
+    p.add_argument("--regression-window", type=int, default=None,
+                   metavar="N", help="trailing records the baseline median "
+                                     "uses (default 5)")
+    p.add_argument("--regression-tolerance", type=float, default=None,
+                   metavar="F", help="fraction below baseline that fails "
+                                     "(default 0.15)")
     return p.parse_args(argv)
 
 
@@ -204,7 +217,7 @@ def main(argv=None):
           f"img={image_size} loss={float(loss):.3f}", file=sys.stderr)
     print(f"# Img/sec total: {mean:.1f} +- {conf:.1f}; per chip: {per_chip:.1f}",
           file=sys.stderr)
-    print(json.dumps({
+    result = {
         "metric": f"{model_name.lower()}_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
@@ -222,7 +235,39 @@ def main(argv=None):
             "103.55 img/s per Pascal GPU, 2017, from the reference's "
             "ResNet-101 run (docs/benchmarks.rst:43) — its only published "
             "throughput figure" if model_name == "ResNet50" else None),
-    }))
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if args.history:
+        from benchmarks.history import (append_record, check_regression,
+                                        load_history)
+
+        # compare against the trajectory BEFORE appending: today's run
+        # must not be allowed to vote in its own baseline
+        if args.check_regression:
+            verdict = check_regression(
+                load_history(args.history, metric=result["metric"]),
+                result["value"],
+                **{k: v for k, v in (
+                    ("window", args.regression_window),
+                    ("tolerance", args.regression_tolerance))
+                   if v is not None})
+            print("# regression check: %s" % json.dumps(verdict),
+                  file=sys.stderr)
+            if verdict["regression"]:
+                print(f"# REGRESSION: {result['metric']} = "
+                      f"{result['value']} fell below the floor "
+                      f"{verdict['floor']} (baseline {verdict['baseline']} "
+                      f"over {verdict['samples']} runs)", file=sys.stderr)
+                rc = 3
+        append_record(args.history, {
+            "metric": result["metric"], "value": result["value"],
+            "unit": result["unit"], "model": model_name,
+            "backend": backend, "devices": n_dev,
+            "batch_per_device": batch_per_device, "image_size": image_size,
+        })
+        print(f"# perf history appended to {args.history}", file=sys.stderr)
 
     if args.metrics_dump:
         with open(args.metrics_dump, "w") as f:
@@ -235,7 +280,8 @@ def main(argv=None):
         hvd.shutdown()
         print(f"# trace written; analyze with: bin/hvdprof report "
               f"{args.trace}", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

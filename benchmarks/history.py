@@ -1,0 +1,107 @@
+"""Schema-versioned perf-history store for bench.py (JSONL).
+
+Each benchmark run appends one JSON line — the metric, its value, and
+enough run context (model, backend, device count, batch) to explain a
+shift later. ``check_regression`` compares a fresh value against the
+recorded trajectory of the same metric: the baseline is the median of
+the last ``window`` comparable records, and the run regresses when it
+falls more than ``tolerance`` below that baseline (throughput metrics:
+bigger is better).
+
+The file is append-only and line-oriented so concurrent CI runs cannot
+corrupt each other and a truncated final line (killed run) only costs
+that one record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+SCHEMA_VERSION = 1
+
+#: default number of trailing records the baseline is computed from
+DEFAULT_WINDOW = 5
+#: default fraction below baseline that counts as a regression
+DEFAULT_TOLERANCE = 0.15
+
+
+def append_record(path: str, record: dict) -> dict:
+    """Stamp schema/time onto ``record`` and append it as one JSONL line.
+    Returns the stamped record."""
+    rec = dict(record)
+    rec["schema"] = SCHEMA_VERSION
+    rec.setdefault("timestamp", time.time())
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return rec
+
+
+def load_history(path: str, metric: Optional[str] = None) -> List[dict]:
+    """Records in file order; unreadable lines and unknown future schemas
+    are skipped, not fatal. ``metric`` filters to one trajectory."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # truncated tail from a killed run
+        if not isinstance(rec, dict):
+            continue
+        if int(rec.get("schema", 0)) > SCHEMA_VERSION:
+            continue  # written by a newer tool; fields may not line up
+        if metric is not None and rec.get("metric") != metric:
+            continue
+        out.append(rec)
+    return out
+
+
+def _median(values: List[float]) -> float:
+    ordered = sorted(values)
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return ordered[mid]
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def check_regression(history: List[dict], value: float,
+                     window: int = DEFAULT_WINDOW,
+                     tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Verdict dict for one fresh measurement against its trajectory.
+
+    ``regression`` is True when ``value`` falls more than ``tolerance``
+    below the median of the last ``window`` recorded values. With no
+    usable history the verdict is ``no_baseline`` (never a failure — the
+    first CI run must pass so it can seed the history)."""
+    values = [float(r["value"]) for r in history[-int(window):]
+              if isinstance(r.get("value"), (int, float))]
+    if not values:
+        return {"regression": False, "reason": "no_baseline", "value": value,
+                "baseline": None, "window": int(window),
+                "tolerance": tolerance, "samples": 0}
+    baseline = _median(values)
+    floor = baseline * (1.0 - tolerance)
+    regressed = bool(baseline > 0 and value < floor)
+    return {
+        "regression": regressed,
+        "reason": ("below_tolerance" if regressed else "ok"),
+        "value": value,
+        "baseline": round(baseline, 4),
+        "floor": round(floor, 4),
+        "window": int(window),
+        "tolerance": tolerance,
+        "samples": len(values),
+    }

@@ -319,6 +319,71 @@ def check_trace_capture() -> None:
           f"({wire} wire spans) from ranks {ranks}; hvdprof parses it")
 
 
+def check_blackbox_doctor() -> None:
+    """Postmortem smoke (docs/observability.md): a real 2-process job with
+    rank 1 wedged at its first collective (``hang@collective``) under an
+    enforced 3 s HOROVOD_COLLECTIVE_TIMEOUT must die leaving a blackbox
+    dump from BOTH ranks, and ``bin/hvddoctor`` on the bundle must name
+    the collective deadlock, the stalled tensor, and the missing rank."""
+    import tempfile
+
+    bbdir = tempfile.mkdtemp(prefix="hvd_blackbox_smoke_")
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from horovod_tpu.run.api import run\n"
+        "def fn():\n"
+        "    import numpy as np\n"
+        "    import horovod_tpu as hvd\n"
+        "    hvd.init()\n"
+        "    hvd.allreduce(np.ones((8,), np.float32), name='bb_probe',"
+        " op=hvd.Sum)\n"
+        "    hvd.shutdown()\n"
+        "    return True\n"
+        "env = {\n"
+        "    'JAX_PLATFORMS': 'cpu',\n"
+        "    'PALLAS_AXON_POOL_IPS': '',\n"
+        # wedge rank 1 for 30s at its 1st enqueued collective; the 3s
+        # watchdog fails rank 0 long before, and the launcher's
+        # first-failure SIGTERM triggers rank 1's signal-path dump
+        "    'HOROVOD_FAULT_SPEC': 'hang@collective:30:1#1',\n"
+        "    'HOROVOD_COLLECTIVE_TIMEOUT': '3',\n"
+        "    'HOROVOD_BLACKBOX': '1',\n"
+        f"    'HOROVOD_BLACKBOX_DIR': {bbdir!r},\n"
+        f"    'PYTHONPATH': {REPO!r},\n"
+        "}\n"
+        "try:\n"
+        "    run(fn, np=2, env=env, start_timeout=120)\n"
+        "except RuntimeError as exc:\n"
+        "    print('===DIED===', str(exc).splitlines()[-1])\n"
+        "else:\n"
+        "    raise SystemExit('job survived a wedged rank + 3s watchdog')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"blackbox smoke job failed:\n{r.stderr[-2000:]}\n{r.stdout[-2000:]}")
+    assert "===DIED===" in r.stdout, (
+        f"wedged job did not die as expected:\n{r.stdout[-2000:]}")
+    for rank in (0, 1):
+        path = os.path.join(bbdir, f"rank_{rank}.json")
+        assert os.path.exists(path), (
+            f"no blackbox dump from rank {rank}; dir has "
+            f"{sorted(os.listdir(bbdir))}")
+    hvddoctor = os.path.join(REPO, "bin", "hvddoctor")
+    d = subprocess.run([sys.executable, hvddoctor, bbdir],
+                       capture_output=True, text=True, timeout=60)
+    assert d.returncode == 0, (
+        f"hvddoctor rejected the bundle:\n{d.stderr[-2000:]}")
+    out = d.stdout
+    assert "collective deadlock" in out, f"no deadlock diagnosis:\n{out}"
+    assert "bb_probe" in out, f"diagnosis does not name the tensor:\n{out}"
+    assert "[1]" in out, f"diagnosis does not name the missing rank:\n{out}"
+    print("ok: blackbox smoke — both ranks dumped; hvddoctor named the "
+          "deadlock, tensor 'bb_probe', missing rank [1]")
+
+
 def main():
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
@@ -328,8 +393,10 @@ def main():
     check_chaos_reconnect()
     check_nan_skip()
     check_trace_capture()
+    check_blackbox_doctor()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
-          "+ chaos reconnect + nan skip-step + trace capture valid")
+          "+ chaos reconnect + nan skip-step + trace capture "
+          "+ blackbox doctor valid")
 
 
 if __name__ == "__main__":

@@ -37,6 +37,9 @@ python -m pytest tests/test_integrity.py tests/test_stall.py -q
 stage "tracing: clock, spans, merge, hvdprof critical-path report"
 python -m pytest tests/test_tracing.py -q
 
+stage "doctor: blackbox flight recorder, signatures, hvddoctor, anomaly watch"
+python -m pytest tests/test_blackbox.py -q
+
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # includes tests/test_spark_real.py (real-pyspark scenarios; they skip
 # when pyspark is absent from the image)

@@ -255,6 +255,11 @@ def init(
             from .metrics import maybe_start_server
 
             maybe_start_server()
+            # live anomaly watch over the aggregated hvd_* registry when
+            # HOROVOD_ANOMALY_WATCH is set (docs/observability.md)
+            from .blackbox import watch as _watch
+
+            _watch.maybe_start_watch()
 
 
 _shutdown_hooks = []
@@ -296,6 +301,13 @@ def shutdown() -> None:
         if out:
             logger.info("merged trace written to %s (hvdprof report %s)",
                         out, out)
+        # the black box only speaks on abnormal exit: a clean shutdown
+        # just stops the watch and resets the recorder state
+        from . import blackbox
+        from .blackbox import watch as _watch
+
+        _watch.stop_watch()
+        blackbox.finalize()
     for fn in _shutdown_hooks:
         try:
             fn()

@@ -1,0 +1,375 @@
+"""Per-rank black-box flight recorder (``HOROVOD_BLACKBOX``).
+
+A bounded in-memory ring of recent structured events — control frames,
+collective lifecycle transitions, integrity verdicts, heartbeat state,
+metric deltas, elastic epoch changes — recorded on every rank at
+near-zero cost. On abnormal exit (enforced collective timeout,
+``NonFiniteError``/``ParameterDesyncError``, ``ShutdownError``, an
+unhandled exception, SIGTERM/SIGABRT, or a coordinator-declared dead
+worker) every reachable rank dumps its ring plus a final metrics
+snapshot and open-span table to ``HOROVOD_BLACKBOX_DIR/rank_N.json``;
+rank 0 assembles the per-rank dumps — writing coordinator-knowledge
+stubs for ranks that died silently — into one postmortem bundle that
+``bin/hvddoctor`` diagnoses.
+
+The whole subsystem is a no-op unless ``HOROVOD_BLACKBOX`` is set:
+``active()`` returns ``None`` and every instrumentation site is a single
+attribute read, allocating nothing (same discipline as tracing, asserted
+the same way via :func:`allocation_count`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal as _signal
+import socket as _socket
+import sys
+import threading
+import time
+
+from .recorder import (  # noqa: F401  (re-exported for callers)
+    K_ANOMALY, K_COLLECTIVE, K_EPOCH, K_ERROR, K_FAULT, K_FRAME_RX,
+    K_FRAME_TX, K_HEARTBEAT, K_METRICS, K_RANK_LOST, K_RECONNECT, K_SIGNAL,
+    K_STALL, K_TIMEOUT, K_VERDICT,
+    Event, FlightRecorder, allocation_count, ring_capacity,
+)
+
+logger = logging.getLogger("horovod_tpu")
+
+BLACKBOX_VERSION = 1
+DEFAULT_DIR = "hvd_blackbox"
+
+_lock = threading.Lock()
+_recorder = None            # FlightRecorder when HOROVOD_BLACKBOX is set
+_dir = None                 # dump directory (resolved at activation)
+_rank = 0                   # this process's rank (set_identity)
+_world = 1
+_dumped = False             # one dump per abnormal exit, not one per symptom
+_shipper = None             # callable(doc_json) shipping a dump to rank 0
+_dead = {}                  # rank -> (wall time, reason): coordinator view
+_hooks_installed = False
+_prev_excepthook = None
+_prev_handlers = {}         # signum -> previous handler
+
+
+def _enabled_env() -> bool:
+    raw = os.environ.get("HOROVOD_BLACKBOX", "").strip()
+    return raw not in ("", "0", "false", "False", "off")
+
+
+def blackbox_dir() -> str:
+    return _dir if _dir else (
+        os.environ.get("HOROVOD_BLACKBOX_DIR", "").strip() or DEFAULT_DIR)
+
+
+def active():
+    """The process recorder, or None when the blackbox is off (fast path)."""
+    return _recorder
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def maybe_activate():
+    """Install the recorder iff ``HOROVOD_BLACKBOX`` is set. Idempotent."""
+    global _recorder, _dir
+    if not _enabled_env():
+        return None
+    with _lock:
+        if _recorder is None:
+            _dir = (os.environ.get("HOROVOD_BLACKBOX_DIR", "").strip()
+                    or DEFAULT_DIR)
+            _recorder = FlightRecorder()
+            _install_hooks()
+        return _recorder
+
+
+def set_identity(rank: int, world_size: int) -> None:
+    """Learned at init: names this process's dump file and stamps events
+    recorded without an explicit rank."""
+    global _rank, _world
+    _rank = int(rank)
+    _world = int(world_size)
+
+
+def set_shipper(fn) -> None:
+    """How a worker's dump reaches rank 0 (a ``push_blackbox`` bound to
+    the coordinated controller); None on rank 0 / uncoordinated modes."""
+    global _shipper
+    _shipper = fn
+
+
+def record(kind, name="", detail="", rank=None, t=None) -> None:
+    """Record one event if the blackbox is on; no-op (one global read +
+    one compare) otherwise. Non-hot-path convenience — tight loops should
+    hold ``active()`` themselves, exactly like tracing sites do."""
+    rec = _recorder
+    if rec is None:
+        return
+    rec.record(kind, name, detail, _rank if rank is None else rank, t)
+
+
+def note_dead_rank(rank: int, reason: str) -> None:
+    """Coordinator side: remember a declared-dead worker so rank 0's dump
+    can write a stub for it (its own dump will never arrive)."""
+    rec = _recorder
+    if rec is None:
+        return
+    rank = int(rank)
+    _dead[rank] = (time.time(), reason)
+    rec.record(K_RANK_LOST, "rank_%d" % rank, reason, rank)
+
+
+# ------------------------------------------------------------------- dumps
+
+def _open_span_table():
+    """The tracing recorder's in-flight collectives — what each rank was
+    still waiting on when it died."""
+    from .. import tracing
+    tr = tracing.active()
+    if tr is None:
+        return []
+    try:
+        return [{"rank": r, "name": n, "ts": ts}
+                for r, n, ts in tr.open_spans()]
+    except Exception:
+        return []
+
+
+def _build_doc(reason: str) -> dict:
+    rec = _recorder
+    doc = {
+        "blackbox": BLACKBOX_VERSION,
+        "rank": _rank,
+        "world_size": _world,
+        "reason": reason,
+        "hostname": _socket.gethostname(),
+        "pid": os.getpid(),
+        "dumped_at": time.time(),
+        "events": rec.event_dicts() if rec is not None else [],
+        "dropped_events": rec.dropped if rec is not None else 0,
+    }
+    try:
+        from ..metrics import local_snapshot
+        doc["metrics"] = local_snapshot()
+    except Exception:
+        doc["metrics"] = {}
+    doc["open_spans"] = _open_span_table()
+    if _rank == 0 and _dead:
+        doc["coordinator"] = {
+            "dead_ranks": {str(r): {"at": t, "reason": why}
+                           for r, (t, why) in sorted(_dead.items())}}
+    return doc
+
+
+def _write_doc(path: str, doc: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def dump(reason: str, force: bool = False):
+    """Write this rank's postmortem dump. Idempotent per process (the
+    first abnormal symptom wins; later ones are usually cascade). Never
+    raises — this runs from excepthooks and signal handlers. Returns the
+    written path, or None when the blackbox is off or already dumped."""
+    global _dumped
+    rec = _recorder
+    if rec is None:
+        return None
+    with _lock:
+        if _dumped and not force:
+            return None
+        _dumped = True
+    try:
+        doc = _build_doc(reason)
+        path = os.path.join(blackbox_dir(), "rank_%d.json" % _rank)
+        _write_doc(path, doc)
+        try:
+            from ..metrics import instruments
+            instruments.blackbox_dumps().inc()
+        except Exception:
+            pass
+        logger.warning("blackbox: rank %d dumped %d events to %s (%s)",
+                       _rank, len(doc["events"]), path, reason)
+        shipper = _shipper
+        if shipper is not None and _rank != 0:
+            try:
+                shipper(json.dumps(doc))
+            except Exception:
+                pass
+        if _rank == 0:
+            _write_dead_stubs(reason)
+            assemble(blackbox_dir(), reason=reason)
+        return path
+    except Exception as exc:  # must never take down the dying process
+        logger.error("blackbox: dump failed: %s", exc)
+        return None
+
+
+def _write_dead_stubs(reason: str) -> None:
+    """Rank 0 speaks for ranks that died without dumping: a stub carrying
+    the coordinator's knowledge (declared-dead reason and when)."""
+    for rank, (t, why) in sorted(_dead.items()):
+        path = os.path.join(blackbox_dir(), "rank_%d.json" % rank)
+        if os.path.exists(path):
+            continue
+        try:
+            _write_doc(path, {
+                "blackbox": BLACKBOX_VERSION, "rank": rank,
+                "world_size": _world, "stub": True, "assembled_by": _rank,
+                "reason": "no dump received; coordinator declared the rank "
+                          "dead: %s" % why,
+                "declared_dead_at": t, "dumped_at": time.time(),
+                "events": [], "metrics": {}, "open_spans": [],
+            })
+        except Exception:
+            pass
+
+
+def store_dump(rank: int, doc_json: str) -> None:
+    """Rank 0: persist a worker's dump arriving over ``MSG_BLACKBOX``.
+    Re-assembles the bundle if rank 0 already dumped, so late worker
+    dumps still make it into ``bundle.json``."""
+    try:
+        doc = json.loads(doc_json)
+        rank = int(rank)
+        path = os.path.join(blackbox_dir(), "rank_%d.json" % rank)
+        _write_doc(path, doc)
+        record(K_ERROR, "rank_%d" % rank,
+               "received postmortem dump (%s)" % (doc.get("reason") or "?"),
+               rank=rank)
+        if _dumped:
+            assemble(blackbox_dir())
+        logger.warning("blackbox: stored rank %d dump at %s", rank, path)
+    except Exception as exc:
+        logger.debug("blackbox: dropping bad dump from rank %s: %s",
+                     rank, exc)
+
+
+def assemble(dir_path=None, reason=None):
+    """Collect every ``rank_*.json`` in the dump directory into one
+    ``bundle.json`` manifest. Safe to call repeatedly (late dumps) and
+    from the driver for runs whose rank 0 itself died."""
+    dir_path = dir_path or blackbox_dir()
+    ranks = {}
+    try:
+        names = sorted(os.listdir(dir_path))
+    except OSError:
+        return None
+    for name in names:
+        if not (name.startswith("rank_") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(dir_path, name)) as f:
+                doc = json.load(f)
+            ranks[str(int(doc.get("rank", name[5:-5])))] = doc
+        except (OSError, ValueError):
+            continue
+    if not ranks:
+        return None
+    bundle = {"blackbox_bundle": BLACKBOX_VERSION,
+              "assembled_at": time.time(),
+              "reason": reason, "ranks": ranks}
+    path = os.path.join(dir_path, "bundle.json")
+    try:
+        _write_doc(path, bundle)
+    except OSError as exc:
+        logger.error("blackbox: bundle assembly failed: %s", exc)
+        return None
+    return path
+
+
+# ------------------------------------------------- process-level triggers
+
+def _on_unhandled(exc_type, exc, tb):
+    try:
+        record(K_ERROR, exc_type.__name__, str(exc))
+        dump("unhandled exception: %s: %s" % (exc_type.__name__, exc))
+    except Exception:
+        pass
+    if _prev_excepthook is not None:
+        _prev_excepthook(exc_type, exc, tb)
+
+
+def _on_signal(signum, frame):
+    try:
+        name = _signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    try:
+        record(K_SIGNAL, name, "process received %s" % name)
+        dump("signal %s" % name)
+    except Exception:
+        pass
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        # restore default disposition and re-deliver so the exit status
+        # still says "killed by signal"
+        _signal.signal(signum, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def _install_hooks() -> None:
+    """sys.excepthook chain + SIGTERM/SIGABRT handlers. Signal handlers
+    only install from the main thread (signal.signal raises elsewhere —
+    in-process thread clusters simply skip them)."""
+    global _hooks_installed, _prev_excepthook
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    _prev_excepthook = sys.excepthook
+    sys.excepthook = _on_unhandled
+    for signum in (_signal.SIGTERM, getattr(_signal, "SIGABRT", None)):
+        if signum is None:
+            continue
+        try:
+            _prev_handlers[signum] = _signal.signal(signum, _on_signal)
+        except (ValueError, OSError, RuntimeError):
+            pass
+
+
+def _uninstall_hooks() -> None:
+    global _hooks_installed, _prev_excepthook
+    if not _hooks_installed:
+        return
+    _hooks_installed = False
+    if sys.excepthook is _on_unhandled:
+        sys.excepthook = _prev_excepthook or sys.__excepthook__
+    _prev_excepthook = None
+    for signum, prev in list(_prev_handlers.items()):
+        try:
+            if _signal.getsignal(signum) is _on_signal:
+                _signal.signal(signum, prev)
+        except (ValueError, OSError, RuntimeError, TypeError):
+            pass
+    _prev_handlers.clear()
+
+
+# --------------------------------------------------------------- lifecycle
+
+def finalize() -> None:
+    """Normal-shutdown teardown (basics.shutdown): no dump — the black
+    box only speaks on abnormal exit — just reset module state."""
+    global _recorder, _dir, _dumped, _shipper, _rank, _world
+    with _lock:
+        _recorder = None
+        _dir = None
+        _dumped = False
+        _shipper = None
+        _rank = 0
+        _world = 1
+        _dead.clear()
+    _uninstall_hooks()
+
+
+def reset_for_tests() -> None:
+    """Hard reset of all module state (unit tests only)."""
+    finalize()

@@ -1,0 +1,158 @@
+"""``hvddoctor`` — postmortem diagnosis of a blackbox bundle.
+
+Ingests a dump directory (``rank_*.json`` + optional ``bundle.json``), a
+bundle manifest, or a single rank dump, then:
+
+* matches the known failure signatures (:mod:`.signatures`) — collective
+  deadlock with the stalled tensor and missing ranks, parameter-desync
+  origin step, NaN-first rank, dead workers, stragglers, reconnect
+  storms, heartbeat flaps;
+* prints a cross-rank merged timeline of the final seconds;
+* reports the first divergence — the earliest event where one rank's
+  stream stops matching its peers.
+
+Exit codes: 0 diagnosis produced, 1 unreadable/empty bundle, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict
+
+from . import signatures as sigs
+
+
+def load_bundle(path: str) -> Dict[int, dict]:
+    """{rank: dump doc} out of a directory, bundle manifest, or one dump.
+    Raises ValueError when nothing diagnosable is found."""
+    docs: Dict[int, dict] = {}
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.startswith("rank_") and name.endswith(".json"):
+                _ingest(os.path.join(path, name), docs)
+        if not docs:  # a bare bundle.json with its rank files cleaned up
+            manifest = os.path.join(path, "bundle.json")
+            if os.path.exists(manifest):
+                _ingest(manifest, docs)
+    else:
+        _ingest(path, docs)
+    if not docs:
+        raise ValueError("no rank dumps found in %r (expected rank_N.json "
+                         "files or a bundle.json manifest)" % path)
+    return docs
+
+
+def _ingest(path: str, docs: Dict[int, dict]) -> None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise ValueError("unreadable dump %s: %s" % (path, exc))
+    if isinstance(doc, dict) and "ranks" in doc and "blackbox_bundle" in doc:
+        for rank, rdoc in doc["ranks"].items():
+            docs[int(rank)] = rdoc
+    elif isinstance(doc, dict) and "rank" in doc:
+        docs[int(doc["rank"])] = doc
+    else:
+        raise ValueError("%s is not a blackbox dump or bundle" % path)
+
+
+def diagnose(bundle: Dict[int, dict], window_s: float = 30.0,
+             timeline_limit: int = 200) -> dict:
+    world = max([d.get("world_size") or 0 for d in bundle.values()]
+                + [max(bundle) + 1])
+    present = sorted(bundle)
+    return {
+        "ranks": present,
+        "world_size": world,
+        "missing_ranks": [r for r in range(world) if r not in bundle],
+        "stub_ranks": [r for r in present if bundle[r].get("stub")],
+        "reasons": {r: bundle[r].get("reason") or "" for r in present},
+        "signatures": sigs.match_signatures(bundle),
+        "first_divergence": sigs.first_divergence(bundle),
+        "timeline": sigs.merged_timeline(bundle, window_s, timeline_limit),
+    }
+
+
+def format_report(diag: dict, bundle_path: str) -> str:
+    lines = ["hvddoctor: %s" % bundle_path,
+             "  ranks: %s of world %d%s" % (
+                 diag["ranks"], diag["world_size"],
+                 " (MISSING: %s)" % diag["missing_ranks"]
+                 if diag["missing_ranks"] else "")]
+    for r in diag["ranks"]:
+        stub = " [coordinator stub]" if r in diag["stub_ranks"] else ""
+        lines.append("  rank %d%s: %s" % (r, stub, diag["reasons"][r]))
+    lines.append("")
+    if diag["signatures"]:
+        lines.append("DIAGNOSIS")
+        for sig in diag["signatures"]:
+            lines.append("  [%s] %s" % (sig["severity"].upper(),
+                                        sig["summary"]))
+    else:
+        lines.append("DIAGNOSIS\n  no known failure signature matched; "
+                     "inspect the timeline below")
+    div = diag["first_divergence"]
+    if div is not None:
+        lines.append("")
+        lines.append("FIRST DIVERGENCE")
+        lines.append("  %s %r at %s: present on rank(s) %s, absent on "
+                     "rank(s) %s" % (div["kind"], div["name"],
+                                     _fmt_t(div["t"]), div["present_ranks"],
+                                     div["absent_ranks"]))
+    if diag["timeline"]:
+        t_end = diag["timeline"][-1]["t"]
+        lines.append("")
+        lines.append("TIMELINE (final %d events)" % len(diag["timeline"]))
+        for ev in diag["timeline"]:
+            lines.append("  %+9.3fs rank %s %-10s %s %s" % (
+                float(ev["t"]) - float(t_end), ev.get("rank", "?"),
+                ev.get("kind", "?"), ev.get("name", ""),
+                ev.get("detail", "")))
+    return "\n".join(lines)
+
+
+def _fmt_t(t) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(t)))
+    except (ValueError, OverflowError, OSError):
+        return str(t)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvddoctor",
+        description="Diagnose a horovod_tpu blackbox postmortem bundle "
+                    "(HOROVOD_BLACKBOX; see docs/observability.md).")
+    parser.add_argument("bundle",
+                        help="dump directory, bundle.json, or rank_N.json")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the diagnosis as JSON")
+    parser.add_argument("--window", type=float, default=30.0,
+                        help="timeline window before the last event "
+                             "(seconds, default 30)")
+    parser.add_argument("--timeline-limit", type=int, default=200,
+                        help="max merged-timeline events (default 200)")
+    args = parser.parse_args(argv)
+
+    try:
+        bundle = load_bundle(args.bundle)
+    except ValueError as exc:
+        print("invalid bundle: %s" % exc, file=sys.stderr)
+        return 1
+    diag = diagnose(bundle, args.window, args.timeline_limit)
+    try:
+        if args.json:
+            print(json.dumps(diag, indent=1))
+        else:
+            print(format_report(diag, args.bundle))
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # reader (head, less) closed the pipe mid-report: not an error, but
+        # the interpreter would complain again flushing stdout at exit
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0

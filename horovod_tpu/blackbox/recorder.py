@@ -1,0 +1,131 @@
+"""Flight-recorder event records and the bounded per-process event ring.
+
+An event is one row of the black box: a wall-clock timestamp, the rank it
+happened on, a short ``kind`` tag (one of the ``K_*`` constants), a
+``name`` (tensor / peer / signal, kind-dependent) and a free-form
+``detail`` string. Events land in a ring capped by
+``HOROVOD_BLACKBOX_EVENTS``; overflow drops the oldest event — the whole
+point of a flight recorder is the *recent* past, so the ring never grows
+without bound and never blocks the paths it instruments.
+
+The module mirrors the tracing discipline exactly: with
+``HOROVOD_BLACKBOX`` unset nothing here is ever constructed, and the
+``_allocations`` counter lets tests assert the engine's hot path
+allocates zero blackbox objects in that state.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+# Event kinds. Strings, not ints: dumps are JSON for humans and hvddoctor
+# both, and the ring is small enough that tag size is irrelevant.
+K_FRAME_TX = "frame_tx"        # control-plane frame sent
+K_FRAME_RX = "frame_rx"        # control-plane frame received
+K_COLLECTIVE = "collective"    # collective lifecycle transition
+K_STALL = "stall"              # coordinator stall warning for a tensor
+K_TIMEOUT = "timeout"          # enforced collective watchdog fired
+K_VERDICT = "verdict"          # GradGuard / ConsistencyAuditor verdict
+K_HEARTBEAT = "heartbeat"      # heartbeat state change (miss / recovery)
+K_METRICS = "metrics"          # periodic metric-registry delta
+K_EPOCH = "epoch"              # elastic membership epoch change
+K_RANK_LOST = "rank_lost"      # coordinator declared a worker lost/dead
+K_RECONNECT = "reconnect"      # worker control-plane reconnect
+K_FAULT = "fault"              # fault-injection rule fired
+K_ERROR = "error"              # exception / abnormal condition
+K_SIGNAL = "signal"            # process signal received
+K_ANOMALY = "anomaly"          # live anomaly-watch detection
+
+DEFAULT_EVENTS = 4096
+
+# Tracks every event-record allocation so the no-op fast path can be
+# asserted: with the blackbox disabled this must not move.
+_allocations = 0
+
+
+def allocation_count() -> int:
+    return _allocations
+
+
+class Event:
+    __slots__ = ("t", "rank", "kind", "name", "detail")
+
+    def __init__(self, t, rank, kind, name="", detail=""):
+        self.t = t
+        self.rank = rank
+        self.kind = kind
+        self.name = name
+        self.detail = detail
+
+    def as_dict(self) -> dict:
+        return {"t": self.t, "rank": self.rank, "kind": self.kind,
+                "name": self.name, "detail": self.detail}
+
+    def __repr__(self):
+        return ("Event(t=%r, rank=%r, kind=%r, name=%r, detail=%r)"
+                % (self.t, self.rank, self.kind, self.name, self.detail))
+
+
+def ring_capacity() -> int:
+    try:
+        cap = int(os.environ.get("HOROVOD_BLACKBOX_EVENTS", DEFAULT_EVENTS))
+    except ValueError:
+        cap = DEFAULT_EVENTS
+    return max(1, cap)
+
+
+class FlightRecorder:
+    """Per-process bounded ring of recent structured events.
+
+    Thread-safe; every controller/engine/coordinator thread funnels
+    through the one process-wide instance installed by
+    :mod:`horovod_tpu.blackbox`. Recording never raises and never blocks
+    beyond the ring lock — a crashing process must still be able to
+    record its way down.
+    """
+
+    def __init__(self, capacity=None):
+        self._cap = capacity if capacity is not None else ring_capacity()
+        self._ring = deque()
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    def record(self, kind, name="", detail="", rank=0, t=None):
+        global _allocations
+        if t is None:
+            t = time.time()
+        with self._lock:
+            _allocations += 1
+            if len(self._ring) >= self._cap:
+                self._ring.popleft()
+                self._dropped += 1
+            self._ring.append(Event(t, rank, kind, name, detail))
+
+    def events(self):
+        """A stable copy of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def event_dicts(self):
+        return [e.as_dict() for e in self.events()]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0

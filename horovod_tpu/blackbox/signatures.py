@@ -1,0 +1,363 @@
+"""Known-failure signature engine, shared postmortem and live.
+
+Two consumers, one vocabulary:
+
+* ``bin/hvddoctor`` runs the event-based detectors over a postmortem
+  bundle (every rank's flight-recorder dump) and reports which known
+  failure shapes match.
+* The rank-0 anomaly watch (:mod:`.watch`) runs the metric-based
+  :class:`RollingBaseline` live over the aggregated ``hvd_*`` registry
+  and emits the same :func:`make_signature` records when a window
+  deviates.
+
+A signature is a plain dict — ``id``, ``severity``, ``summary`` and an
+``evidence`` mapping — so both paths serialize identically and the
+doctor's JSON output is stable for scripting.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import recorder as rec
+
+SEV_INFO = "info"
+SEV_WARNING = "warning"
+SEV_CRITICAL = "critical"
+
+_SEV_ORDER = {SEV_CRITICAL: 0, SEV_WARNING: 1, SEV_INFO: 2}
+
+#: threshold (seconds) past which the final straggler-skew gauge alone is
+#: considered diagnostic, even without coordinator stall events
+STRAGGLER_SKEW_S = 1.0
+#: reconnects by one rank that constitute a storm
+RECONNECT_STORM_COUNT = 3
+#: ok->miss heartbeat transitions that constitute a flap
+HEARTBEAT_FLAP_TRANSITIONS = 2
+
+
+def make_signature(sig_id: str, severity: str, summary: str,
+                   **evidence) -> dict:
+    return {"id": sig_id, "severity": severity, "summary": summary,
+            "evidence": evidence}
+
+
+def sort_signatures(sigs: List[dict]) -> List[dict]:
+    return sorted(sigs, key=lambda s: (_SEV_ORDER.get(s["severity"], 9),
+                                       s["id"]))
+
+
+# ------------------------------------------------------------------ parsing
+
+def parse_ranks(text: str) -> List[int]:
+    """Rank list out of a coordinator/integrity detail string: matches the
+    ``ranks [1, 2]`` / ``rank(s) ['0']`` phrasings those sites emit."""
+    m = re.search(r"ranks?(?:\(s\))? \[([^\]]*)\]", text)
+    if not m:
+        return []
+    return [int(n) for n in re.findall(r"\d+", m.group(1))]
+
+
+def parse_step(text: str) -> Optional[int]:
+    m = re.search(r"\(step (\d+)\)", text)
+    return int(m.group(1)) if m else None
+
+
+def _iter_events(bundle: Dict[int, dict]):
+    for rank in sorted(bundle):
+        for ev in bundle[rank].get("events") or []:
+            yield rank, ev
+
+
+def _metric_value(doc: dict, name: str) -> float:
+    """Sum of a metric's series values in one dump's final snapshot."""
+    metric = (doc.get("metrics") or {}).get(name)
+    if not metric:
+        return 0.0
+    total = 0.0
+    for series in metric.get("series") or []:
+        total += float(series.get("value", series.get("sum", 0.0)) or 0.0)
+    return total
+
+
+# ---------------------------------------------------------------- detectors
+
+def detect_collective_deadlock(bundle) -> List[dict]:
+    """Enforced-watchdog timeouts, or stall warnings that never resolved:
+    name the tensor and the ranks it was waiting on."""
+    sigs = []
+    seen = set()
+    stalls: Dict[str, dict] = {}
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") == rec.K_TIMEOUT:
+            tensor = ev.get("name") or "?"
+            missing = parse_ranks(ev.get("detail") or "")
+            key = (tensor, tuple(missing))
+            if key in seen:
+                continue
+            seen.add(key)
+            sigs.append(make_signature(
+                "collective_deadlock", SEV_CRITICAL,
+                "collective deadlock: tensor %r timed out waiting on "
+                "rank(s) %s" % (tensor, missing or "?"),
+                tensor=tensor, missing_ranks=missing, reported_by=src,
+                detail=ev.get("detail") or ""))
+        elif ev.get("kind") == rec.K_STALL:
+            tensor = ev.get("name") or "?"
+            stalls[tensor] = {"missing": parse_ranks(ev.get("detail") or ""),
+                              "detail": ev.get("detail") or "", "src": src,
+                              "count": stalls.get(tensor, {}).get(
+                                  "count", 0) + 1}
+    if not sigs:
+        for tensor, info in stalls.items():
+            sigs.append(make_signature(
+                "collective_deadlock", SEV_CRITICAL,
+                "collective deadlock: tensor %r stalled waiting on "
+                "rank(s) %s (never resolved)" % (tensor,
+                                                 info["missing"] or "?"),
+                tensor=tensor, missing_ranks=info["missing"],
+                reported_by=info["src"], stall_warnings=info["count"],
+                detail=info["detail"]))
+    return sigs
+
+
+def detect_straggler(bundle) -> List[dict]:
+    """A single rank repeatedly the one everybody waits on, or a final
+    arrival-skew gauge big enough to explain the slowdown on its own."""
+    waited_on: Dict[int, int] = {}
+    for _, ev in _iter_events(bundle):
+        if ev.get("kind") in (rec.K_STALL, rec.K_TIMEOUT):
+            for r in parse_ranks(ev.get("detail") or ""):
+                waited_on[r] = waited_on.get(r, 0) + 1
+    skew = max((_metric_value(doc, "hvd_straggler_skew_seconds")
+                for doc in bundle.values()), default=0.0)
+    sigs = []
+    repeat = [(n, r) for r, n in waited_on.items() if n >= 2]
+    if repeat:
+        n, r = max(repeat)
+        sigs.append(make_signature(
+            "straggler", SEV_WARNING,
+            "straggler: rank %d was the missing rank in %d stall/timeout "
+            "events (final arrival skew %.3fs)" % (r, n, skew),
+            rank=r, events=n, skew_seconds=skew))
+    elif skew >= STRAGGLER_SKEW_S:
+        sigs.append(make_signature(
+            "straggler", SEV_WARNING,
+            "straggler: final enqueue-time skew %.3fs between fastest and "
+            "slowest rank" % skew, skew_seconds=skew))
+    return sigs
+
+
+def detect_param_desync(bundle) -> List[dict]:
+    """Consistency-auditor divergence: report the earliest origin step."""
+    first = None
+    for src, ev in _iter_events(bundle):
+        if (ev.get("kind") == rec.K_VERDICT
+                and "parameter desync" in (ev.get("detail") or "")):
+            step = parse_step(ev["detail"])
+            if first is None or (step or 0) < (first[0] or 1 << 60):
+                first = (step, src, ev)
+    if first is None:
+        return []
+    step, src, ev = first
+    offenders = parse_ranks(ev.get("detail") or "")
+    return [make_signature(
+        "param_desync", SEV_CRITICAL,
+        "parameter desync first detected at step %s on rank(s) %s"
+        % (step if step is not None else "?", offenders or "?"),
+        origin_step=step, ranks=offenders, reported_by=src,
+        detail=ev.get("detail") or "")]
+
+
+def detect_nan_first(bundle) -> List[dict]:
+    """Non-finite gradients: the earliest event across ranks names the
+    rank where NaN/Inf entered the job."""
+    first = None
+    for src, ev in _iter_events(bundle):
+        if (ev.get("kind") == rec.K_VERDICT
+                and "non-finite" in (ev.get("detail") or "")):
+            if first is None or float(ev.get("t") or 0) < float(
+                    first[1].get("t") or 0):
+                first = (src, ev)
+    if first is None:
+        return []
+    src, ev = first
+    offenders = parse_ranks(ev.get("detail") or "")
+    origin = offenders[0] if offenders else src
+    return [make_signature(
+        "nan_first", SEV_CRITICAL,
+        "non-finite gradients entered first on rank %s (step %s)"
+        % (origin, parse_step(ev.get("detail") or "") or "?"),
+        rank=origin, ranks=offenders, reported_by=src,
+        detail=ev.get("detail") or "")]
+
+
+def detect_reconnect_storm(bundle) -> List[dict]:
+    counts: Dict[int, int] = {}
+    for _, ev in _iter_events(bundle):
+        if ev.get("kind") == rec.K_RECONNECT:
+            r = int(ev.get("rank") or 0)
+            counts[r] = counts.get(r, 0) + 1
+    sigs = []
+    for r, n in sorted(counts.items()):
+        if n >= RECONNECT_STORM_COUNT:
+            sigs.append(make_signature(
+                "reconnect_storm", SEV_WARNING,
+                "reconnect storm: rank %d reconnected its control-plane "
+                "connection %d times" % (r, n), rank=r, reconnects=n))
+    return sigs
+
+
+def detect_heartbeat_flap(bundle) -> List[dict]:
+    """A rank repeatedly missing heartbeats and recovering — a flapping
+    network or an overloaded host, not a clean death."""
+    streams: Dict[int, List[str]] = {}
+    for _, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_HEARTBEAT:
+            continue
+        subject = int(ev.get("rank") or 0)
+        state = "miss" if "miss" in (ev.get("detail") or "") else "ok"
+        streams.setdefault(subject, []).append(state)
+    sigs = []
+    for r, states in sorted(streams.items()):
+        transitions = sum(1 for a, b in zip(states, states[1:])
+                          if a == "ok" and b == "miss")
+        if states and states[0] == "miss":
+            transitions += 1
+        if transitions >= HEARTBEAT_FLAP_TRANSITIONS:
+            sigs.append(make_signature(
+                "heartbeat_flap", SEV_WARNING,
+                "heartbeat flap: rank %d went silent %d separate times"
+                % (r, transitions), rank=r, flaps=transitions))
+    return sigs
+
+
+def detect_dead_worker(bundle) -> List[dict]:
+    sigs = []
+    seen = set()
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") == rec.K_RANK_LOST:
+            r = int(ev.get("rank") or 0)
+            if r in seen:
+                continue
+            seen.add(r)
+            sigs.append(make_signature(
+                "dead_worker", SEV_CRITICAL,
+                "worker lost: rank %d (%s)" % (r, ev.get("detail") or
+                                               "no reason recorded"),
+                rank=r, reason=ev.get("detail") or "", reported_by=src))
+    return sigs
+
+
+#: every event-based detector the doctor runs, in reporting order
+DETECTORS = (
+    detect_collective_deadlock,
+    detect_param_desync,
+    detect_nan_first,
+    detect_dead_worker,
+    detect_straggler,
+    detect_reconnect_storm,
+    detect_heartbeat_flap,
+)
+
+
+def match_signatures(bundle: Dict[int, dict]) -> List[dict]:
+    sigs: List[dict] = []
+    for detect in DETECTORS:
+        sigs.extend(detect(bundle))
+    return sort_signatures(sigs)
+
+
+# ----------------------------------------------------- cross-rank analysis
+
+#: kinds every rank emits — the only sound basis for divergence analysis
+#: (coordinator-side kinds exist on rank 0 alone by construction)
+_DIVERGENCE_KINDS = (rec.K_COLLECTIVE, rec.K_VERDICT)
+
+
+def first_divergence(bundle: Dict[int, dict]) -> Optional[dict]:
+    """Earliest (kind, name) that appears in some ranks' streams but not
+    all of them — where one rank's recent history stops matching its
+    peers (e.g. the tensor a hung rank never enqueued)."""
+    ranks = sorted(bundle)
+    if len(ranks) < 2:
+        return None
+    keysets = {}
+    first_seen = {}
+    for r in ranks:
+        keys = set()
+        for ev in bundle[r].get("events") or []:
+            if ev.get("kind") not in _DIVERGENCE_KINDS:
+                continue
+            key = (ev["kind"], ev.get("name") or "")
+            keys.add(key)
+            t = float(ev.get("t") or 0)
+            if key not in first_seen or t < first_seen[key]:
+                first_seen[key] = t
+        keysets[r] = keys
+    divergent = []
+    for key, t in first_seen.items():
+        present = [r for r in ranks if key in keysets[r]]
+        if len(present) != len(ranks):
+            divergent.append((t, key, present))
+    if not divergent:
+        return None
+    t, (kind, name), present = min(divergent)
+    return {"t": t, "kind": kind, "name": name, "present_ranks": present,
+            "absent_ranks": [r for r in ranks if r not in present]}
+
+
+def merged_timeline(bundle: Dict[int, dict], window_s: float = 30.0,
+                    limit: int = 200) -> List[dict]:
+    """All ranks' events interleaved by wall time, clipped to the final
+    ``window_s`` seconds before the last recorded event."""
+    events = []
+    for src, ev in _iter_events(bundle):
+        d = dict(ev)
+        d.setdefault("rank", src)
+        events.append(d)
+    if not events:
+        return []
+    events.sort(key=lambda e: float(e.get("t") or 0))
+    t_end = float(events[-1].get("t") or 0)
+    clipped = [e for e in events if float(e.get("t") or 0) >= t_end - window_s]
+    return clipped[-limit:]
+
+
+# ------------------------------------------------------------- live metrics
+
+class RollingBaseline:
+    """Rolling-median baseline for one live metric signal.
+
+    ``observe(value)`` returns True when the window holds enough history
+    and the new value exceeds ``factor`` times the baseline median (with
+    a per-signal noise floor so idle jobs never alarm)."""
+
+    def __init__(self, window: int = 12, factor: float = 3.0,
+                 min_samples: int = 4, floor: float = 1e-3):
+        self.window = max(2, int(window))
+        self.factor = float(factor)
+        self.min_samples = max(2, int(min_samples))
+        self.floor = float(floor)
+        self._values = deque(maxlen=self.window)
+
+    def baseline(self) -> Optional[float]:
+        if len(self._values) < self.min_samples:
+            return None
+        ordered = sorted(self._values)
+        mid = len(ordered) // 2
+        if len(ordered) % 2:
+            return ordered[mid]
+        return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+    def observe(self, value: float) -> bool:
+        base = self.baseline()
+        anomalous = (base is not None
+                     and value > self.factor * max(base, self.floor))
+        self._values.append(float(value))
+        return anomalous
+
+    def __len__(self):
+        return len(self._values)

@@ -1,0 +1,217 @@
+"""Live anomaly watch (``HOROVOD_ANOMALY_WATCH``).
+
+A daemon thread on the aggregating process (rank 0) sampling the
+already-merged ``hvd_*`` registry on a fixed cadence and holding a
+:class:`~.signatures.RollingBaseline` per tracked signal:
+
+* ``step_seconds`` — mean allreduce latency over the sample interval
+* ``exposed_comm_seconds`` — blocked-in-synchronize time per interval
+* ``straggler_skew_seconds`` — the arrival-skew gauge as-is
+* ``wire_bytes_rate`` — collective payload bytes/second on the wire
+
+When a window deviates past the configured factor the watch raises the
+``hvd_anomaly_active{signal=...}`` gauge, logs a structured warning, and
+records a flight-recorder event — the hook the autotuner and quantization
+gating consume, and extra forensics if the job later dies. Knobs:
+``HOROVOD_ANOMALY_INTERVAL`` (seconds, default 5), ``HOROVOD_ANOMALY_WINDOW``
+(samples, default 12), ``HOROVOD_ANOMALY_FACTOR`` (default 3.0).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from ..utils.env import env_float as _env_float
+from . import K_ANOMALY, record as _record
+from .signatures import RollingBaseline, SEV_WARNING, make_signature
+
+logger = logging.getLogger("horovod_tpu")
+
+#: (signal name, noise floor) — floors keep idle jobs from alarming
+SIGNALS = (
+    ("step_seconds", 1e-3),
+    ("exposed_comm_seconds", 1e-3),
+    ("straggler_skew_seconds", 0.05),
+    ("wire_bytes_rate", 1024.0),
+)
+
+_watch = None
+_watch_lock = threading.Lock()
+
+
+def _series_total(snapshot, name, field="value"):
+    metric = snapshot.get(name)
+    if not metric:
+        return 0.0
+    total = 0.0
+    for series in metric.get("series") or []:
+        total += float(series.get(field, 0.0) or 0.0)
+    return total
+
+
+def _hist_totals(snapshot, name):
+    metric = snapshot.get(name)
+    if not metric:
+        return 0.0, 0.0
+    s = c = 0.0
+    for series in metric.get("series") or []:
+        s += float(series.get("sum", 0.0) or 0.0)
+        c += float(series.get("count", 0.0) or 0.0)
+    return s, c
+
+
+class AnomalyWatch:
+    """Rolling-baseline watcher over aggregated snapshots.
+
+    ``observe_snapshot`` is the whole algorithm and takes a plain
+    snapshot dict, so tests drive it synchronously without the thread."""
+
+    def __init__(self, interval=None, window=None, factor=None,
+                 min_samples=None):
+        self.interval = (interval if interval is not None
+                         else _env_float("HOROVOD_ANOMALY_INTERVAL", 5.0))
+        window = (int(window) if window is not None
+                  else int(_env_float("HOROVOD_ANOMALY_WINDOW", 12)))
+        factor = (factor if factor is not None
+                  else _env_float("HOROVOD_ANOMALY_FACTOR", 3.0))
+        min_samples = int(min_samples) if min_samples is not None else 4
+        self._baselines = {
+            name: RollingBaseline(window=window, factor=factor,
+                                  min_samples=min_samples, floor=floor)
+            for name, floor in SIGNALS}
+        self._active = {name: False for name, _ in SIGNALS}
+        self._prev = {}          # cumulative-counter memory between samples
+        self._samples = 0
+        self._signatures = []    # most recent detections (healthz surface)
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------- signals
+    def _delta(self, key, current):
+        prev = self._prev.get(key)
+        self._prev[key] = current
+        if prev is None or current < prev:  # first sample or registry reset
+            return None
+        return current - prev
+
+    def extract(self, snapshot) -> dict:
+        """Per-interval signal values out of one aggregated snapshot.
+        Cumulative series become deltas; their first sample is skipped."""
+        out = {}
+        hsum, hcount = _hist_totals(snapshot, "hvd_allreduce_latency_seconds")
+        dsum, dcount = self._delta("lat_sum", hsum), self._delta(
+            "lat_count", hcount)
+        if dsum is not None and dcount:
+            out["step_seconds"] = dsum / dcount
+        dexp = self._delta("exposed", _series_total(
+            snapshot, "hvd_exposed_comm_seconds"))
+        if dexp is not None:
+            out["exposed_comm_seconds"] = dexp
+        out["straggler_skew_seconds"] = _series_total(
+            snapshot, "hvd_straggler_skew_seconds")
+        dwire = self._delta("wire", _series_total(
+            snapshot, "hvd_wire_bytes_total"))
+        if dwire is not None:
+            out["wire_bytes_rate"] = dwire / max(self.interval, 1e-6)
+        return out
+
+    # ------------------------------------------------------------ decision
+    def observe_snapshot(self, snapshot) -> list:
+        """Feed one aggregated snapshot; returns this sample's new
+        anomaly signatures (empty on a healthy sample)."""
+        from ..metrics import instruments
+
+        self._samples += 1
+        fired = []
+        for name, value in self.extract(snapshot).items():
+            baseline = self._baselines[name]
+            base = baseline.baseline()
+            anomalous = baseline.observe(value)
+            if anomalous and not self._active[name]:
+                sig = make_signature(
+                    "anomaly:%s" % name, SEV_WARNING,
+                    "anomaly: %s=%.6g deviates from rolling baseline %.6g "
+                    "(factor %g over %d samples)"
+                    % (name, value, base, baseline.factor, len(baseline)),
+                    signal=name, value=value, baseline=base)
+                fired.append(sig)
+                logger.warning("anomaly watch: %s", sig["summary"])
+                _record(K_ANOMALY, name, sig["summary"])
+            if anomalous != self._active[name]:
+                self._active[name] = anomalous
+                instruments.anomaly_active().labels(signal=name).set(
+                    1 if anomalous else 0)
+        if fired:
+            self._signatures = (self._signatures + fired)[-16:]
+        return fired
+
+    def state(self) -> dict:
+        """Healthz surface: which signals are currently anomalous."""
+        return {"running": self._thread is not None
+                and self._thread.is_alive(),
+                "samples": self._samples,
+                "active": {k: v for k, v in self._active.items() if v},
+                "recent": [s["summary"] for s in self._signatures[-4:]]}
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run,
+                                        name="hvd-anomaly-watch", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        from ..metrics import aggregate, instruments
+
+        for name, _ in SIGNALS:  # pre-touch so /metrics renders zeros
+            instruments.anomaly_active().labels(signal=name).set(0)
+        while not self._stop.wait(self.interval):
+            try:
+                self.observe_snapshot(aggregate())
+            except Exception as exc:  # the watch must never kill the job
+                logger.debug("anomaly watch: sample failed: %s", exc)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+
+# -------------------------------------------------------- module lifecycle
+
+def _enabled_env() -> bool:
+    raw = os.environ.get("HOROVOD_ANOMALY_WATCH", "").strip()
+    return raw not in ("", "0", "false", "False", "off")
+
+
+def maybe_start_watch(force: bool = False):
+    """Start the watch thread if ``HOROVOD_ANOMALY_WATCH`` is set (or
+    ``force``). Idempotent; returns the watch or None. Called from
+    ``hvd.init()`` on the aggregating process only — the signals it
+    consumes exist merged on rank 0."""
+    global _watch
+    if not _enabled_env() and not force:
+        return None
+    with _watch_lock:
+        if _watch is None:
+            _watch = AnomalyWatch()
+            _watch.start()
+        return _watch
+
+
+def stop_watch() -> None:
+    global _watch
+    with _watch_lock:
+        w, _watch = _watch, None
+    if w is not None:
+        w.stop()
+
+
+def watch_state():
+    """The running watch's state dict, or None when the watch is off."""
+    with _watch_lock:
+        return None if _watch is None else _watch.state()

@@ -54,6 +54,12 @@ class Injector:
                     logger.warning(
                         "faultinject: rank %s firing %s at %s (hit %d)",
                         self.rank, rule.kind, point, n)
+                    from .. import blackbox
+                    blackbox.record(
+                        blackbox.K_FAULT, point,
+                        "%s fired (hit %d, %gs)" % (rule.kind, n,
+                                                    rule.seconds),
+                        rank=self.rank)
         return fired
 
     def fire(self, point: str) -> None:

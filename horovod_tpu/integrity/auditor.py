@@ -173,9 +173,12 @@ class ConsistencyAuditor:
         detail = (f"parameter desync at audit {self._audits} (step "
                   f"{self._step}): tensor(s) {bad} diverged from rank "
                   f"{self._root} on rank(s) {offenders}")
+        from .. import blackbox
+        blackbox.record(blackbox.K_VERDICT, "auditor", detail)
         policy = (self._policy if self._policy is not None
                   else policy_from_env())
         if policy == "abort":
+            blackbox.dump(detail)
             raise ParameterDesyncError(
                 f"{detail} (HOROVOD_CONSISTENCY_POLICY=abort; use heal to "
                 "re-broadcast from the root instead)")

@@ -163,7 +163,10 @@ class GradGuard:
         offenders = decode_rank_mask(combined, basics.size())
         detail = (f"non-finite gradients at step {self._step}: "
                   f"tensor(s) {names} from rank(s) {offenders}")
+        from .. import blackbox
+        blackbox.record(blackbox.K_VERDICT, "gradguard", detail)
         if policy == "abort":
+            blackbox.dump(detail)
             raise NonFiniteError(
                 f"{detail} (HOROVOD_GRAD_GUARD=abort; use skip/zero to "
                 "continue training through transient NaN/Inf)")
@@ -223,6 +226,13 @@ def precheck_entry(entry) -> None:
     import jax.numpy as jnp
 
     if not bool(jnp.all(jnp.isfinite(arr))):
+        detail = (f"non-finite values in tensor {entry.tensor_name!r} "
+                  f"submitted by rank(s) [{entry.rank}] "
+                  "(HOROVOD_GRAD_GUARD=abort)")
+        from .. import blackbox
+        blackbox.record(blackbox.K_VERDICT, "gradguard", detail,
+                        rank=entry.rank)
+        blackbox.dump(detail)
         raise NonFiniteError(
             f"non-finite values in tensor {entry.tensor_name!r} submitted "
             f"by rank {entry.rank} (HOROVOD_GRAD_GUARD=abort)")

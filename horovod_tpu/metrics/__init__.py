@@ -28,14 +28,19 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "instruments",
     "exponential_buckets", "get_registry", "merge_snapshots",
     "parse_prometheus", "render_prometheus", "reset_registry",
-    "local_snapshot", "store_report", "clear_reports", "aggregate",
-    "metrics_text", "metrics", "maybe_start_server", "stop_server",
-    "server_port",
+    "local_snapshot", "store_report", "drop_report", "readmit_report",
+    "clear_reports", "aggregate", "metrics_text", "metrics",
+    "maybe_start_server", "stop_server", "server_port",
+    "set_health_source", "health_summary",
 ]
 
 # Per-rank snapshots received over the control channel, keyed by rank.
 # Only populated on the aggregating (coordinator) process.
 _reports = {}
+# Ranks declared dead by the coordinator: their in-flight MSG_METRICS
+# frames may still land after rank_lost, and must not resurrect the dead
+# rank's gauges in aggregate(). Cleared per rank on elastic re-admission.
+_dropped = set()
 _reports_lock = threading.Lock()
 
 _server = None
@@ -48,14 +53,36 @@ def local_snapshot() -> dict:
 
 
 def store_report(rank: int, snapshot: dict, timestamp: float = 0.0) -> None:
-    """Record one rank's shipped snapshot (coordinator side)."""
+    """Record one rank's shipped snapshot (coordinator side). Snapshots
+    from ranks dropped via :func:`drop_report` are discarded — a stale
+    frame racing the death must not resurrect the rank."""
     with _reports_lock:
-        _reports[int(rank)] = (float(timestamp), snapshot)
+        rank = int(rank)
+        if rank in _dropped:
+            return
+        _reports[rank] = (float(timestamp), snapshot)
+
+
+def drop_report(rank: int) -> None:
+    """Forget a rank's stored snapshot and refuse later ones (coordinator
+    ``rank_lost``), so a stale MSG_METRICS arriving after the death never
+    resurrects the dead rank's gauges in :func:`aggregate`."""
+    with _reports_lock:
+        _reports.pop(int(rank), None)
+        _dropped.add(int(rank))
+
+
+def readmit_report(rank: int) -> None:
+    """A previously-lost rank rejoined (elastic admission): accept its
+    snapshots again."""
+    with _reports_lock:
+        _dropped.discard(int(rank))
 
 
 def clear_reports() -> None:
     with _reports_lock:
         _reports.clear()
+        _dropped.clear()
 
 
 def report_ranks():
@@ -90,6 +117,44 @@ def metrics(prometheus: bool = False):
     return metrics_text() if prometheus else aggregate()
 
 
+# -- /healthz (docs/observability.md) ----------------------------------------
+
+# Control-plane liveness provider: the CoordinatorServer registers the
+# CoordState.health_summary bound method; None outside coordinated mode.
+_health_source = None
+
+
+def set_health_source(fn) -> None:
+    global _health_source
+    _health_source = fn
+
+
+def health_summary() -> dict:
+    """The /healthz JSON body: reporting ranks, the coordinator's
+    control-plane view (last-negotiation age, heartbeat ledger, members)
+    and the live anomaly-watch state."""
+    doc = {"status": "ok", "reporting_ranks": report_ranks()}
+    src = _health_source
+    if src is not None:
+        try:
+            cp = src()
+        except Exception as exc:
+            cp = {"error": str(exc)}
+        doc["control_plane"] = cp
+        if cp.get("shutting_down") or cp.get("disconnected") \
+                or cp.get("silent_ranks"):
+            doc["status"] = "degraded"
+    try:
+        from ..blackbox.watch import watch_state
+        ws = watch_state()
+        doc["anomaly_watch"] = ws if ws is not None else {"running": False}
+        if (ws or {}).get("active"):
+            doc["status"] = "degraded"
+    except Exception:
+        pass
+    return doc
+
+
 # -- endpoint lifecycle (called from basics.init / basics.shutdown) ---------
 
 def maybe_start_server(force: bool = False):
@@ -106,7 +171,9 @@ def maybe_start_server(force: bool = False):
         if not raw.strip() and not force:
             return None
         port = int(raw) if raw.strip() else 0
-        srv = MetricsHTTPServer(port, metrics_text)
+        addr = os.environ.get("HOROVOD_METRICS_ADDR", "").strip() or "0.0.0.0"
+        srv = MetricsHTTPServer(port, metrics_text, addr=addr,
+                                health_fn=health_summary)
         srv.start()
         _server = srv
         return srv

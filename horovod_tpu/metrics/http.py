@@ -4,10 +4,17 @@ A daemon-threaded stdlib HTTP server started on the aggregating process
 (rank 0, or any standalone/local-cluster process).  Port 0 binds an
 ephemeral port; the bound port is exposed as ``server.port`` and logged,
 which is how tests and the CI smoke scrape without a fixed allocation.
+``HOROVOD_METRICS_ADDR`` selects the bind address (default ``0.0.0.0``;
+``127.0.0.1`` keeps the endpoint loopback-only).
+
+Besides ``/metrics`` the server answers ``/healthz`` with a JSON liveness
+summary — rank count, last-negotiation age, heartbeat status, anomaly-
+watch state (docs/observability.md).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -15,24 +22,43 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("horovod_tpu")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+HEALTH_CONTENT_TYPE = "application/json; charset=utf-8"
 
 
 class MetricsHTTPServer:
-    """Serves ``render_fn()`` at /metrics; everything else is 404."""
+    """Serves ``render_fn()`` at /metrics and ``health_fn()`` as JSON at
+    /healthz; everything else is 404."""
 
-    def __init__(self, port: int, render_fn):
+    def __init__(self, port: int, render_fn, addr: str = "0.0.0.0",
+                 health_fn=None):
         self._render = render_fn
+        self._health = health_fn
         self._requested_port = int(port)
+        # the wildcard spelling callers use maps to the stdlib's "" bind
+        self._addr = "" if addr in ("", "0.0.0.0") else addr
+        self._display_addr = addr or "0.0.0.0"
         self._httpd = None
         self._thread = None
         self.port = None  # bound port, set by start()
 
     def start(self) -> int:
         render = self._render
+        health = self._health
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    try:
+                        body = json.dumps(
+                            health() if health is not None else {},
+                            indent=1).encode("utf-8")
+                    except Exception as exc:  # pragma: no cover - source bug
+                        self.send_error(500, str(exc))
+                        return
+                    self._reply(body, HEALTH_CONTENT_TYPE)
+                    return
+                if path not in ("/metrics", "/"):
                     self.send_error(404)
                     return
                 try:
@@ -40,8 +66,11 @@ class MetricsHTTPServer:
                 except Exception as exc:  # pragma: no cover - render bug
                     self.send_error(500, str(exc))
                     return
+                self._reply(body, CONTENT_TYPE)
+
+            def _reply(self, body, content_type):
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -49,14 +78,16 @@ class MetricsHTTPServer:
             def log_message(self, fmt, *args):
                 log.debug("metrics http: " + fmt, *args)
 
-        self._httpd = ThreadingHTTPServer(("", self._requested_port), Handler)
+        self._httpd = ThreadingHTTPServer((self._addr, self._requested_port),
+                                          Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.25},
             name="hvd-metrics-http", daemon=True)
         self._thread.start()
-        log.info("metrics endpoint on http://0.0.0.0:%d/metrics", self.port)
+        log.info("metrics endpoint on http://%s:%d/metrics (+/healthz)",
+                 self._display_addr, self.port)
         return self.port
 
     def stop(self) -> None:

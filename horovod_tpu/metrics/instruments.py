@@ -201,3 +201,18 @@ def trace_dropped_events():
         "hvd_trace_dropped_events_total",
         "Trace spans dropped because the HOROVOD_TRACE_BUFFER ring (or "
         "rank 0's merge store) was full.")
+
+
+def anomaly_active():
+    return get_registry().gauge(
+        "hvd_anomaly_active",
+        "Live anomaly-watch verdict per tracked signal (1 = the current "
+        "window deviates from its rolling baseline; HOROVOD_ANOMALY_WATCH, "
+        "docs/observability.md).", labels=("signal",), agg="max")
+
+
+def blackbox_dumps():
+    return get_registry().counter(
+        "hvd_blackbox_dumps_total",
+        "Flight-recorder postmortem dumps written by this process on "
+        "abnormal exit (HOROVOD_BLACKBOX).")

@@ -60,6 +60,7 @@ from ..exceptions import RanksChangedError, ShutdownError, WorkerLostError
 from ..metrics import instruments
 from ..utils.env import env_float as _env_float
 from ..utils.timeline import Timeline
+from .. import blackbox as _blackbox
 from .. import faultinject
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
 from . import wire
@@ -96,6 +97,10 @@ MSG_TRACE = 10
 # min-RTT NTP-style offset so spans from every rank share one timeline
 MSG_CLOCK = 11
 MSG_CLOCK_RESP = 12
+# fire-and-forget postmortem dump (a worker's flight-recorder JSON doc ->
+# rank 0, which persists it into the blackbox bundle, docs/observability.md);
+# same interleaving contract as MSG_METRICS
+MSG_BLACKBOX = 13
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -190,6 +195,11 @@ class CoordState:
         self.last_seen: Dict[int, float] = {}
         self.disconnected: Dict[int, Tuple[float, str]] = {}
         self._hb_miss_counts: Dict[int, int] = {}
+        # ranks currently observed silent, for flight-recorder flap events
+        # only (the metric ledger above keeps its own accounting)
+        self._hb_silent: set = set()
+        # wall time of the last completed negotiation (/healthz freshness)
+        self.last_negotiation = 0.0
         self.warned: set = set()
         # ---- elastic membership (docs/elastic.md). Non-elastic jobs keep
         # members == range(world) for life, so every len(self.members)
@@ -312,7 +322,14 @@ class CoordState:
                              self.inflight_seq, self.last_data_resp,
                              self.inflight_data):
                 per_rank.pop(rank, None)
+            self._hb_silent.discard(rank)
             instruments.elastic_rank_lost().inc()
+            # flight recorder: remember the death so rank 0's bundle carries
+            # a stub for the rank that will never ship its own dump; a stale
+            # metrics report from it must also never resurrect its gauges
+            _blackbox.note_dead_rank(rank, reason)
+            from ..metrics import drop_report
+            drop_report(rank)
             self._reset_locked(
                 f"worker lost: rank {rank} dropped its control-plane "
                 f"connection ({reason})")
@@ -345,7 +362,14 @@ class CoordState:
         with self.cv:
             self.disconnected.pop(rank, None)
             self._hb_miss_counts.pop(rank, None)
+            if rank in self._hb_silent:
+                self._hb_silent.discard(rank)
+                _blackbox.record(_blackbox.K_HEARTBEAT, "rank_%d" % rank,
+                                 "rank %d ok (resumed)" % rank, rank=rank)
             self.last_seen[rank] = time.monotonic()
+            _blackbox.record(_blackbox.K_RECONNECT, "rank_%d" % rank,
+                             "resumed (last acked seq %s)" % last_acked,
+                             rank=rank)
             logger.warning("coordinator: rank %s resumed its control-plane "
                            "connection (last acked seq %s)", rank, last_acked)
 
@@ -383,6 +407,22 @@ class CoordState:
                     if misses > prev:
                         instruments.heartbeat_misses().inc(misses - prev)
                         self._hb_miss_counts[rank] = misses
+                    # flight-recorder flap edges, tracked apart from the
+                    # metric ledger (whose high-water counts never reset on
+                    # silent recovery): one miss event per silent episode,
+                    # one ok event when frames resume
+                    if misses >= 1 and rank not in self._hb_silent:
+                        self._hb_silent.add(rank)
+                        _blackbox.record(
+                            _blackbox.K_HEARTBEAT, "rank_%d" % rank,
+                            "rank %d missed %d heartbeat interval(s)"
+                            % (rank, misses), rank=rank)
+                    elif misses == 0 and rank in self._hb_silent:
+                        self._hb_silent.discard(rank)
+                        _blackbox.record(
+                            _blackbox.K_HEARTBEAT, "rank_%d" % rank,
+                            "rank %d ok (heartbeats resumed)" % rank,
+                            rank=rank)
                     if hb_timeout > 0 and age > hb_timeout:
                         lost.append((rank, f"no heartbeat for {age:.1f}s "
                                      "(HOROVOD_HEARTBEAT_TIMEOUT="
@@ -391,6 +431,9 @@ class CoordState:
             if self.elastic and rank > 0:
                 self.rank_lost(rank, why)
             else:
+                # non-elastic: the job dies with the rank, but the bundle
+                # still wants a stub naming who was declared dead and why
+                _blackbox.note_dead_rank(rank, why)
                 self.set_bye(f"worker rank {rank} declared dead: {why}")
 
     def _maybe_admit_locked(self) -> None:
@@ -402,6 +445,9 @@ class CoordState:
             admitted = sorted(self.pending_joins)
             self.members |= self.pending_joins
             self.pending_joins.clear()
+            from ..metrics import readmit_report
+            for r in admitted:
+                readmit_report(r)
             self._reset_locked(
                 f"worker joined: rank(s) {admitted} admitted at commit "
                 "boundary")
@@ -434,6 +480,9 @@ class CoordState:
         # EPOCH_SEQ_BASE, so no stale entry could match anyway)
         self.last_resp.clear()
         self.last_data_resp.clear()
+        _blackbox.record(_blackbox.K_EPOCH, "epoch_%d" % self.epoch,
+                         "%s; members now %s" % (reason,
+                                                 sorted(self.members)))
         logger.warning("elastic: membership epoch %d (%s); members now %s",
                        self.epoch, reason, sorted(self.members))
         self._publish_members_locked()
@@ -576,6 +625,7 @@ class CoordState:
             self.bye = True
             if reason and not self.shutdown_reason:
                 self.shutdown_reason = reason
+                _blackbox.record(_blackbox.K_ERROR, "shutdown", reason)
             for seq in list(self.lists):
                 self.resps[seq] = self._shutdown_bytes()
                 del self.lists[seq]
@@ -614,6 +664,7 @@ class CoordState:
 
     def _negotiate(self, per_rank) -> bytes:
         flags = 0
+        self.last_negotiation = time.time()
         tuned = self._tune()
         invalid: set = set()
         for rank, (rflags, cached, reqs) in per_rank.items():
@@ -693,6 +744,10 @@ class CoordState:
                         "coordinator: collective timeout on tensor '%s' "
                         "(waited %ds on ranks %s); declaring them lost",
                         name, int(waited), missing)
+                    _blackbox.record(
+                        _blackbox.K_TIMEOUT, name,
+                        "waited %ds on ranks %s; declaring them lost"
+                        % (int(waited), missing))
                     for r in missing:
                         self.rank_lost(
                             r, f"collective timeout: tensor '{name}' "
@@ -701,6 +756,11 @@ class CoordState:
                                f"{self.collective_timeout_s:g}s exceeded)")
                     return self._ranks_changed_bytes()
                 timed_out.append((name, missing, waited))
+                _blackbox.record(
+                    _blackbox.K_TIMEOUT, name,
+                    "waited %ds on ranks %s (HOROVOD_COLLECTIVE_TIMEOUT="
+                    "%gs exceeded)" % (int(waited), missing,
+                                       self.collective_timeout_s))
                 self.warned.discard(name)
                 # invalidate like a stall: the next negotiation of this
                 # name must start from full metadata
@@ -714,6 +774,9 @@ class CoordState:
                 self.warned.add(name)
                 warnings.append(
                     f"{name} (waiting on ranks {missing} for {int(waited)}s)")
+                _blackbox.record(
+                    _blackbox.K_STALL, name,
+                    f"waiting on ranks {missing} for {int(waited)}s")
                 # stall invalidation: drop the stalled tensor's cache entry
                 # so every rank renegotiates it from full metadata once the
                 # stall clears (a stale per-rank meta here could otherwise
@@ -728,6 +791,8 @@ class CoordState:
                         f"stall shutdown: tensor '{name}' waited {int(waited)}"
                         f"s on ranks {missing} (HOROVOD_STALL_SHUTDOWN_TIME_"
                         "SECONDS exceeded, stall_inspector.h:80)")
+                    _blackbox.record(_blackbox.K_ERROR, "shutdown",
+                                     self.shutdown_reason)
 
         instruments.stalled_tensors().set(n_stalled)
         if max_skew >= 0:
@@ -974,6 +1039,27 @@ class CoordState:
         with self.cv:
             return self.cache_hits, self.cache_misses
 
+    def health_summary(self) -> dict:
+        """Control-plane liveness snapshot for the /healthz endpoint
+        (docs/observability.md)."""
+        with self.cv:
+            age = (round(time.time() - self.last_negotiation, 3)
+                   if self.last_negotiation else None)
+            return {
+                "world_size": self.world,
+                "members": sorted(self.members),
+                "epoch": self.epoch,
+                "elastic": self.elastic,
+                "shutting_down": self.bye,
+                "shutdown_reason": self.shutdown_reason,
+                "last_negotiation_age_s": age,
+                "disconnected": {str(r): why for r, (_, why)
+                                 in self.disconnected.items()},
+                "heartbeat_misses": {str(r): n for r, n
+                                     in self._hb_miss_counts.items() if n},
+                "silent_ranks": sorted(self._hb_silent),
+            }
+
 
 class CoordinatorServer:
     """TCP front-end for :class:`CoordState`; one handler thread per worker."""
@@ -1005,6 +1091,10 @@ class CoordinatorServer:
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, name="hvd_coord_liveness", daemon=True)
         self._monitor_thread.start()
+        # /healthz pulls its control-plane section straight from the state
+        # machine (docs/observability.md)
+        from ..metrics import set_health_source
+        set_health_source(state.health_summary)
 
     def _accept_loop(self) -> None:
         self._sock.settimeout(0.5)
@@ -1072,6 +1162,17 @@ class CoordinatorServer:
                         logger.debug("coordinator: bad metrics report from "
                                      "rank %s", rank, exc_info=True)
                     continue
+                if mt == MSG_BLACKBOX:
+                    # fire-and-forget: a dying worker shipped its flight
+                    # recorder; persist it into rank 0's bundle, no reply
+                    try:
+                        brank, _, doc_json = wire.decode_blackbox_dump(
+                            payload)
+                        _blackbox.store_dump(brank, doc_json)
+                    except Exception:
+                        logger.debug("coordinator: bad blackbox dump from "
+                                     "rank %s", rank, exc_info=True)
+                    continue
                 if mt == MSG_TRACE:
                     # fire-and-forget: merge the rank's completed spans into
                     # rank 0's trace store; no reply frame
@@ -1121,6 +1222,8 @@ class CoordinatorServer:
 
     def stop(self) -> None:
         self._stop.set()
+        from ..metrics import set_health_source
+        set_health_source(None)
         try:
             self._sock.close()
         except OSError:
@@ -1601,6 +1704,9 @@ class CoordController:
                 except OSError:
                     pass
             instruments.control_reconnects().inc()
+            _blackbox.record(_blackbox.K_RECONNECT, "rank_%d" % self._rank,
+                             "reconnected after %s (attempt %d)"
+                             % (why, attempt), rank=self._rank)
             logger.warning(
                 "control plane: reconnected to coordinator %s after %s "
                 "(attempt %d, replaying seq %s, last acked seq %s)",
@@ -1630,6 +1736,23 @@ class CoordController:
                                 self._rank, payload)
         except (ConnectionError, OSError):
             pass  # telemetry only; the control path will surface the loss
+
+    def push_blackbox(self, doc_json: str) -> None:
+        """Ship this rank's postmortem flight-recorder dump to rank 0 as a
+        fire-and-forget MSG_BLACKBOX frame, so the bundle carries every
+        reachable rank even when workers have no shared filesystem. Called
+        once, from blackbox.dump(), before the BYE that tears the
+        connection down. Rank 0 writes its dump locally."""
+        if self._rank == 0 or self._sock is None:
+            return
+        payload = wire.encode_blackbox_dump(self._rank, time.time(),
+                                            doc_json)
+        try:
+            with self._send_lock:
+                wire.send_frame(self._sock, self._secret, MSG_BLACKBOX, 0,
+                                self._rank, payload)
+        except (ConnectionError, OSError):
+            pass  # the local rank_N.json still exists; only shipping failed
 
     def push_traces(self) -> None:
         """Ship this rank's completed trace spans as a fire-and-forget

@@ -55,6 +55,7 @@ from ..exceptions import (CollectiveTimeoutError, DuplicateNameError,
                           HorovodInternalError, RanksChangedError,
                           ShutdownError)
 from ..metrics import instruments
+from .. import blackbox as _blackbox
 from .. import tracing as _tracing
 from ..utils.env import env_float as _env_float, env_on as _env_on
 from .executor import Executor
@@ -203,6 +204,15 @@ class Engine:
         _tracing.maybe_activate()
         self._trace_interval = _env_float("HOROVOD_TRACE_INTERVAL", 2.0)
         self._trace_next_push = time.monotonic() + self._trace_interval
+        # flight recorder (docs/observability.md): same no-op discipline as
+        # tracing — active() stays None unless HOROVOD_BLACKBOX is set
+        _blackbox.maybe_activate()
+        _blackbox.set_identity(state.rank0, state.size)
+        _blackbox.set_shipper(getattr(self.controller, "push_blackbox",
+                                      None))
+        # wire/exact totals at the last flight-recorder metric delta
+        self._bb_wire_prev = 0
+        self._bb_exact_prev = 0
         # pre-touch the catalog's unlabeled series (inc(0) materializes the
         # child) so /metrics renders them at 0 before the first negotiation
         instruments.response_cache_hits().inc(0)
@@ -277,6 +287,10 @@ class Engine:
                     entry.rank, entry.tensor_name, entry.request_type.name,
                     int(entry.array.size) * entry.array.dtype.itemsize,
                     _tracing.clock.trace_us())
+            bb = _blackbox.active()
+            if bb is not None:
+                bb.record(_blackbox.K_COLLECTIVE, entry.tensor_name,
+                          "enqueue %s" % entry.request_type.name, entry.rank)
         if fail is not None:
             # the completion contract covers submit-time failures too, and
             # callbacks must never run under the engine lock (they may call
@@ -343,6 +357,17 @@ class Engine:
                     push = getattr(self.controller, "push_metrics", None)
                     if push is not None:
                         push()
+                    bb = _blackbox.active()
+                    if bb is not None:
+                        # the ring keeps the last K metric deltas so the
+                        # dump shows throughput right up to the death
+                        bb.record(
+                            _blackbox.K_METRICS, "delta",
+                            "wire_bytes+=%d exact_bytes+=%d"
+                            % (self._wire_acc - self._bb_wire_prev,
+                               self._exact_acc - self._bb_exact_prev))
+                        self._bb_wire_prev = self._wire_acc
+                        self._bb_exact_prev = self._exact_acc
                 if (_tracing.active() is not None
                         and now >= self._trace_next_push):
                     self._trace_next_push = now + self._trace_interval
@@ -357,6 +382,13 @@ class Engine:
                 (responses, handle_pairs, join_released, last_joined,
                  stall_warnings, stall_shutdown) = tick
                 for name in stall_warnings:
+                    # coordinated warnings arrive pre-formatted as
+                    # "tensor (waiting on ranks [...] for Ns)"; split so the
+                    # event names the tensor and the detail keeps the ranks
+                    tensor, _, rest = name.partition(" (")
+                    _blackbox.record(_blackbox.K_STALL, tensor,
+                                     rest.rstrip(")")
+                                     or "stalled past the warning threshold")
                     logger.warning(
                         "One or more tensors were submitted to be reduced/"
                         "gathered/broadcasted by subset of ranks and are "
@@ -401,6 +433,9 @@ class Engine:
                 # epoch change and serves the re-synced training
                 logger.warning("engine: %s; failing in-flight collectives "
                                "for elastic recovery", exc)
+                # recoverable: record the reset, keep flying (no dump)
+                _blackbox.record(_blackbox.K_EPOCH, type(exc).__name__,
+                                 str(exc))
                 with self._lock:
                     entries = list(self._pending.values())
                     self._pending.clear()
@@ -419,8 +454,16 @@ class Engine:
             except ShutdownError as exc:
                 # coordinated shutdown (a peer sent BYE / the coordinator
                 # broadcast the shutdown flag): drain quietly — this is the
-                # normal end-of-job path in multiprocess mode
+                # normal end-of-job path in multiprocess mode. A reasoned
+                # shutdown (declared-dead worker, exhausted reconnects) is
+                # abnormal: that one gets a flight-recorder dump.
                 logger.info("engine: %s", exc)
+                msg = str(exc)
+                if msg not in ("coordinated shutdown",
+                               "control plane shut down",
+                               "Horovod has been shut down."):
+                    _blackbox.record(_blackbox.K_ERROR, "ShutdownError", msg)
+                    _blackbox.dump("shutdown: %s" % msg)
                 with self._lock:
                     self._shutdown = True
                     drained = self._drain_locked()
@@ -428,6 +471,10 @@ class Engine:
                 return
             except Exception as exc:
                 logger.error("engine thread aborting: %s", exc)
+                _blackbox.record(_blackbox.K_ERROR, type(exc).__name__,
+                                 str(exc))
+                _blackbox.dump("engine thread aborted: %s: %s"
+                               % (type(exc).__name__, exc))
                 with self._lock:
                     self._shutdown = True
                     drained = self._drain_locked()
@@ -541,8 +588,15 @@ class Engine:
             if msg.startswith("collective timeout"):
                 error_cls = CollectiveTimeoutError
                 instruments.collective_timeouts().inc()
+                bb = _blackbox.active()
+                if bb is not None:
+                    bb.record(_blackbox.K_TIMEOUT,
+                              resp.tensor_names[0] if resp.tensor_names
+                              else "", msg)
+                    _blackbox.dump(msg)
             else:
                 error_cls = HorovodInternalError
+                _blackbox.record(_blackbox.K_ERROR, "negotiation", msg)
             for es in ebr.values():
                 for e in es:
                     self._fire_callback(e, False, resp.error_message)

@@ -29,6 +29,7 @@ import threading
 import zlib
 from typing import List, Optional, Tuple
 
+from .. import blackbox as _blackbox
 from ..exceptions import ShutdownError
 from ..metrics import instruments
 from .messages import Frame, Response, ResponseType
@@ -41,6 +42,13 @@ class FrameError(ConnectionError):
 
 
 _HEAD = struct.Struct("<BIi")
+
+# Frame-type names for blackbox events (numbers match coordinator.MSG_*).
+# The bulk data plane (DATA/DATA_RESP) is excluded: it can run at tensor
+# rate and would wash everything else out of the ring.
+_FRAME_NAMES = {1: "HELLO", 2: "LIST", 3: "RESP", 4: "BYE", 7: "METRICS",
+                8: "HEARTBEAT", 9: "RESUME", 10: "TRACE", 11: "CLOCK",
+                12: "CLOCK_RESP", 13: "BLACKBOX"}
 
 
 def _frame_limit() -> int:
@@ -56,6 +64,10 @@ def send_frame(sock: socket.socket, secret: str, msg_type: int, seq: int,
            if secret else b"")
     frame = struct.pack("<I", len(payload)) + head + crc + mac + payload
     instruments.control_bytes().labels(direction="sent").inc(len(frame))
+    bb = _blackbox.active()
+    if bb is not None and msg_type in _FRAME_NAMES:
+        bb.record(_blackbox.K_FRAME_TX, _FRAME_NAMES[msg_type],
+                  "seq=%d len=%d" % (seq, len(payload)), rank)
     sock.sendall(frame)
 
 
@@ -104,6 +116,10 @@ def recv_frame(sock: socket.socket, secret: str,
             raise FrameError("control-plane HMAC mismatch")
     instruments.control_bytes().labels(direction="recv").inc(
         8 + len(head) + len(mac) + len(payload))
+    bb = _blackbox.active()
+    if bb is not None and msg_type in _FRAME_NAMES:
+        bb.record(_blackbox.K_FRAME_RX, _FRAME_NAMES[msg_type],
+                  "seq=%d len=%d" % (seq, len(payload)), rank)
     return Frame(msg_type, seq, rank, payload)
 
 
@@ -600,6 +616,29 @@ def decode_metrics_report(buf: bytes):
                 fam["series"].append({"labels": labels, "value": rd.f64()})
         snapshot[name] = fam
     return rank, timestamp, snapshot
+
+
+# --------------------------------------------------------------------------
+# Blackbox dumps (MSG_BLACKBOX frames): one rank's postmortem flight-recorder
+# dump, shipped to the coordinator on abnormal exit so rank 0 can assemble
+# the bundle even when workers cannot reach HOROVOD_BLACKBOX_DIR themselves
+# (docs/observability.md). The document is the already-JSON dump payload —
+# this is a once-per-process-lifetime frame, so compactness is irrelevant
+# and the JSON round-trips into the bundle untouched.
+# --------------------------------------------------------------------------
+
+def encode_blackbox_dump(rank: int, timestamp: float, doc_json: str) -> bytes:
+    w = Writer()
+    w.i32(rank)
+    w.f64(timestamp)
+    w.str(doc_json)
+    return w.getvalue()
+
+
+def decode_blackbox_dump(buf: bytes):
+    """Returns (rank, timestamp, doc_json)."""
+    rd = Reader(buf)
+    return rd.i32(), rd.f64(), rd.str()
 
 
 # --------------------------------------------------------------------------

@@ -57,6 +57,12 @@ def main(argv=None):
         except (OSError, ValueError, json.JSONDecodeError) as e:
             print("invalid trace %s: %s" % (args.trace, e), file=sys.stderr)
             return 1
+        if not events:
+            # an empty or truncated file can parse as JSON ({} / []) yet
+            # carry nothing — that is a failed trace run, not a valid one
+            print("invalid trace %s: no trace events (empty or truncated "
+                  "capture)" % args.trace, file=sys.stderr)
+            return 1
         print("ok: %s (%d events)" % (args.trace, len(events)))
         return 0
     try:

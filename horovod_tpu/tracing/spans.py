@@ -207,3 +207,11 @@ class SpanRecorder:
     def open_count(self) -> int:
         with self._lock:
             return len(self._open)
+
+    def open_spans(self):
+        """Snapshot of in-flight collectives as (rank, name, ts) rows —
+        the blackbox dump's open-span table: what each rank was still
+        waiting on when the process died."""
+        with self._lock:
+            return [(s.rank, s.name, list(s.ts))
+                    for s in self._open.values()]

@@ -80,3 +80,78 @@ def test_allreduce_bench_compression_sweep(capsys):
     out = capsys.readouterr().out.strip().splitlines()
     metrics = [json.loads(l) for l in out if '"metric"' in l]
     assert any(m["metric"] == "allreduce_int8_wire_ratio" for m in metrics)
+
+
+# -- perf-history store + regression gate (benchmarks/history.py) ----------
+
+def test_history_append_and_load(tmp_path):
+    import history
+
+    path = str(tmp_path / "history.jsonl")
+    rec = history.append_record(path, {"metric": "imgs_per_sec",
+                                       "value": 100.0, "model": "ResNet18"})
+    assert rec["schema"] == history.SCHEMA_VERSION
+    assert rec["timestamp"] > 0
+    history.append_record(path, {"metric": "imgs_per_sec", "value": 110.0})
+    history.append_record(path, {"metric": "tokens_per_sec", "value": 5.0})
+    assert [r["value"] for r in
+            history.load_history(path, metric="imgs_per_sec")] == [100.0,
+                                                                   110.0]
+    assert len(history.load_history(path)) == 3
+
+
+def test_history_skips_garbage_and_future_schema(tmp_path):
+    import json as _json
+
+    import history
+
+    path = str(tmp_path / "history.jsonl")
+    history.append_record(path, {"metric": "m", "value": 1.0})
+    with open(path, "a") as f:
+        f.write('{"metric": "m", "va')  # truncated tail from a killed run
+        f.write("\n")
+        f.write(_json.dumps({"metric": "m", "value": 9.0,
+                             "schema": history.SCHEMA_VERSION + 1}) + "\n")
+        f.write("[1, 2]\n")  # not a record
+    recs = history.load_history(path, metric="m")
+    assert [r["value"] for r in recs] == [1.0]
+    assert history.load_history(str(tmp_path / "absent.jsonl")) == []
+
+
+def test_check_regression_verdicts():
+    import history
+
+    # no usable history: never a failure (the first CI run seeds it)
+    v = history.check_regression([], 50.0)
+    assert v["regression"] is False and v["reason"] == "no_baseline"
+
+    hist = [{"value": x} for x in (100.0, 102.0, 98.0, 101.0, 99.0)]
+    ok = history.check_regression(hist, 95.0, tolerance=0.15)
+    assert ok["regression"] is False and ok["reason"] == "ok"
+    assert ok["baseline"] == 100.0
+
+    bad = history.check_regression(hist, 80.0, tolerance=0.15)
+    assert bad["regression"] is True and bad["reason"] == "below_tolerance"
+    assert bad["floor"] == 85.0
+
+    # the window only sees the trailing records
+    shifted = hist + [{"value": 10.0}] * 5
+    v = history.check_regression(shifted, 9.0, window=5, tolerance=0.15)
+    assert v["baseline"] == 10.0 and v["regression"] is False
+
+
+def test_bench_regression_gate_compares_before_append(tmp_path):
+    """bench.py orders compare-then-append so today's run cannot vote in
+    its own baseline; exit code 3 flags a regression. Exercised at the
+    history layer the same way bench.main does."""
+    import history
+
+    path = str(tmp_path / "history.jsonl")
+    for v in (100.0, 101.0, 99.0):
+        history.append_record(path, {"metric": "imgs_per_sec", "value": v})
+    fresh = 50.0
+    verdict = history.check_regression(
+        history.load_history(path, metric="imgs_per_sec"), fresh)
+    history.append_record(path, {"metric": "imgs_per_sec", "value": fresh})
+    assert verdict["regression"] is True  # compared against 100-ish, not 50
+    assert len(history.load_history(path)) == 4

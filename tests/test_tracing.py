@@ -312,6 +312,32 @@ class TestCLI:
         assert hvdprof_main(["report", str(bad)]) == 1
         assert hvdprof_main([]) == 2
 
+    def test_validate_rejects_empty_file(self, tmp_path, capsys):
+        """A zero-byte trace (the run died before the final flush) must
+        fail validation, not pass as vacuously-valid JSON."""
+        empty = tmp_path / "empty.json"
+        empty.write_text("")
+        assert hvdprof_main(["validate", str(empty)]) == 1
+        assert "invalid trace" in capsys.readouterr().err
+
+    def test_validate_rejects_truncated_file(self, tmp_path, capsys):
+        path = str(tmp_path / "trunc.json")
+        write_merged(path, _synthetic_spans(), trace_id=1)
+        whole = open(path).read()
+        with open(path, "w") as f:
+            f.write(whole[:len(whole) // 2])  # killed mid-write
+        assert hvdprof_main(["validate", path]) == 1
+        assert "invalid trace" in capsys.readouterr().err
+
+    def test_validate_rejects_zero_events(self, tmp_path, capsys):
+        """Parseable JSON carrying no events is a failed capture: exit
+        nonzero with a clear message instead of 'ok (0 events)'."""
+        for doc in ("{}", '{"traceEvents": []}', "[]"):
+            p = tmp_path / "zero.json"
+            p.write_text(doc)
+            assert hvdprof_main(["validate", str(p)]) == 1, doc
+            assert "no trace events" in capsys.readouterr().err
+
     def test_bin_hvdprof_entrypoint(self, tmp_path):
         path = str(tmp_path / "trace.json")
         write_merged(path, _synthetic_spans(), trace_id=1)
