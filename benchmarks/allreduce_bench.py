@@ -219,6 +219,65 @@ def bench_compression(sizes_mb, iters, warmup, modes):
     return results
 
 
+def bench_bucket_overlap(bucket_mbs, iters, warmup, layers=16, np_=8):
+    """Backward-pass bucket-overlap sweep (HOROVOD_BUCKET_MB,
+    docs/overlap.md): a synthetic gradient pytree (``layers`` x
+    [256, 1024] weight + [1024] bias, fp32) rides ``allreduce_gradients``
+    with the bucket knob swept; 0 is the per-leaf baseline. Reports the
+    drain wall time AND the per-step exposed-communication seconds
+    (hvd_exposed_comm_seconds delta — time blocked in synchronize, the
+    quantity bucket overlap exists to shrink)."""
+    import horovod_tpu as hvd
+    from horovod_tpu import testing
+
+    shapes = [(256, 1024), (1024,)] * layers
+    total_mb = sum(int(np.prod(s)) for s in shapes) * 4 / (1 << 20)
+    results = []
+    for bmb in bucket_mbs:
+
+        def worker():
+            import time as _t
+
+            from horovod_tpu.metrics import instruments
+            from horovod_tpu.optim import distributed as dist
+
+            rng = np.random.RandomState(1234)
+            grads = [rng.randn(*s).astype(np.float32) for s in shapes]
+            for _ in range(warmup):
+                dist.allreduce_gradients(grads, op=hvd.Sum, prefix="ob")
+            e0 = instruments.exposed_comm_seconds().value
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                dist.allreduce_gradients(grads, op=hvd.Sum, prefix="ob")
+            dt = (_t.perf_counter() - t0) / iters
+            exposed = (instruments.exposed_comm_seconds().value - e0) / iters
+            return dt, exposed
+
+        if hvd.is_initialized():
+            hvd.shutdown()
+        if bmb > 0:
+            os.environ["HOROVOD_BUCKET_MB"] = str(bmb)
+        else:
+            os.environ.pop("HOROVOD_BUCKET_MB", None)
+        try:
+            outs = testing.run_cluster(worker, np=np_)
+        finally:
+            hvd.shutdown()
+            os.environ.pop("HOROVOD_BUCKET_MB", None)
+        dt = max(o[0] for o in outs)
+        exposed = max(o[1] for o in outs)
+        results.append({
+            "path": "bucket-overlap", "bucket_mb": bmb, "n": np_,
+            "layers": layers, "total_mb": round(total_mb, 2),
+            "time_us": round(dt * 1e6, 1),
+            "exposed_comm_us": round(exposed * 1e6, 1),
+            "exposed_comm_pct": round(100.0 * exposed / dt, 1) if dt else 0.0,
+            "algbw_gbps": round(total_mb * (1 << 20) / dt / 1e9, 3),
+        })
+        print(json.dumps(results[-1]))
+    return results
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes-mb", default="0.0625,0.25,1,4,16,64",
@@ -232,10 +291,35 @@ def main(argv=None):
                     help="comma-separated wire modes to sweep "
                          f"({','.join(_COMPRESSION_MODES)}); implies "
                          "--path compression")
+    ap.add_argument("--bucket-mb", default=None,
+                    help="comma-separated HOROVOD_BUCKET_MB values to sweep "
+                         "(0 = per-leaf baseline), e.g. '0,0.5,1,4'; runs "
+                         "the bucket-overlap bench instead of --path")
+    ap.add_argument("--layers", type=int, default=16,
+                    help="synthetic model depth for --bucket-mb")
+    ap.add_argument("--np", type=int, default=8, dest="np_",
+                    help="cluster size for --bucket-mb")
     args = ap.parse_args(argv)
     sizes = [float(s) for s in args.sizes_mb.split(",")]
 
     import horovod_tpu as hvd
+
+    if args.bucket_mb is not None:
+        bucket_mbs = [float(b) for b in args.bucket_mb.split(",")]
+        results = bench_bucket_overlap(bucket_mbs, args.iters, args.warmup,
+                                       layers=args.layers, np_=args.np_)
+        off = next((r for r in results if r["bucket_mb"] == 0), None)
+        on = [r for r in results if r["bucket_mb"] > 0]
+        if off and on:
+            best = min(on, key=lambda r: r["exposed_comm_pct"])
+            print(json.dumps({
+                "metric": "bucket_overlap_exposed_comm_pct",
+                "off_pct": off["exposed_comm_pct"],
+                "on_pct": best["exposed_comm_pct"],
+                "best_bucket_mb": best["bucket_mb"],
+                "time_us_off": off["time_us"],
+                "time_us_on": best["time_us"]}))
+        return results
 
     if args.path == "compression" or args.compression is not None:
         modes = ([m.strip() for m in args.compression.split(",")]

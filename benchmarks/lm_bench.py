@@ -14,8 +14,14 @@ T = tokens) per Kaplan et al. / PaLM appendix B.
 
     python benchmarks/lm_bench.py                 # real chip
     LM_PRESET=tiny python benchmarks/lm_bench.py  # CPU smoke
+
+With ``--history PATH`` the final record (tokens/s + MFU) appends to the
+same schema-versioned JSONL store bench.py uses (benchmarks/history.py);
+``--check-regression`` compares against the trajectory BEFORE appending
+and exits 3 below the tolerance floor.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -39,7 +45,31 @@ PRESETS = {
 }
 
 
-def main():
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Transformer-LM training benchmark (config via LM_* "
+                    "env knobs; see module docstring)")
+    p.add_argument("--history", metavar="PATH", default=None,
+                   help="append this run's tokens/s + MFU to a "
+                        "schema-versioned JSONL perf history "
+                        "(benchmarks/history.py)")
+    p.add_argument("--check-regression", action="store_true",
+                   help="with --history: compare this run against the "
+                        "recorded trajectory BEFORE appending; exit 3 when "
+                        "it falls below the tolerance floor")
+    p.add_argument("--regression-window", type=int, default=None,
+                   metavar="N", help="trailing records the baseline median "
+                                     "uses (default 5)")
+    p.add_argument("--regression-tolerance", type=float, default=None,
+                   metavar="F", help="fraction below baseline that fails "
+                                     "(default 0.15)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    # callers (tests) invoke main() bare: no argv means no flags, never
+    # pytest's sys.argv
+    args = parse_args([] if argv is None else argv)
     import jax
     import jax.numpy as jnp
     import optax
@@ -240,13 +270,47 @@ def main():
     print(f"# tokens/sec: {tok_per_s:,.0f}; model TFLOP/s: "
           f"{flops_per_s/1e12:.1f}; MFU/chip: {100*mfu:.1f}%",
           file=sys.stderr)
-    print(json.dumps({
+    result = {
         "metric": "transformer_lm_tokens_per_sec",
         "value": round(tok_per_s, 1),
         "unit": "tok/s",
         "mfu_pct": round(100 * mfu, 2) if on_tpu else None,
-    }))
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if args.history:
+        from benchmarks.history import (append_record, check_regression,
+                                        load_history)
+
+        # compare against the trajectory BEFORE appending: today's run
+        # must not be allowed to vote in its own baseline
+        if args.check_regression:
+            verdict = check_regression(
+                load_history(args.history, metric=result["metric"]),
+                result["value"],
+                **{k: v for k, v in (
+                    ("window", args.regression_window),
+                    ("tolerance", args.regression_tolerance))
+                   if v is not None})
+            print("# regression check: %s" % json.dumps(verdict),
+                  file=sys.stderr)
+            if verdict["regression"]:
+                print(f"# REGRESSION: {result['metric']} = "
+                      f"{result['value']} fell below the floor "
+                      f"{verdict['floor']} (baseline {verdict['baseline']} "
+                      f"over {verdict['samples']} runs)", file=sys.stderr)
+                rc = 3
+        append_record(args.history, {
+            "metric": result["metric"], "value": result["value"],
+            "unit": result["unit"], "mfu_pct": result["mfu_pct"],
+            "backend": jax.default_backend(), "devices": n_dev,
+            "preset": os.environ.get("LM_PRESET", ""),
+            "batch": batch, "seq": seq,
+        })
+        print(f"# perf history appended to {args.history}", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(sys.argv[1:]))

@@ -40,6 +40,9 @@ python -m pytest tests/test_tracing.py -q
 stage "doctor: blackbox flight recorder, signatures, hvddoctor, anomaly watch"
 python -m pytest tests/test_blackbox.py -q
 
+stage "overlap: bucketed backward drain, fused kernels, hvdprof overlap %"
+python -m pytest tests/test_overlap.py -q
+
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # includes tests/test_spark_real.py (real-pyspark scenarios; they skip
 # when pyspark is absent from the image)
@@ -91,6 +94,9 @@ if [ "$QUICK" != "quick" ]; then
           --batch-per-device 2 --iters 3
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python benchmarks/allreduce_bench.py --sizes-mb 0.25,1 --iters 5
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python benchmarks/allreduce_bench.py --bucket-mb 0,0.5 --iters 5 \
+          --layers 4
 fi
 
 echo

@@ -58,7 +58,8 @@ def _commit(tensor, rank: int):
 
 def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
              average=False, prescale=1.0, postscale=1.0,
-             callback=None, splits=None, wire: str = "") -> int:
+             callback=None, splits=None, wire: str = "",
+             fusable: bool = True) -> int:
     eng = basics._engine()
     r = basics.rank()
     # chaos harness: hang@collective / delay@collective hold THIS rank's
@@ -79,6 +80,7 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
         callback=callback,
         splits=splits,
         compression=wire,
+        fusable=fusable,
     )
     from ..integrity import precheck_entry
 
@@ -90,7 +92,7 @@ def _enqueue(request_type: RequestType, tensor, name: str, *, root_rank=-1,
 def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0, callback=None,
-                    compression=None) -> int:
+                    compression=None, fusable: bool = True) -> int:
     """Asynchronous allreduce; returns a handle (`torch/mpi_ops.py:207-229`).
     ``callback(ok, result_or_error)`` fires on the engine thread at
     completion, before ``synchronize`` unblocks (the reference's done-
@@ -100,7 +102,11 @@ def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
     default; wire-mode compressors (``Compression.int8`` / ``int8_dcn``)
     enqueue the tensor unchanged and negotiate the quantized wire program
     through the control plane (cast compressors belong on the synchronous
-    ``allreduce`` wrapper, which owns the decompress side)."""
+    ``allreduce`` wrapper, which owns the decompress side).
+
+    ``fusable=False`` marks the tensor as a client-built bucket the
+    controller must not merge with others (backward-pass bucket overlap,
+    docs/overlap.md); default True preserves the engine's normal fusion."""
     name = _auto_name("allreduce", name)
     if compression is None:
         compression = _compression.from_env()
@@ -113,7 +119,8 @@ def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
     return _enqueue(RequestType.ALLREDUCE, tensor, name,
                     average=(op == Average),
                     prescale=prescale_factor, postscale=postscale_factor,
-                    callback=callback, wire=compression.wire or "")
+                    callback=callback, wire=compression.wire or "",
+                    fusable=fusable)
 
 
 def allreduce(tensor, name: Optional[str] = None, op: int = Average,

@@ -1608,3 +1608,182 @@ def int8_dequantize_2d(q2, s2):
         compiler_params=_cparams("parallel"),
         interpret=_interpret(),
     )(q2, s2)
+
+
+# =================================================== fused quantize + pack
+# Single-pass wire assembly for the packed int8 allreduce
+# (HOROVOD_PACKED_WIRE, `runtime/executor.py`): instead of quantizing into
+# TWO buffers (payload + scales) that ride TWO collectives, each block row
+# becomes one int8 row ``[q_0..q_{B-1} | scale as 4 raw bytes]`` written by
+# ONE store — the fusion-buffer layout itself, so the separate quantize
+# pass and the second collective both disappear. The quantization formula
+# is byte-identical to `_int8_quant_kernel` above (same absmax/scale/clip
+# chain); only the destination layout differs.
+
+PACK_SCALE_BYTES = 4  # one f32 scale per block row, bitcast to raw bytes
+
+
+def _int8_quant_pack_kernel(x_ref, p_ref):
+    x = x_ref[...].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax * (1.0 / 127.0)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / safe), -127.0, 127.0).astype(jnp.int8)
+    sbytes = lax.bitcast_convert_type(scale, jnp.int8).reshape(
+        x.shape[0], PACK_SCALE_BYTES)
+    p_ref[...] = jnp.concatenate([q, sbytes], axis=1)
+
+
+def int8_quantize_pack_2d(x2):
+    """[rows, block] float → [rows, block+4] int8 packed rows."""
+    rows, block = x2.shape
+    br = _pick_block(rows, 256)
+    row = pl.BlockSpec((br, block), lambda i: (i, 0))
+    prow = pl.BlockSpec((br, block + PACK_SCALE_BYTES), lambda i: (i, 0))
+    return pl.pallas_call(
+        _int8_quant_pack_kernel,
+        grid=(rows // br,),
+        in_specs=[row],
+        out_specs=prow,
+        out_shape=_struct((rows, block + PACK_SCALE_BYTES), jnp.int8, x2),
+        compiler_params=_cparams("parallel"),
+        interpret=_interpret(),
+    )(x2)
+
+
+def int8_quantize_pack_ref(x2):
+    """jnp fallback — the exact kernel formula, bit-identical packed rows."""
+    xf = x2.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    scale = absmax * (1.0 / 127.0)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe), -127.0, 127.0).astype(jnp.int8)
+    sbytes = lax.bitcast_convert_type(scale, jnp.int8).reshape(
+        x2.shape[0], PACK_SCALE_BYTES)
+    return jnp.concatenate([q, sbytes], axis=1)
+
+
+def int8_quantize_pack(x2):
+    """Kernel when the shape tiles and no vma constraint applies; jnp
+    fallback otherwise. Same bits either way."""
+    rows, block = x2.shape
+    if int8_supported(rows, block) and not vma_active(x2):
+        return int8_quantize_pack_2d(x2)
+    return int8_quantize_pack_ref(x2)
+
+
+def int8_unpack(p2):
+    """[rows, block+4] packed int8 → ([rows, block] int8, [rows, 1] f32).
+    Pure layout surgery (slice + bitcast); XLA fuses it into the consumer,
+    so no kernel is needed on the unpack side."""
+    rows = p2.shape[0]
+    block = p2.shape[1] - PACK_SCALE_BYTES
+    q = p2[:, :block]
+    scales = lax.bitcast_convert_type(
+        p2[:, block:].reshape(rows, 1, PACK_SCALE_BYTES), jnp.float32)
+    return q, scales.reshape(rows, 1)
+
+
+# ============================================= fused matmul + reduce-scatter
+# The tail-linear / LM-head pattern: x [R, Kl] and w [Kl, N] are the local
+# shards of a contraction-sharded matmul, so the full product is
+# sum_over_ranks(x_j @ w_j) and each rank only needs its own row chunk of
+# the sum — matmul feeding reduce-scatter. The fused form decomposes the
+# local product into per-chunk partial matmuls and rotates the accumulator
+# around the ring: every hop's ppermute is data-independent of the chunk
+# matmul issued next to it, so the compiler overlaps wire and MXU instead
+# of serializing full-matmul-then-collective.
+
+
+def _mm_kernel(x_ref, w_ref, o_ref, acc_ref, *, k_steps):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(x_ref[...], w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _emit():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def matmul_tiles(mdim: int, kdim: int, ndim: int):
+    """(bm, bk, bn) MXU tiling for the matmul kernel, or None when the
+    shape doesn't tile (caller uses jnp.dot — identical contraction)."""
+    if mode() == "off" or kdim % _LANES or ndim % _LANES:
+        return None
+    bm = _pick_block(mdim, 256)
+    bk = _pick_block(kdim, 512)
+    bn = _pick_block(ndim, 256)
+    if bm is None or bk is None or bn is None:
+        return None
+    return bm, bk, bn
+
+
+def matmul_2d(x2, w2):
+    """Tiled MXU matmul with f32 accumulation (k innermost, sequential —
+    the grid revisits one output tile per (i, j))."""
+    mdim, kdim = x2.shape
+    ndim = w2.shape[1]
+    bm, bk, bn = matmul_tiles(mdim, kdim, ndim)
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, k_steps=kdim // bk),
+        grid=(mdim // bm, ndim // bn, kdim // bk),
+        in_specs=[pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                  pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=_struct((mdim, ndim), jnp.result_type(x2, w2), x2, w2),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_sem_par2_arb(),
+        interpret=_interpret(),
+    )(x2, w2)
+
+
+def _mm_chunk(xs, w):
+    mdim, kdim = xs.shape
+    if matmul_tiles(mdim, kdim, w.shape[1]) is not None \
+            and not vma_active(xs, w):
+        return matmul_2d(xs, w)
+    return jnp.dot(xs, w)
+
+
+def matmul_reduce_scatter_reference(x, w, axis_name):
+    """Unfused reference: full local matmul, then a tiled psum_scatter of
+    the product (same result up to f32 addition order)."""
+    return lax.psum_scatter(x @ w, axis_name, scatter_dimension=0,
+                            tiled=True)
+
+
+def matmul_reduce_scatter(x, w, axis_name):
+    """``psum_scatter(x @ w)`` fused into a compute/permute ring.
+
+    Call inside shard_map/pmap over ``axis_name`` with ``x`` [R, Kl] and
+    ``w`` [Kl, N] (contraction-sharded); returns this rank's [R/m, N] row
+    chunk of the cross-rank sum. Rank p seeds its accumulator with the
+    local partial of chunk (p-1) mod m; each of the m-1 hops rotates the
+    accumulator one rank forward and adds the local partial of chunk
+    (p-k-1) mod m, so after hop k=m-1 rank p holds chunk p summed over
+    every rank — and every hop's wire transfer is independent of the
+    matmul scheduled beside it. Falls back to the unfused reference when
+    rows don't split evenly, the kernels are off, or vma checking is
+    active (addition order matches psum_scatter only in the fallback;
+    the ring result differs by f32 reassociation, like any ring
+    reduce-scatter)."""
+    m = lax.psum(1, axis_name)
+    rows = x.shape[0]
+    if m == 1 or rows % m or mode() == "off" or vma_active(x, w):
+        return matmul_reduce_scatter_reference(x, w, axis_name)
+    p = lax.axis_index(axis_name)
+    c = rows // m
+
+    def partial_chunk(k):
+        idx = jnp.mod(p - k - 1, m)
+        xs = lax.dynamic_slice_in_dim(x, idx * c, c, axis=0)
+        return _mm_chunk(xs, w)
+
+    acc = partial_chunk(0)
+    perm = [(j, (j + 1) % m) for j in range(m)]
+    for k in range(1, m):
+        acc = lax.ppermute(acc, axis_name, perm) + partial_chunk(k)
+    return acc

@@ -20,6 +20,7 @@ JAX shape: gradients are a pytree produced by ``jax.grad``. Two modes:
 
 from __future__ import annotations
 
+import os
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -31,6 +32,120 @@ from ..basics import Adasum, Average, Sum
 from ..ops import collective_ops as ops
 from ..ops import compression as _compression
 from ..ops.compression import Compression
+
+
+def _bucket_bytes() -> int:
+    """``HOROVOD_BUCKET_MB`` resolved to bytes (0 = bucket overlap off).
+    Read per call, like every other knob, so tests/benchmarks can flip it
+    between steps without re-importing."""
+    v = os.environ.get("HOROVOD_BUCKET_MB", "")
+    if not v:
+        return 0
+    try:
+        return int(float(v) * 2 ** 20)
+    except ValueError:
+        raise ValueError(
+            f"HOROVOD_BUCKET_MB={v!r}: expected a number of MiB "
+            "(0 = disabled)") from None
+
+
+def partition_buckets(sizes_bytes, dtypes, bucket_bytes: int):
+    """Partition leaf indices into reverse-order buckets of <= bucket_bytes.
+
+    ``sizes_bytes``/``dtypes`` are per-leaf, in tree order; the result
+    walks the leaves in REVERSE tree order (the approximation of
+    backward-pass production order — the last layers' gradients
+    materialize first under reverse-mode AD) and closes a bucket when the
+    byte budget would overflow or the dtype changes (a fused buffer is one
+    typed concat). Every bucket holds at least one leaf, so oversized
+    leaves ride alone. Deterministic by construction: same tree + same
+    knob → same buckets on every rank.
+    """
+    buckets, cur, cur_bytes = [], [], 0
+    for i in reversed(range(len(sizes_bytes))):
+        if cur and (dtypes[i] != dtypes[cur[-1]]
+                    or cur_bytes + sizes_bytes[i] > bucket_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += sizes_bytes[i]
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _allreduce_gradients_bucketed(grads, op, compression, prefix,
+                                  sparse_as_dense, bucket_bytes):
+    """Bucketed backward-pass overlap (HOROVOD_BUCKET_MB, docs/overlap.md).
+
+    Dense leaves concat into reverse-production-order flat buckets, each
+    enqueued as its own NON-fusable allreduce — the first buckets are on
+    the wire while later buckets are still being assembled/enqueued, and
+    the controller cannot re-merge them into one serial mega-bucket.
+    Values are bit-identical to the per-leaf path: the engine's fusion
+    buffer is itself a concat, and the reduction is elementwise, so
+    grouping cannot change any element's cross-rank sum. Sparse leaves
+    keep the per-leaf two-allgather path (ragged — not concatable).
+    """
+    from ..ops import sparse as _sparse
+
+    is_sparse = lambda x: isinstance(x, _sparse.IndexedSlices)  # noqa: E731
+    pairs, treedef = jax.tree_util.tree_flatten_with_path(
+        grads, is_leaf=is_sparse)
+    tr = _tracing.active()
+    launch_span = (tr.begin_block(_tracing.K_PHASE, basics.rank(),
+                                  "GRAD_LAUNCH", _tracing.clock.trace_us())
+                   if tr is not None else None)
+    dense = []          # (pos, compressed leaf, ctx) in tree order
+    sparse_items = []   # (pos, name, leaf)
+    for pos, (path, leaf) in enumerate(pairs):
+        if is_sparse(leaf):
+            if sparse_as_dense:
+                leaf = _sparse.to_dense(leaf)
+            else:
+                sparse_items.append(
+                    (pos, prefix + jax.tree_util.keystr(path), leaf))
+                continue
+        comp, ctx = compression.compress(jnp.asarray(leaf))
+        dense.append((pos, comp, ctx))
+    buckets = partition_buckets(
+        [int(c.size) * c.dtype.itemsize for _, c, _ in dense],
+        [c.dtype for _, c, _ in dense], bucket_bytes)
+    started = []
+    for i, idxs in enumerate(buckets):
+        members = [dense[j] for j in idxs]
+        flat = (jnp.ravel(members[0][1]) if len(members) == 1
+                else jnp.concatenate([jnp.ravel(c) for _, c, _ in members]))
+        h = ops.allreduce_async(flat, name=f"{prefix}.bucket.{i}", op=op,
+                                compression=compression, fusable=False)
+        started.append(("bucket", h, members))
+    for pos, name, leaf in sparse_items:
+        started.append(
+            ("sparse", _sparse.allreduce_sparse_async(leaf, name),
+             (pos, leaf)))
+    if tr is not None:
+        tr.end_block(launch_span, _tracing.clock.trace_us())
+        drain_span = tr.begin_block(_tracing.K_PHASE, basics.rank(),
+                                    "GRAD_DRAIN", _tracing.clock.trace_us())
+    outs: list = [None] * len(pairs)
+    try:
+        for kind, h, meta in started:
+            if kind == "sparse":
+                pos, leaf = meta
+                outs[pos] = _sparse.synchronize_sparse(
+                    h, op=op, dense_shape=leaf.dense_shape)
+                continue
+            flat = ops.synchronize(h)
+            off = 0
+            for pos, comp, ctx in meta:
+                n = int(comp.size)
+                outs[pos] = compression.decompress(
+                    flat[off:off + n].reshape(comp.shape), ctx)
+                off += n
+    finally:
+        if tr is not None:
+            tr.end_block(drain_span, _tracing.clock.trace_us())
+    return jax.tree_util.tree_unflatten(treedef, outs)
 
 
 def allreduce_gradients(grads, op: int = Average,
@@ -69,6 +184,13 @@ def allreduce_gradients(grads, op: int = Average,
         # sparse_as_dense must densify here too, or optax would tree_map
         # into the IndexedSlices on single-process debug runs.
         return _sparse.densify_tree(grads) if sparse_as_dense else grads
+    # Bucketed backward overlap (HOROVOD_BUCKET_MB, docs/overlap.md).
+    # Adasum keeps the per-leaf path: its combine rule is not elementwise,
+    # so reducing a concat would change the math.
+    bucket_bytes = _bucket_bytes() if op != Adasum else 0
+    if bucket_bytes > 0:
+        return _allreduce_gradients_bucketed(
+            grads, op, compression, prefix, sparse_as_dense, bucket_bytes)
     pairs, treedef = jax.tree_util.tree_flatten_with_path(
         grads, is_leaf=is_sparse)
     tr = _tracing.active()

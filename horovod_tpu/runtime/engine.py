@@ -33,6 +33,18 @@ Env knobs (parity with `common.h:61-87` / `operations.cc:388-485`):
   HOROVOD_INT8_BLOCK       (quantization block length, default 256)
   HOROVOD_COMPRESSION_MIN_SIZE (elements; buckets below it skip
                             quantization, default 1024)
+  HOROVOD_BUCKET_MB        (backward-pass bucket overlap: gradient pytrees
+                            partition into buckets of this many MiB in
+                            reverse-production order, each enqueued as its
+                            own non-fusable collective so early buckets hit
+                            the wire while the tail still computes;
+                            0/unset = per-leaf path unchanged,
+                            docs/overlap.md)
+  HOROVOD_PACKED_WIRE      (1 = single-buffer int8 wire: payload and scale
+                            bytes packed per block into ONE all_to_all +
+                            ONE all_gather via the fused quantize+pack
+                            kernel; default 0 keeps the two-collective
+                            PR-1 wire, docs/overlap.md)
 
 Autotune and compression: quantized allreduces are scored by the bytes the
 wire actually moved (int8 payload + f32 scales, Executor.last_wire_bytes),
@@ -563,6 +575,33 @@ class Engine:
         """PerformOperation analogue (`operations.cc:227-304`)."""
         with self._lock:
             entries = [self._pending.pop(ch) for _, ch in pairs]
+        if (len(resp.tensor_names) > 1
+                and resp.response_type != ResponseType.ERROR
+                and any(not e.fusable for e in entries)):
+            # bucket-boundary backstop: control planes whose wire/ABI
+            # predates the fusable flag (native tick frames, coordinator
+            # Requests) can hand back a response that merged client-built
+            # buckets. Split it back into per-tensor sub-responses executed
+            # in negotiated tensor_names order — deterministic and
+            # identical on every rank, because bucket names and flags are
+            # produced by the same client code everywhere.
+            import dataclasses
+            by_name: Dict[str, List[TensorTableEntry]] = {}
+            for e in entries:
+                by_name.setdefault(e.tensor_name, []).append(e)
+            for idx, name in enumerate(resp.tensor_names):
+                sub = dataclasses.replace(
+                    resp, tensor_names=[name],
+                    tensor_sizes=([resp.tensor_sizes[idx]]
+                                  if idx < len(resp.tensor_sizes) else []),
+                    tensor_shapes=([resp.tensor_shapes[idx]]
+                                   if idx < len(resp.tensor_shapes) else []))
+                self._perform_resp(sub, by_name.get(name, []))
+            return
+        self._perform_resp(resp, entries)
+
+    def _perform_resp(self, resp: Response,
+                      entries: List[TensorTableEntry]) -> None:
         ebr: Dict[int, List[TensorTableEntry]] = {}
         for e in entries:
             ebr.setdefault(e.rank, []).append(e)

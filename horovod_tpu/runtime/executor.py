@@ -302,11 +302,19 @@ class Executor:
         Without a two-level topology it degrades to the flat int8 program.
         """
         from ..ops import compression as comp
+        from ..ops import pallas_kernels as pk
 
         block = comp.block_size()
+        # HOROVOD_PACKED_WIRE: single-buffer wire rows [int8 payload |
+        # 4 scale bytes] assembled by the fused quantize+pack kernel — ONE
+        # all_to_all and ONE all_gather instead of two of each, and no
+        # separate scale-quantize pass. Bit-identical values (same
+        # quantize formula, same f32 sum order); same wire_bytes total.
+        packed = os.environ.get(
+            "HOROVOD_PACKED_WIRE", "").lower() in ("1", "on", "true")
         hier = wire == "int8-dcn" and self._mesh2 is not None
         key = ("allreduce_q", "int8-dcn" if hier else "int8", n, length,
-               dtype, average, prescale, postscale, block)
+               dtype, average, prescale, postscale, block, packed)
         fn = self._fn_cache.get(key)
         if fn is None:
             jax = self._jax
@@ -326,6 +334,23 @@ class Executor:
                 padded = chunk * m
                 if padded != ln:
                     x = jnp.pad(x, (0, padded - ln))
+                if packed:
+                    nb = chunk // block
+                    prow = block + pk.PACK_SCALE_BYTES
+                    p = pk.int8_quantize_pack(
+                        x.reshape(padded // block, block))
+                    wt = lax.all_to_all(p.reshape(m, nb * prow), axis, 0, 0,
+                                        tiled=True)
+                    q2, s2 = pk.int8_unpack(wt.reshape(m * nb, prow))
+                    d = (q2.astype(jnp.float32).reshape(m, nb, block)
+                         * s2.reshape(m, nb, 1))
+                    red = jnp.sum(d.reshape(m, chunk), axis=0)
+                    rp = pk.int8_quantize_pack(red.reshape(nb, block))
+                    gp = lax.all_gather(rp.reshape(nb * prow), axis,
+                                        tiled=True)
+                    rq, rs = pk.int8_unpack(gp.reshape(m * nb, prow))
+                    out = (rq.astype(jnp.float32) * rs).reshape(padded)
+                    return out[:ln] if padded != ln else out
                 q, s = comp.quantize_blocks(x, block)
                 qt = lax.all_to_all(q.reshape(m, chunk), axis, 0, 0,
                                     tiled=True)

@@ -144,3 +144,12 @@ class TensorTableEntry:
     splits: Optional[Any] = None
     # requested wire compression ("" = none; see Response.compression)
     compression: str = ""
+    # False = this entry is already a client-built bucket (backward-pass
+    # bucket overlap, optim/distributed.py): the controller must not merge
+    # it with other tensors — re-fusing hand-made buckets would serialize
+    # the wire behind the last bucket and erase the overlap. The flag is
+    # rank-local but set deterministically by the same client code on every
+    # rank, so enforcement decisions resolve identically everywhere; planes
+    # whose wire/ABI cannot carry it (native tick frames, coordinator
+    # Requests) are backstopped by the engine's response split.
+    fusable: bool = True

@@ -19,7 +19,7 @@ from .messages import RequestType, Response, ResponseType, TensorTableEntry
 class _Meta:
     __slots__ = ("name", "rank", "type", "dtype", "shape", "root_rank",
                  "average", "prescale", "postscale", "handle", "enqueue_t",
-                 "nbytes", "splits", "compression")
+                 "nbytes", "splits", "compression", "fusable")
 
     def __init__(self, e: TensorTableEntry, handle: int):
         self.name = e.tensor_name
@@ -37,6 +37,7 @@ class _Meta:
         self.splits = None if e.splits is None else tuple(int(s)
                                                           for s in e.splits)
         self.compression = e.compression
+        self.fusable = e.fusable
 
 
 class PyController:
@@ -333,14 +334,18 @@ class PyController:
                 used[i] = True
                 bucket = [i]
                 total = e0.nbytes
-                fusable = self._fusion_enabled and e0.type in (
+                # client-built buckets (fusable=False, backward-pass bucket
+                # overlap) never merge: each stays its own response so its
+                # wire can start while later buckets are still enqueueing
+                fusable = self._fusion_enabled and e0.fusable and e0.type in (
                     RequestType.ALLREDUCE, RequestType.ADASUM,
                     RequestType.ALLGATHER)
                 if fusable:
                     for j in range(i + 1, len(singles)):
                         if used[j]:
                             continue
-                        if (self._sig(singles[j][1]) == self._sig(e0)
+                        if (singles[j][1].fusable
+                                and self._sig(singles[j][1]) == self._sig(e0)
                                 and total + singles[j][1].nbytes
                                 <= self._threshold):
                             used[j] = True

@@ -66,6 +66,41 @@ def union_us(intervals):
     return total
 
 
+def _merge(intervals):
+    """Sorted disjoint [a, b) list from possibly-overlapping (ts, dur)."""
+    ivs = sorted((ts, ts + max(0, dur)) for ts, dur in intervals)
+    out = []
+    for a, b in ivs:
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1][1] = b
+        else:
+            out.append([a, b])
+    return out
+
+
+def intersect_us(a_intervals, b_intervals):
+    """Microseconds covered by BOTH span sets ((ts, dur) lists).
+
+    Used for overlap accounting: wire time intersected with WAIT time is
+    wire the step sat blocked on; the remainder of wire time ran while
+    the rank was doing something else — communication hidden under
+    compute (docs/overlap.md).
+    """
+    a_m, b_m = _merge(a_intervals), _merge(b_intervals)
+    total = i = j = 0
+    while i < len(a_m) and j < len(b_m):
+        lo = max(a_m[i][0], b_m[j][0])
+        hi = min(a_m[i][1], b_m[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a_m[i][1] <= b_m[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
 def analyze(path, top=10):
     """Build the hvdprof report dict from a merged trace file."""
     events = load_events(path)
@@ -101,7 +136,7 @@ def analyze(path, top=10):
             occ[key] += 1
 
     ranks = {}
-    tot_step = tot_wait = 0
+    tot_step = tot_wait = tot_wire = tot_hidden = 0
     for rank in sorted(by_rank):
         groups = by_rank[rank]
         step_us = sum(d for _, d in groups.get(EV_STEP, []))
@@ -110,6 +145,11 @@ def analyze(path, top=10):
         deq_us = union_us(groups.get(EV_DEQUEUE, []))
         wait_us = union_us(groups.get(EV_WAIT, []))
         compute_us = max(0, step_us - wait_us)
+        # wire time NOT under a WAIT span ran while this rank was busy
+        # elsewhere (launching later buckets, backward compute) — hidden
+        # communication; the bucket-overlap win this % makes visible
+        hidden_us = wire_us - intersect_us(groups.get(EV_WIRE, []),
+                                           groups.get(EV_WAIT, []))
         ranks[rank] = {
             "steps": len(groups.get(EV_STEP, [])),
             "step_us": step_us,
@@ -120,9 +160,13 @@ def analyze(path, top=10):
             "wait_us": wait_us,
             "exposed_comm_pct":
                 (100.0 * wait_us / step_us) if step_us else 0.0,
+            "overlap_pct":
+                (100.0 * hidden_us / wire_us) if wire_us else 0.0,
         }
         tot_step += step_us
         tot_wait += wait_us
+        tot_wire += wire_us
+        tot_hidden += hidden_us
 
     # Straggler skew: for every (tensor, occurrence) group seen on >1 rank,
     # the spread of negotiation-start times is how long the fastest rank
@@ -159,8 +203,12 @@ def analyze(path, top=10):
         "overall": {
             "exposed_comm_pct":
                 (100.0 * tot_wait / tot_step) if tot_step else 0.0,
+            "overlap_pct":
+                (100.0 * tot_hidden / tot_wire) if tot_wire else 0.0,
             "step_s": tot_step / 1e6,
             "wait_s": tot_wait / 1e6,
+            "wire_s": tot_wire / 1e6,
+            "hidden_wire_s": tot_hidden / 1e6,
             "max_skew_us": max_skew,
         },
         "skew": skew,
@@ -191,21 +239,26 @@ def format_report(report, path=""):
                  % (c["events"], c["x_events"], c["wire_spans"]))
     lines.append("")
     lines.append("per-rank step breakdown")
-    lines.append("  %-4s %5s %12s %12s %12s %12s %12s %8s"
+    lines.append("  %-4s %5s %12s %12s %12s %12s %12s %8s %8s"
                  % ("rank", "steps", "step", "compute", "negotiate",
-                    "wire", "wait", "exposed"))
+                    "wire", "wait", "exposed", "overlap"))
     for rank in sorted(report["ranks"]):
         r = report["ranks"][rank]
-        lines.append("  %-4d %5d %12s %12s %12s %12s %12s %7.1f%%"
+        lines.append("  %-4d %5d %12s %12s %12s %12s %12s %7.1f%% %7.1f%%"
                      % (rank, r["steps"], _fmt_us(r["step_us"]),
                         _fmt_us(r["compute_us"]), _fmt_us(r["negotiate_us"]),
                         _fmt_us(r["wire_us"]), _fmt_us(r["wait_us"]),
-                        r["exposed_comm_pct"]))
+                        r["exposed_comm_pct"], r.get("overlap_pct", 0.0)))
     o = report["overall"]
     lines.append("")
     lines.append("exposed communication: %.1f%% of step time (%s wait / %s "
                  "step)" % (o["exposed_comm_pct"], _fmt_us(o["wait_s"] * 1e6),
                             _fmt_us(o["step_s"] * 1e6)))
+    if "overlap_pct" in o:
+        lines.append("overlap: %.1f%% of wire time hidden under compute "
+                     "(%s hidden / %s wire)"
+                     % (o["overlap_pct"], _fmt_us(o["hidden_wire_s"] * 1e6),
+                        _fmt_us(o["wire_s"] * 1e6)))
     if report["skew"]:
         lines.append("")
         lines.append("per-rank straggler skew (lag behind fastest rank at "

@@ -1,0 +1,358 @@
+"""Backward-pass bucket overlap + overlap instrumentation (docs/overlap.md).
+
+Unit layer: the ``HOROVOD_BUCKET_MB`` knob parse, the reverse-order bucket
+partitioner, the controller's refusal to merge ``fusable=False`` entries,
+the engine's response-split backstop for control planes whose wire cannot
+carry the flag, and the analyzer's wire/wait interval intersection behind
+the hvdprof "overlap %" line. Acceptance: with the knob set, a local
+cluster run returns gradients BIT-identical to the per-leaf path (dense,
+sparse, scalar and mixed-dtype leaves; Sum and Average); with it unset,
+the bucketed code path is provably never entered (zero-overhead default)
+and Adasum ignores the knob entirely. The packed int8 wire
+(``HOROVOD_PACKED_WIRE``) is covered here too: exact value equality with
+the unpacked program and a distinct compiled-program cache key.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import basics, testing
+from horovod_tpu.optim import distributed as dist
+from horovod_tpu.ops import sparse as sparse_ops
+from horovod_tpu.runtime import engine as engine_mod
+from horovod_tpu.runtime import messages, pycontroller
+from horovod_tpu.tracing import analyzer
+
+
+# ------------------------------------------------------------- knob parse
+
+def test_bucket_bytes_parse(monkeypatch):
+    monkeypatch.delenv("HOROVOD_BUCKET_MB", raising=False)
+    assert dist._bucket_bytes() == 0
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "0")
+    assert dist._bucket_bytes() == 0
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "4")
+    assert dist._bucket_bytes() == 4 * 2 ** 20
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "0.5")
+    assert dist._bucket_bytes() == 2 ** 19
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "lots")
+    with pytest.raises(ValueError, match="HOROVOD_BUCKET_MB"):
+        dist._bucket_bytes()
+
+
+# ------------------------------------------------------------ partitioner
+
+def test_partition_buckets_reverse_order():
+    # four 4-byte leaves, 8-byte budget: last-produced leaves bucket first
+    assert dist.partition_buckets([4, 4, 4, 4], ["f"] * 4, 8) \
+        == [[3, 2], [1, 0]]
+
+
+def test_partition_buckets_dtype_boundary():
+    # a dtype change closes the bucket even with budget to spare
+    assert dist.partition_buckets([4, 4, 4], ["f", "f", "i"], 100) \
+        == [[2], [1, 0]]
+
+
+def test_partition_buckets_oversized_leaf_rides_alone():
+    assert dist.partition_buckets([4, 1000, 4], ["f"] * 3, 8) \
+        == [[2], [1], [0]]
+
+
+def test_partition_buckets_empty():
+    assert dist.partition_buckets([], [], 8) == []
+
+
+# ------------------------------------------- controller: fusable=False
+
+def _ctrl(world=1):
+    return pycontroller.PyController(
+        world=world, fusion_threshold=64 * 2 ** 20, stall_warning_s=60.0,
+        stall_shutdown_s=0.0, cache_capacity=0, fusion_enabled=True,
+        timeline_path=None, autotune=False, cycle_time_ms=1.0)
+
+
+def _entry(name, rank=0, fusable=True):
+    return messages.TensorTableEntry(
+        tensor_name=name, rank=rank,
+        request_type=messages.RequestType.ALLREDUCE,
+        array=np.zeros(8, np.float32), fusable=fusable)
+
+
+def test_controller_never_merges_nonfusable_entries():
+    c = _ctrl()
+    for name, fusable in (("a", True), ("b", True),
+                          ("g.bucket.0", False), ("g.bucket.1", False)):
+        assert c.submit(_entry(name, fusable=fusable)) >= 0
+    responses, handle_pairs, *_ = c.tick()
+    names = [list(r.tensor_names) for r in responses]
+    # a+b fuse into one response; each client bucket stays its own
+    assert ["a", "b"] in names
+    assert ["g.bucket.0"] in names
+    assert ["g.bucket.1"] in names
+    assert len(responses) == 3
+
+
+def test_controller_nonfusable_not_absorbed_as_merge_candidate():
+    # a fusable seed must not pull a non-fusable entry into its bucket
+    c = _ctrl()
+    assert c.submit(_entry("a", fusable=True)) >= 0
+    assert c.submit(_entry("g.bucket.0", fusable=False)) >= 0
+    assert c.submit(_entry("z", fusable=True)) >= 0
+    responses, *_ = c.tick()
+    names = sorted(tuple(r.tensor_names) for r in responses)
+    assert names == [("a", "z"), ("g.bucket.0",)]
+
+
+# --------------------------------------------- engine: split backstop
+
+def _stub_engine(pending):
+    eng = object.__new__(engine_mod.Engine)
+    eng._lock = threading.Lock()
+    eng._pending = dict(pending)
+    return eng
+
+
+def test_engine_splits_fused_response_over_nonfusable(monkeypatch):
+    """A control plane that merged client buckets anyway (native tick
+    frames, coordinator Requests — their wire predates the flag) is
+    backstopped: the engine splits the response back per tensor."""
+    calls = []
+    monkeypatch.setattr(
+        engine_mod.Engine, "_perform_resp",
+        lambda self, resp, entries: calls.append(
+            (list(resp.tensor_names), [e.tensor_name for e in entries])))
+    eng = _stub_engine({
+        1: _entry("g.bucket.0", fusable=False),
+        2: _entry("g.bucket.1", fusable=False),
+    })
+    resp = messages.Response(messages.ResponseType.ALLREDUCE,
+                             ["g.bucket.0", "g.bucket.1"])
+    eng._perform(resp, [(0, 1), (0, 2)])
+    assert calls == [(["g.bucket.0"], ["g.bucket.0"]),
+                     (["g.bucket.1"], ["g.bucket.1"])]
+    assert eng._pending == {}
+
+
+def test_engine_keeps_fused_response_when_all_fusable(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        engine_mod.Engine, "_perform_resp",
+        lambda self, resp, entries: calls.append(
+            (list(resp.tensor_names), sorted(e.tensor_name
+                                             for e in entries))))
+    eng = _stub_engine({1: _entry("a"), 2: _entry("b")})
+    resp = messages.Response(messages.ResponseType.ALLREDUCE, ["a", "b"])
+    eng._perform(resp, [(0, 1), (0, 2)])
+    assert calls == [(["a", "b"], ["a", "b"])]
+
+
+# ----------------------------------------- cluster: bit-identical values
+
+def _grads(rank):
+    rng = np.random.RandomState(100 + rank)
+    return {
+        "head": rng.randn(300, 7).astype(np.float32),
+        "bias": rng.randn(17).astype(np.float32),
+        "nest": {
+            "embed": rng.randn(1000).astype(np.float32),
+            "temp": np.float32(rank + 1.5),
+            "steps": np.asarray(rng.randint(0, 10, 33), np.int32),
+        },
+    }
+
+
+def _reduce(op, np_=4):
+    def worker():
+        out = dist.allreduce_gradients(_grads(hvd.rank()), op=op)
+        return jax.tree_util.tree_map(np.asarray, out)
+    return testing.run_cluster(worker, np=np_)
+
+
+@pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+def test_bucketed_bit_identical_to_per_leaf(op, monkeypatch):
+    monkeypatch.delenv("HOROVOD_BUCKET_MB", raising=False)
+    base = _reduce(op)
+    # ~2 KiB budget over ~5 KiB of f32 + an int32 leaf: several buckets,
+    # a dtype boundary, and a scalar riding in a concat
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "0.002")
+    bucketed = _reduce(op)
+    hvd.shutdown()
+    for b0, b1 in zip(base, bucketed):
+        l0 = jax.tree_util.tree_leaves(b0)
+        l1 = jax.tree_util.tree_leaves(b1)
+        assert len(l0) == len(l1)
+        for a, b in zip(l0, l1):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_bucketed_sparse_leaves_match_per_leaf(monkeypatch):
+    def worker():
+        rng = np.random.RandomState(7 + hvd.rank())
+        grads = {
+            "dense": rng.randn(512).astype(np.float32),
+            "emb": sparse_ops.IndexedSlices(
+                values=rng.randn(4, 8).astype(np.float32),
+                indices=np.asarray([0, 3, 3, 9 + hvd.rank()]),
+                dense_shape=(16, 8)),
+        }
+        out = dist.allreduce_gradients(grads, op=hvd.Sum)
+        return jax.tree_util.tree_map(
+            np.asarray, sparse_ops.densify_tree(out))
+
+    monkeypatch.delenv("HOROVOD_BUCKET_MB", raising=False)
+    base = testing.run_cluster(worker, np=2)
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "0.001")
+    bucketed = testing.run_cluster(worker, np=2)
+    hvd.shutdown()
+    for b0, b1 in zip(base, bucketed):
+        np.testing.assert_array_equal(b0["dense"], b1["dense"])
+        np.testing.assert_array_equal(b0["emb"], b1["emb"])
+
+
+def test_zero_overhead_default(monkeypatch):
+    """Knob unset → the bucketed helper is provably never entered."""
+    monkeypatch.delenv("HOROVOD_BUCKET_MB", raising=False)
+
+    def boom(*a, **k):
+        raise AssertionError("bucketed path entered with knob unset")
+
+    monkeypatch.setattr(dist, "_allreduce_gradients_bucketed", boom)
+    _reduce(hvd.Sum, np_=2)
+    hvd.shutdown()
+
+
+def test_adasum_ignores_bucket_knob(monkeypatch):
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "4")
+
+    def boom(*a, **k):
+        raise AssertionError("Adasum must keep the per-leaf path")
+
+    monkeypatch.setattr(dist, "_allreduce_gradients_bucketed", boom)
+
+    def worker():
+        g = {"w": np.random.RandomState(hvd.rank()).randn(64)
+             .astype(np.float32)}
+        return np.asarray(dist.allreduce_gradients(
+            g, op=hvd.Adasum, compression=hvd.Compression.none)["w"])
+
+    outs = testing.run_cluster(worker, np=2)
+    hvd.shutdown()
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_bucket_names_on_the_wire(monkeypatch):
+    """The engine negotiates `<prefix>.bucket.<i>` tensors — several of
+    them — instead of per-leaf names, and each compiles its own allreduce
+    program (the controller kept them separate)."""
+    monkeypatch.setenv("HOROVOD_BUCKET_MB", "0.002")
+
+    def worker():
+        dist.allreduce_gradients(_grads(hvd.rank()), op=hvd.Sum,
+                                 prefix="ow")
+        ex = basics._engine()._executor
+        lengths = sorted(k[2] for k in ex._fn_cache
+                         if k[0] == "allreduce")
+        return lengths
+
+    lengths = testing.run_cluster(worker, np=2)[0]
+    hvd.shutdown()
+    # 2117 f32 elements in ~512-element buckets + a separate int32 bucket:
+    # multiple distinct programs, none covering the whole tree at once
+    assert len(lengths) >= 3
+    assert max(lengths) < 2117
+
+
+# --------------------------------------------- analyzer: overlap %
+
+def test_intersect_us():
+    assert analyzer.intersect_us([], []) == 0
+    assert analyzer.intersect_us([(0, 10)], []) == 0
+    # [0,10) + [20,30) against [5,25): 5 + 5
+    assert analyzer.intersect_us([(0, 10), (20, 10)], [(5, 20)]) == 10
+    # overlapping input intervals are merged before intersecting
+    assert analyzer.intersect_us([(0, 10), (5, 10)], [(0, 100)]) == 15
+
+
+def _span(name, ts, dur, pid=0, tensor=None):
+    args = {} if tensor is None else {"tensor": tensor}
+    return {"ph": "X", "pid": pid, "tid": 0, "name": name, "ts": ts,
+            "dur": dur, "args": args}
+
+
+def test_analyzer_overlap_pct(tmp_path):
+    # wire [100,500) (400us), wait [300,600): 200us of wire under wait →
+    # 200us hidden → 50% overlap
+    events = [
+        _span("STEP", 0, 1000),
+        _span("WIRE", 100, 400, tensor="g.bucket.0"),
+        _span("WAIT", 300, 300),
+    ]
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    rep = analyzer.analyze(str(path))
+    assert rep["ranks"][0]["overlap_pct"] == pytest.approx(50.0)
+    assert rep["overall"]["overlap_pct"] == pytest.approx(50.0)
+    assert rep["overall"]["wire_s"] == pytest.approx(400 / 1e6)
+    assert rep["overall"]["hidden_wire_s"] == pytest.approx(200 / 1e6)
+    text = analyzer.format_report(rep, str(path))
+    assert "overlap" in text
+
+
+def test_analyzer_overlap_pct_fully_exposed(tmp_path):
+    # wire entirely inside a wait span: nothing hidden
+    events = [
+        _span("STEP", 0, 1000),
+        _span("WIRE", 200, 100, tensor="t"),
+        _span("WAIT", 100, 400),
+    ]
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    rep = analyzer.analyze(str(path))
+    assert rep["ranks"][0]["overlap_pct"] == pytest.approx(0.0)
+
+
+def test_analyzer_overlap_pct_no_wire(tmp_path):
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": [_span("STEP", 0, 100)]}))
+    rep = analyzer.analyze(str(path))
+    assert rep["ranks"][0]["overlap_pct"] == 0.0
+    assert rep["overall"]["overlap_pct"] == 0.0
+
+
+# --------------------------------------------- packed int8 wire
+
+def _int8_allreduce(n=5000, seed=40):
+    def worker():
+        x = np.random.RandomState(seed + hvd.rank()).randn(n) \
+            .astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, name="pw", op=hvd.Sum,
+                                       compression=hvd.Compression.int8))
+        ex = basics._engine()._executor
+        keys = [k for k in ex._fn_cache if k[0] == "allreduce_q"]
+        return out, keys
+    return testing.run_cluster(worker, np=4)
+
+
+def test_packed_wire_bit_identical_and_own_program(monkeypatch):
+    monkeypatch.delenv("HOROVOD_PACKED_WIRE", raising=False)
+    base = _int8_allreduce()
+    monkeypatch.setenv("HOROVOD_PACKED_WIRE", "1")
+    packed = _int8_allreduce()
+    hvd.shutdown()
+    for (out0, _), (out1, _) in zip(base, packed):
+        # same quantize formula, same f32 sum order — exactly equal
+        np.testing.assert_array_equal(out0, out1)
+    # the flag is part of the cache key: two distinct compiled programs
+    keys = packed[0][1]
+    assert len(keys) == 2
+    flags = sorted(k[-1] for k in keys)
+    assert flags == [False, True]

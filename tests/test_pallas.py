@@ -720,3 +720,146 @@ def test_flash_fwd_oneshot_vs_step_path(causal, monkeypatch):
     for a, b in zip(g_once, g_step):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------- fused quantize + pack (wire)
+def test_int8_quantize_pack_matches_unfused_pair():
+    """Packed rows carry exactly the payload + scales of the unfused
+    two-buffer kernel: unpacking reproduces int8_quantize_2d bit-for-bit."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(16, 256).astype(np.float32)
+    x[0, :] = 0.0  # all-zero block exercises the scale>0 guard
+    packed = pk.int8_quantize_pack_2d(jnp.asarray(x))
+    assert packed.shape == (16, 256 + pk.PACK_SCALE_BYTES)
+    assert packed.dtype == jnp.int8
+    q, s = pk.int8_quantize_2d(jnp.asarray(x))
+    uq, us = pk.int8_unpack(packed)
+    np.testing.assert_array_equal(np.asarray(uq), np.asarray(q))
+    np.testing.assert_array_equal(np.asarray(us), np.asarray(s))
+
+
+def test_int8_quantize_pack_kernel_vs_ref_bits():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(32, 128).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(pk.int8_quantize_pack_2d(x)),
+        np.asarray(pk.int8_quantize_pack_ref(x)))
+
+
+def test_int8_quantize_pack_fallback_non_lane_aligned():
+    """Shapes the kernel can't tile (rows=5, block=100) dispatch to the jnp
+    reference — same bits, and the dequantized roundtrip stays within the
+    per-row quantization bound."""
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(5, 100).astype(np.float32))
+    assert not pk.int8_supported(5, 100)
+    packed = pk.int8_quantize_pack(x)
+    np.testing.assert_array_equal(
+        np.asarray(packed), np.asarray(pk.int8_quantize_pack_ref(x)))
+    q, s = pk.int8_unpack(packed)
+    deq = np.asarray(q, np.float32) * np.asarray(s)
+    bound = np.max(np.abs(np.asarray(x)), axis=1, keepdims=True) / 127 * 0.51
+    assert np.all(np.abs(deq - np.asarray(x)) <= bound + 1e-7)
+
+
+def test_int8_quantize_pack_gating(monkeypatch):
+    x = jnp.asarray(np.random.RandomState(6).randn(16, 128)
+                    .astype(np.float32))
+    ref = np.asarray(pk.int8_quantize_pack_ref(x))
+    monkeypatch.setenv("HVD_PALLAS", "0")
+    np.testing.assert_array_equal(np.asarray(pk.int8_quantize_pack(x)), ref)
+    monkeypatch.setenv("HVD_PALLAS", "interpret")
+    np.testing.assert_array_equal(np.asarray(pk.int8_quantize_pack(x)), ref)
+
+
+# ------------------------------------------- fused matmul + reduce-scatter
+def test_matmul_2d_matches_jnp():
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(64, 256).astype(np.float32))
+    w = jnp.asarray(rng.randn(256, 128).astype(np.float32))
+    assert pk.matmul_tiles(64, 256, 128) is not None
+    np.testing.assert_allclose(np.asarray(pk.matmul_2d(x, w)),
+                               np.asarray(x @ w), rtol=1e-5, atol=1e-4)
+
+
+def test_matmul_tiles_gating(monkeypatch):
+    assert pk.matmul_tiles(64, 256, 128) is not None
+    assert pk.matmul_tiles(64, 250, 128) is None   # k not lane-aligned
+    assert pk.matmul_tiles(64, 256, 100) is None   # n not lane-aligned
+    assert pk.matmul_tiles(5, 256, 128) is None    # m has no block
+    monkeypatch.setenv("HVD_PALLAS", "0")
+    assert pk.matmul_tiles(64, 256, 128) is None
+
+
+def _ring_mm_run(fn, x, w, m):
+    """shard_map ``fn(x_shard, w_shard)`` over the hvd mesh axis; x/w are
+    [m, ...] with one leading slice per rank."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()
+    gx = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("hvd")))
+    gw = jax.device_put(jnp.asarray(w), NamedSharding(mesh, P("hvd")))
+    # check_vma=False pins the ring/kernel path (vma checking would
+    # dispatch the fallback, same as spmd.adasum above)
+    sm = jax.shard_map(lambda a, b: fn(a[0], b[0], "hvd")[None], mesh=mesh,
+                       in_specs=P("hvd"), out_specs=P("hvd"),
+                       check_vma=False)
+    return np.asarray(jax.jit(sm)(gx, gw))
+
+
+def test_matmul_reduce_scatter_matches_reference():
+    """The compute/permute ring == psum_scatter(x @ w) up to f32 addition
+    order, and both equal the dense cross-rank sum."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    m = hvd.num_replicas()
+    rows, kl, n = 8 * m, 128, 128
+    rng = np.random.RandomState(8)
+    x = rng.randn(m, rows, kl).astype(np.float32)
+    w = rng.randn(m, kl, n).astype(np.float32)
+
+    out = _ring_mm_run(pk.matmul_reduce_scatter, x, w, m)
+    ref = _ring_mm_run(pk.matmul_reduce_scatter_reference, x, w, m)
+    assert out.shape == ref.shape == (m, rows // m, n)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
+    dense = np.sum([x[i] @ w[i] for i in range(m)], axis=0)
+    np.testing.assert_allclose(out.reshape(rows, n), dense,
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_matmul_reduce_scatter_non_aligned_chunks():
+    """Chunk shapes the MXU kernel can't tile (n=96 not lane-aligned) keep
+    the ring but ride jnp.dot partials — same contraction."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    m = hvd.num_replicas()
+    rows, kl, n = 2 * m, 64, 96
+    assert pk.matmul_tiles(rows // m, kl, n) is None
+    rng = np.random.RandomState(9)
+    x = rng.randn(m, rows, kl).astype(np.float32)
+    w = rng.randn(m, kl, n).astype(np.float32)
+    out = _ring_mm_run(pk.matmul_reduce_scatter, x, w, m)
+    dense = np.sum([x[i] @ w[i] for i in range(m)], axis=0)
+    np.testing.assert_allclose(out.reshape(rows, n), dense,
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_matmul_reduce_scatter_fallback_when_off(monkeypatch):
+    """HVD_PALLAS=0 routes straight to the unfused reference (bitwise —
+    it IS the reference call)."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    m = hvd.num_replicas()
+    rng = np.random.RandomState(10)
+    x = rng.randn(m, 4 * m, 64).astype(np.float32)
+    w = rng.randn(m, 64, 128).astype(np.float32)
+    ref = _ring_mm_run(pk.matmul_reduce_scatter_reference, x, w, m)
+    monkeypatch.setenv("HVD_PALLAS", "0")
+    out = _ring_mm_run(pk.matmul_reduce_scatter, x, w, m)
+    np.testing.assert_array_equal(out, ref)
