@@ -1,0 +1,216 @@
+"""Control-plane negotiation benchmark: flat vs hierarchical coordination.
+
+Drives the REAL ``CoordState`` barrier with simulated ranks and measures
+negotiation rounds per second and p99 round latency as the rank count
+grows. ``flat`` mode models the pre-hierarchy control plane: one
+``exchange()`` call (= one control frame at rank 0) per rank per round.
+``hier`` mode models per-host sub-coordinators: one ``exchange_batch()``
+call (= ONE frame) per host per round, each carrying that host's ranks.
+
+The interesting output is the scaling curve — flat does O(ranks) frame
+work and O(ranks) thread wakeups under the coordinator lock per round,
+hierarchical does O(hosts). The ISSUE acceptance bar is >= 5x rounds/s
+for hier over flat at 1024 simulated ranks (64 ranks/host).
+
+Usage::
+
+    python benchmarks/coord_bench.py --ranks 64,256,1024 --mode both
+    python benchmarks/coord_bench.py --history perf.jsonl --check-regression
+
+With ``--history`` the headline metric (hier rounds/s at the largest rank
+count) is appended to the JSONL perf history; ``--check-regression`` exits
+3 when it falls below the recorded trajectory (benchmarks/history.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.runtime import wire  # noqa: E402
+from horovod_tpu.runtime.coordinator import CoordState  # noqa: E402
+
+
+def _make_state(world):
+    return CoordState(world, 0, cache_capacity=4096,
+                      stall_warning_s=600.0, stall_shutdown_s=0.0)
+
+
+def _payload():
+    return wire.encode_request_list(
+        0, [], [wire.ReqMeta("bench", 0, "float32", (1024,))], epoch=-1)
+
+
+def bench_mode(mode, ranks, ranks_per_host, rounds, warmup):
+    """One (mode, ranks) cell: persistent worker threads drive ``rounds``
+    negotiation rounds through a fresh CoordState; returns rounds/s, p99
+    round latency, and the frames-per-round the coordinator observed."""
+    if mode == "hier":
+        hosts = max(1, ranks // ranks_per_host)
+        units = hosts
+    else:
+        units = ranks
+    st = _make_state(ranks)
+    payload = _payload()
+    total = warmup + rounds
+    start = threading.Barrier(units + 1)
+    done = threading.Barrier(units + 1)
+    errors = []
+
+    def flat_worker(r):
+        try:
+            for seq in range(total):
+                start.wait()
+                st.exchange(r, seq, payload)
+                done.wait()
+        except Exception as exc:  # pragma: no cover - surfaced in main
+            errors.append(exc)
+            start.abort()
+            done.abort()
+
+    def host_worker(h):
+        lo = h * ranks_per_host
+        hi = min(lo + ranks_per_host, ranks)
+        try:
+            for seq in range(total):
+                start.wait()
+                st.exchange_batch(
+                    [(r, seq, payload) for r in range(lo, hi)])
+                done.wait()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+            start.abort()
+            done.abort()
+
+    target = host_worker if mode == "hier" else flat_worker
+    threads = [threading.Thread(target=target, args=(u,), daemon=True)
+               for u in range(units)]
+    for t in threads:
+        t.start()
+
+    latencies = []
+    frames0 = None
+    for seq in range(total):
+        t0 = time.perf_counter()
+        start.wait()
+        done.wait()
+        dt = time.perf_counter() - t0
+        if seq == warmup - 1:
+            frames0 = st.frames_in
+        if seq >= warmup:
+            latencies.append(dt)
+    frames_per_round = (st.frames_in - frames0) / rounds if rounds else 0
+    for t in threads:
+        t.join(timeout=30)
+    if errors:
+        raise errors[0]
+
+    latencies.sort()
+    p99 = latencies[min(len(latencies) - 1,
+                        int(round(0.99 * (len(latencies) - 1))))]
+    wall = sum(latencies)
+    return {
+        "mode": mode,
+        "ranks": ranks,
+        "units": units,
+        "rounds": rounds,
+        "rounds_per_sec": round(rounds / wall, 2) if wall else 0.0,
+        "p99_round_ms": round(p99 * 1e3, 3),
+        "frames_per_round": round(frames_per_round, 1),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ranks", default="64,256,1024",
+                    help="comma-separated simulated rank counts")
+    ap.add_argument("--ranks-per-host", type=int, default=64,
+                    help="batch size per simulated host in hier mode")
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--mode", choices=["flat", "hier", "both"],
+                    default="both")
+    ap.add_argument("--history", default=None,
+                    help="JSONL perf-history file (benchmarks/history.py)")
+    ap.add_argument("--check-regression", action="store_true",
+                    help="exit 3 when the headline metric regresses "
+                         "against --history")
+    ap.add_argument("--regression-window", type=int, default=None)
+    ap.add_argument("--regression-tolerance", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    rank_counts = [int(r) for r in args.ranks.split(",")]
+    modes = ["flat", "hier"] if args.mode == "both" else [args.mode]
+    results = []
+    for ranks in rank_counts:
+        for mode in modes:
+            r = bench_mode(mode, ranks, args.ranks_per_host,
+                           args.rounds, args.warmup)
+            results.append(r)
+            print(json.dumps(r))
+        if args.mode == "both":
+            flat = next(r for r in results
+                        if r["ranks"] == ranks and r["mode"] == "flat")
+            hier = next(r for r in results
+                        if r["ranks"] == ranks and r["mode"] == "hier")
+            if flat["rounds_per_sec"]:
+                print(json.dumps({
+                    "metric": "coord_hier_speedup",
+                    "ranks": ranks,
+                    "value": round(hier["rounds_per_sec"]
+                                   / flat["rounds_per_sec"], 2)}))
+
+    biggest = max(rank_counts)
+    headline = next((r for r in results
+                     if r["ranks"] == biggest and r["mode"] == "hier"),
+                    results[-1])
+    result = {
+        "metric": "coord_hier_rounds_per_sec",
+        "value": headline["rounds_per_sec"],
+        "unit": "rounds/s",
+        "ranks": headline["ranks"],
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if args.history:
+        from benchmarks.history import (append_record, check_regression,
+                                        load_history)
+
+        # compare against the trajectory BEFORE appending: today's run
+        # must not be allowed to vote in its own baseline
+        if args.check_regression:
+            verdict = check_regression(
+                load_history(args.history, metric=result["metric"]),
+                result["value"],
+                **{k: v for k, v in (
+                    ("window", args.regression_window),
+                    ("tolerance", args.regression_tolerance))
+                   if v is not None})
+            print("# regression check: %s" % json.dumps(verdict),
+                  file=sys.stderr)
+            if verdict["regression"]:
+                print(f"# REGRESSION: {result['metric']} = "
+                      f"{result['value']} fell below the floor "
+                      f"{verdict['floor']} (baseline {verdict['baseline']} "
+                      f"over {verdict['samples']} runs)", file=sys.stderr)
+                rc = 3
+        append_record(args.history, {
+            "metric": result["metric"], "value": result["value"],
+            "unit": result["unit"], "ranks": result["ranks"],
+            "ranks_per_host": args.ranks_per_host,
+            "rounds": args.rounds,
+        })
+        print(f"# perf history appended to {args.history}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

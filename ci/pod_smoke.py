@@ -471,6 +471,145 @@ def check_blackbox_doctor() -> None:
           "deadlock, tensor 'bb_probe', missing rank [1]")
 
 
+def _failover_smoke_fn():
+    """3-rank elastic job with the warm standby on; rank 0 — the
+    coordinator — dies abruptly mid-training. Survivors must finish all 10
+    steps on the promoted standby and return a parameter digest."""
+    import hashlib
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import blackbox
+
+    hvd.init()
+    state = hvd.elastic.ElasticState(w=np.array([4.0], np.float32), step=0)
+
+    @hvd.elastic.run_fn
+    def train(state):
+        while state.step < 10:
+            if hvd.rank() == 0 and state.step == 4:
+                os._exit(29)  # no BYE, no cleanup: the coordinator is gone
+            g = np.float32(hvd.rank() + 1) * (np.asarray(state.w) - 1.0)
+            avg = hvd.allreduce(g, name=f"grad{state.step}",
+                                op=hvd.Average)
+            state.w = np.asarray(state.w) - np.float32(0.1) * \
+                np.asarray(avg, np.float32)
+            state.step += 1
+            state.commit()
+        return hashlib.sha256(
+            np.asarray(state.w, np.float32).tobytes()).hexdigest()
+
+    digest = train(state)
+    # the blackbox normally only speaks on abnormal exit; force the dump
+    # so hvddoctor can diagnose the failover this survivor lived through
+    blackbox.dump("failover smoke postmortem", force=True)
+    return digest
+
+
+def check_coordinator_failover() -> None:
+    """Survivable-control-plane smoke (docs/control-plane.md): SIGKILL the
+    rank-0 coordinator mid-step with HOROVOD_STANDBY_COORD on. Training
+    must resume on the promoted standby, the survivors' parameter digests
+    must be bit-identical, and ``bin/hvddoctor`` over the blackbox bundle
+    must name the coordinator failover."""
+    import pickle
+    import tempfile
+    import time
+
+    import cloudpickle
+
+    from horovod_tpu.run import rendezvous
+
+    bbdir = tempfile.mkdtemp(prefix="hvd_failover_smoke_")
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_failover_smoke_fn, (), {})))
+
+    procs = []
+    try:
+        for r in range(3):
+            env = dict(os.environ)
+            env.update({
+                "HVD_NUM_PROCS": "3",
+                "HVD_PROCESS_ID": str(r),
+                "HVD_KV_ADDR": addr,
+                "HVD_SECRET": secret,
+                "HVD_ELASTIC": "1",
+                "HOROVOD_STANDBY_COORD": "1",
+                "HOROVOD_RECONNECT_GRACE": "2",
+                "HOROVOD_BLACKBOX": "1",
+                "HOROVOD_BLACKBOX_DIR": bbdir,
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                # the smoke fn unpickles by reference to this module
+                "PYTHONPATH": os.pathsep.join(
+                    [REPO, os.path.dirname(os.path.abspath(__file__))]),
+            })
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+        deadline = time.time() + 180
+        blobs = {}
+        while time.time() < deadline and len(blobs) < 2:
+            for r in (1, 2):
+                if r not in blobs:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+            if len(blobs) < 2 and all(p.poll() is not None for p in procs):
+                time.sleep(1.0)
+                for r in (1, 2):
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+                break
+            time.sleep(0.25)
+        assert len(blobs) == 2, (
+            "survivors produced no result after the coordinator kill; "
+            f"got ranks {sorted(blobs)}, exit codes "
+            f"{[p.poll() for p in procs]}")
+        digests = {}
+        for r, blob in blobs.items():
+            ok, payload = pickle.loads(blob)
+            assert ok, f"rank {r} raised:\n{payload}"
+            digests[r] = payload
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
+
+    assert procs[0].wait(timeout=10) == 29, \
+        "rank 0 did not die with its marker code"
+    assert digests[1] == digests[2], (
+        "survivors' parameters diverged across the failover: "
+        f"{digests}")
+
+    for rank in (1, 2):
+        path = os.path.join(bbdir, f"rank_{rank}.json")
+        assert os.path.exists(path), (
+            f"no blackbox dump from survivor rank {rank}; dir has "
+            f"{sorted(os.listdir(bbdir))}")
+    hvddoctor = os.path.join(REPO, "bin", "hvddoctor")
+    d = subprocess.run([sys.executable, hvddoctor, bbdir],
+                       capture_output=True, text=True, timeout=60)
+    assert d.returncode == 0, (
+        f"hvddoctor rejected the bundle:\n{d.stderr[-2000:]}")
+    assert "coordinator failover" in d.stdout, (
+        f"hvddoctor did not diagnose the failover:\n{d.stdout[-3000:]}")
+    print("ok: coordinator failover smoke — rank 0 killed mid-step, "
+          "survivors resumed on the promoted standby with bit-identical "
+          f"parameters (sha256 {digests[1][:12]}…); hvddoctor named the "
+          "coordinator failover")
+
+
 def main():
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
@@ -482,9 +621,11 @@ def main():
     check_trace_capture()
     check_bucket_overlap()
     check_blackbox_doctor()
+    check_coordinator_failover()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
-          "+ bucket overlap + blackbox doctor valid")
+          "+ bucket overlap + blackbox doctor + coordinator failover "
+          "valid")
 
 
 if __name__ == "__main__":

@@ -34,6 +34,17 @@ python -m pytest tests/test_faultinject.py -q
 stage "chaos: data-plane integrity (grad guard, consistency audit, watchdog)"
 python -m pytest tests/test_integrity.py tests/test_stall.py -q
 
+stage "controlplane: hierarchical negotiation, coordinator failover, storms"
+python -m pytest tests/test_coord.py -q -m "not integration"
+# the control-plane integrations run on plain CPU (elastic Popen harness):
+# SIGKILL the rank-0 coordinator mid-step, and a real hierarchical job
+python -m pytest -q \
+    "tests/test_coord.py::test_coordinator_sigkill_failover_bit_identical" \
+    "tests/test_coord.py::test_hierarchical_mode_end_to_end"
+# the hierarchical path must beat flat negotiation at scale (rounds/s is
+# printed; the >=5x acceptance curve lives in docs/control-plane.md)
+python benchmarks/coord_bench.py --ranks 256 --rounds 15 --mode both
+
 stage "tracing: clock, spans, merge, hvdprof critical-path report"
 python -m pytest tests/test_tracing.py -q
 

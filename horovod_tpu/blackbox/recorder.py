@@ -38,6 +38,8 @@ K_FAULT = "fault"              # fault-injection rule fired
 K_ERROR = "error"              # exception / abnormal condition
 K_SIGNAL = "signal"            # process signal received
 K_ANOMALY = "anomaly"          # live anomaly-watch detection
+K_FAILOVER = "failover"        # coordinator failover (standby promotion or
+                               # a worker redialing the promoted standby)
 
 DEFAULT_EVENTS = 4096
 

@@ -251,12 +251,32 @@ def detect_dead_worker(bundle) -> List[dict]:
     return sigs
 
 
+def detect_coordinator_failover(bundle) -> List[dict]:
+    """A K_FAILOVER event means the warm standby promoted itself (or a
+    worker redialed the promoted standby) after rank 0's coordinator died
+    (HOROVOD_STANDBY_COORD, docs/control-plane.md)."""
+    sigs = []
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_FAILOVER:
+            continue
+        detail = ev.get("detail") or ""
+        if "promoted" not in detail and "standby" not in detail:
+            continue
+        sigs.append(make_signature(
+            "coordinator_failover", SEV_WARNING,
+            "coordinator failover: %s" % (detail or "standby promoted"),
+            rank=int(ev.get("rank") or 0), reported_by=src))
+        break  # one promotion event is the story; redials are echoes
+    return sigs
+
+
 #: every event-based detector the doctor runs, in reporting order
 DETECTORS = (
     detect_collective_deadlock,
     detect_param_desync,
     detect_nan_first,
     detect_dead_worker,
+    detect_coordinator_failover,
     detect_straggler,
     detect_reconnect_storm,
     detect_heartbeat_flap,

@@ -70,7 +70,7 @@ class Injector:
         :meth:`actions_for` by the integrity layer, which owns the
         tensors being poisoned."""
         for kind, seconds in self.actions_for(point):
-            if kind in ("delay", "hang"):
+            if kind in ("delay", "hang", "slow"):
                 time.sleep(seconds)
             elif kind == "conn_drop" and self._drop_cb is not None:
                 self._drop_cb()

@@ -22,16 +22,25 @@ A spec is a semicolon-separated list of rules, each of the form::
     - ``hang``       hold this rank's collective submission for ``arg``
       seconds at point ``collective`` — a deterministic wedge; pair with
       HOROVOD_COLLECTIVE_TIMEOUT so the watchdog fires on the peers
+    - ``die``        abrupt coordinator death at point ``coordinator``:
+      rank 0's server closes its listening socket and every worker
+      connection without a BYE, exactly what SIGKILL of rank 0 looks
+      like from the workers (drives the standby-failover pillar,
+      docs/control-plane.md)
+    - ``slow``       coordinator brownout: sleep ``arg`` MILLISECONDS
+      inside each negotiation at point ``coordinator`` (the coordinator
+      lock is held, so every rank observes the slowdown)
 * ``point`` — a named injection site. Frame-granular kinds fire inside the
   wrapped socket at point ``frame`` (one hit per sent frame); ``tick``,
   ``exchange``, ``connect`` and ``heartbeat`` are explicit hooks in
-  `runtime/coordinator.py`; ``grad`` is hit once per guarded optimizer
+  `runtime/coordinator.py`; ``coordinator`` is hit once per negotiation
+  inside rank 0's CoordState; ``grad`` is hit once per guarded optimizer
   step, ``param`` once per consistency audit, ``collective`` once per
   enqueued collective (`ops/collective_ops.py`).
-* ``arg`` — for ``delay`` and ``hang`` the sleep in seconds, with an
-  optional second arg restricting it to the Nth hit (default: every hit).
-  For every other kind the 1-based hit index at which the rule fires once
-  (default 1).
+* ``arg`` — for ``delay`` and ``hang`` the sleep in seconds, for ``slow``
+  the sleep in milliseconds, each with an optional second arg restricting
+  it to the Nth hit (default: every hit). For every other kind the
+  1-based hit index at which the rule fires once (default 1).
 * ``#ranks`` — optional comma list of ranks the rule applies to
   (default: every rank).
 
@@ -45,7 +54,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial",
-         "nan", "desync", "hang")
+         "nan", "desync", "hang", "die", "slow")
 
 # kinds applied to outgoing frames by the FaultSocket wrapper (as opposed to
 # the named fire() hooks in controller code)
@@ -53,6 +62,11 @@ FRAME_KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial")
 
 # kinds that carry a duration as their first argument
 _TIMED_KINDS = ("delay", "hang")
+
+# like _TIMED_KINDS but the argument is in milliseconds (coordinator
+# brownouts are naturally sub-second; "slow@coordinator:250" reads better
+# than a fractional-seconds form)
+_MS_KINDS = ("slow",)
 
 
 class FaultRule:
@@ -73,6 +87,8 @@ class FaultRule:
 
     def __repr__(self):
         extra = f":{self.seconds}" if self.kind in _TIMED_KINDS else ""
+        if self.kind in _MS_KINDS:
+            extra = f":{self.seconds * 1000.0:g}"
         nth = f":{self.nth}" if self.nth is not None else ""
         ranks = ("" if self.ranks is None
                  else "#" + ",".join(str(r) for r in sorted(self.ranks)))
@@ -109,10 +125,12 @@ def parse_spec(text: str) -> List[FaultRule]:
                 f"HOROVOD_FAULT_SPEC: rule {raw!r} names no point")
         args = parts[1:]
         try:
-            if kind in _TIMED_KINDS:
+            if kind in _TIMED_KINDS or kind in _MS_KINDS:
                 if not args:
                     raise ValueError
                 seconds = float(args[0])
+                if kind in _MS_KINDS:
+                    seconds /= 1000.0
                 nth = int(args[1]) if len(args) > 1 else None
             else:
                 seconds = 0.0

@@ -216,3 +216,36 @@ def blackbox_dumps():
         "hvd_blackbox_dumps_total",
         "Flight-recorder postmortem dumps written by this process on "
         "abnormal exit (HOROVOD_BLACKBOX).")
+
+
+def coord_batch_ranks():
+    return get_registry().histogram(
+        "hvd_coord_batch_ranks",
+        "Ranks carried per batched negotiation frame received by the "
+        "coordinator (hierarchical control plane, "
+        "HOROVOD_HIERARCHICAL_COORD; docs/control-plane.md).",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+
+
+def coord_failovers():
+    return get_registry().counter(
+        "hvd_coord_failovers_total",
+        "Coordinator failovers: the warm standby promoted itself after "
+        "losing its replication stream to rank 0 "
+        "(HOROVOD_STANDBY_COORD; docs/control-plane.md).")
+
+
+def epoch_coalesced_joins():
+    return get_registry().counter(
+        "hvd_epoch_coalesced_joins_total",
+        "Extra joiners folded into an already-pending membership epoch "
+        "bump by admission batching (HOROVOD_ADMISSION_BATCH_MS) — each "
+        "one is an epoch reset the job did NOT pay for.")
+
+
+def standby_journal_lag():
+    return get_registry().gauge(
+        "hvd_standby_journal_lag",
+        "Journal records queued at rank 0 but not yet shipped to the "
+        "warm-standby coordinator (0 = the standby is current; "
+        "docs/control-plane.md).", agg="max")

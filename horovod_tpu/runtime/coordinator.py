@@ -101,6 +101,24 @@ MSG_CLOCK_RESP = 12
 # rank 0, which persists it into the blackbox bundle, docs/observability.md);
 # same interleaving contract as MSG_METRICS
 MSG_BLACKBOX = 13
+# hierarchical control plane (HOROVOD_HIERARCHICAL_COORD,
+# docs/control-plane.md): a per-host sub-coordinator ships its local ranks'
+# negotiation frames as ONE batched frame per round, and rank 0 answers with
+# batched responses — possibly several per request frame, since joiner
+# admissions complete later than member barriers (entries self-identify by
+# (rank, seq), so response frames need no 1:1 pairing with request frames)
+MSG_BATCH = 14
+MSG_BATCH_RESP = 15
+# aggregated liveness beacon: every rank listed is alive; ranks that vanish
+# from a connection's beacon are treated as disconnected (the sub-coordinator
+# observed their local connection die)
+MSG_BATCH_HB = 16
+# coordinator replication stream (HOROVOD_STANDBY_COORD): the warm standby
+# identifies itself with REPL_HELLO and receives one SNAPSHOT of the
+# membership state followed by a JOURNAL record per epoch change
+MSG_REPL_HELLO = 17
+MSG_SNAPSHOT = 18
+MSG_JOURNAL = 19
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -210,6 +228,31 @@ class CoordState:
         self.pending_joins: set = set()
         self.committed: set = set()
         self.reset_reason = ""
+        # ---- storm-proof rendezvous (docs/control-plane.md): with
+        # HOROVOD_ADMISSION_BATCH_MS set, joiner admission lingers until no
+        # new joiner has arrived for that long (N simultaneous joins -> ONE
+        # epoch bump), and losses observed close together coalesce into one
+        # reset the same way. 0 (the default) keeps the historical
+        # one-event-one-epoch behavior exactly.
+        self.admission_batch_s = _env_float(
+            "HOROVOD_ADMISSION_BATCH_MS", 0.0) / 1000.0
+        self._pending_join_last_t = 0.0
+        self._pending_lost: List[Tuple[int, str]] = []
+        self._lost_first_t = 0.0
+        # ---- hierarchical control plane: control frames that reached this
+        # state machine (one per exchange() call, one per BATCH regardless
+        # of how many ranks it carries) — the O(hosts)-not-O(ranks) claim is
+        # asserted against this counter
+        self.frames_in = 0
+        # ---- standby replication: monotonic journal seq + attached shipper
+        # queues (one per standby; items are (msg_type, payload) tuples)
+        self.jseq = 0
+        self._journal_sinks: List = []
+        # optional hook run at the top of every negotiation — the
+        # coordinator server points it at the fault injector so
+        # die@coordinator / slow@coordinator fire deterministically per
+        # negotiation round
+        self.on_negotiate = None
         # host-wire data plane: (epoch, dseq) -> in-flight aggregation
         self.data: Dict[Tuple[int, int], dict] = {}
         # per-seq participant count at negotiation time (membership may have
@@ -219,6 +262,8 @@ class CoordState:
     # ---- client entry: one call per rank per tick
     def exchange(self, rank: int, seq: int, payload: bytes) -> bytes:
         with self.cv:
+            self.frames_in += 1
+            self._flush_lost_locked()
             if self.bye:
                 return self._shutdown_bytes()
             last = self.last_resp.get(rank)
@@ -232,15 +277,10 @@ class CoordState:
                 # a replay racing the original serve thread (still blocked
                 # in the barrier): wait for its result rather than entering
                 # the exchange twice
-                while True:
-                    if self.bye:
-                        return self._shutdown_bytes()
-                    last = self.last_resp.get(rank)
-                    if last is not None and last[0] == seq:
-                        return last[1]
-                    if self.inflight_seq.get(rank) != seq:
-                        break  # original died resultless; process normally
-                    self.cv.wait(timeout=0.5)
+                data = self._await_replay_locked(rank, seq)
+                if data is not None:
+                    return data
+                # original died resultless; process normally
             self.inflight_seq[rank] = seq
             try:
                 data = self._exchange_locked(rank, seq, payload)
@@ -251,8 +291,97 @@ class CoordState:
             self.last_resp[rank] = (seq, data)
             return data
 
+    def exchange_batch(self, entries):
+        """One batched frame from a per-host sub-coordinator
+        (docs/control-plane.md): deposit every entry, then collect each
+        rank's response. Returns (replies, deferred) where replies is
+        [(rank, seq, response_bytes)] and deferred is [(rank, seq,
+        payload)] for prospective joiners — their admission wait can span
+        whole commit rounds of the members in THIS batch, so the server
+        answers them from dedicated threads via the ordinary
+        :meth:`exchange` path instead of stalling the batch on them."""
+        replies: List[Tuple[int, int, bytes]] = []
+        deferred: List[Tuple[int, int, bytes]] = []
+        waits: List[Tuple[int, int, str, object, bytes]] = []
+        with self.cv:
+            self.frames_in += 1
+            instruments.coord_batch_ranks().observe(len(entries))
+            self._flush_lost_locked()
+            for rank, seq, payload in entries:
+                if self.bye:
+                    replies.append((rank, seq, self._shutdown_bytes()))
+                    continue
+                last = self.last_resp.get(rank)
+                if last is not None and last[0] == seq:
+                    replies.append((rank, seq, last[1]))
+                    continue
+                if self.elastic and rank not in self.members:
+                    deferred.append((rank, seq, payload))
+                    continue
+                if self.inflight_seq.get(rank) == seq:
+                    waits.append((rank, seq, "replay", None, payload))
+                    continue
+                self.inflight_seq[rank] = seq
+                kind, val = self._deposit_locked(rank, seq, payload)
+                if kind == "done":
+                    if self.inflight_seq.get(rank) == seq:
+                        del self.inflight_seq[rank]
+                    self.last_resp[rank] = (seq, val)
+                    replies.append((rank, seq, val))
+                    self.cv.notify_all()
+                else:
+                    waits.append((rank, seq, kind, val, payload))
+            for rank, seq, kind, val, payload in waits:
+                try:
+                    if kind == "replay":
+                        data = self._await_replay_locked(rank, seq)
+                        if data is None:
+                            # original serve thread died resultless:
+                            # process this entry normally
+                            self.inflight_seq[rank] = seq
+                            k2, v2 = self._deposit_locked(rank, seq,
+                                                          payload)
+                            data = (v2 if k2 == "done" else
+                                    self._await_locked(rank, seq, v2))
+                    else:
+                        data = self._await_locked(rank, seq, val)
+                finally:
+                    if self.inflight_seq.get(rank) == seq:
+                        del self.inflight_seq[rank]
+                    self.cv.notify_all()
+                self.last_resp[rank] = (seq, data)
+                replies.append((rank, seq, data))
+        return replies, deferred
+
+    def _await_replay_locked(self, rank: int, seq: int) -> Optional[bytes]:
+        """Wait out a replay racing the original serve thread. Returns the
+        cached response, shutdown bytes, or None if the original vanished
+        without producing a result (caller re-enters normally)."""
+        while True:
+            if self.bye:
+                return self._shutdown_bytes()
+            last = self.last_resp.get(rank)
+            if last is not None and last[0] == seq:
+                return last[1]
+            if self.inflight_seq.get(rank) != seq:
+                return None
+            self.cv.wait(timeout=0.5)
+
     def _exchange_locked(self, rank: int, seq: int, payload: bytes) -> bytes:
         # runs under self.cv (the exchange() wrapper holds it)
+        kind, val = self._deposit_locked(rank, seq, payload)
+        if kind == "done":
+            return val
+        if kind == "join":
+            return self._await_join_locked(rank)
+        return self._await_locked(rank, seq, val)
+
+    def _deposit_locked(self, rank: int, seq: int, payload: bytes):
+        """Phase 1 of an exchange: decode + elastic gatekeeping + deposit
+        into the seq barrier (negotiating if this deposit completes it).
+        Returns ("done", response_bytes) for immediately-answerable frames,
+        ("join", None) for a prospective joiner (caller must run the
+        admission wait) or ("wait", entry_epoch) after a deposit."""
         flags_cached_reqs_score = wire.decode_request_list(payload)
         score = flags_cached_reqs_score[3]
         if self.elastic:
@@ -261,34 +390,52 @@ class CoordState:
                 # reaches a commit boundary, then enters under the bumped
                 # epoch (re-rendezvous; docs/elastic.md)
                 self.pending_joins.add(rank)
+                self._pending_join_last_t = time.monotonic()
                 self._maybe_admit_locked()
-                while rank not in self.members:
-                    if self.bye:
-                        self.pending_joins.discard(rank)
-                        return self._shutdown_bytes()
-                    self.cv.wait(timeout=0.5)
-                return self._ranks_changed_bytes()
+                return ("join", None)
             if flags_cached_reqs_score[4] != self.epoch:
                 # stale-epoch submission (queued before a reset): fail
                 # fast instead of entering a barrier the current member
                 # set can never complete
-                return self._ranks_changed_bytes()
+                return ("done", self._ranks_changed_bytes())
             if flags_cached_reqs_score[0] & wire.REQ_COMMIT:
                 self.committed.add(rank)
                 self._maybe_admit_locked()
                 if self.epoch != flags_cached_reqs_score[4]:
                     # this commit admitted joiners; the frame itself is
                     # now stale — sender re-syncs like everyone else
-                    return self._ranks_changed_bytes()
+                    return ("done", self._ranks_changed_bytes())
         if score is not None and self.tuner is not None:
             self.round_bytes += score[0]
             self.round_seconds = max(self.round_seconds, score[1])
         self.lists.setdefault(seq, {})[rank] = flags_cached_reqs_score[:3]
-        if len(self.lists[seq]) == len(self.members):
+        self._maybe_negotiate_locked(seq)
+        return ("wait", self.epoch)
+
+    def _maybe_negotiate_locked(self, seq: int) -> None:
+        # a coalescing loss reset is pending: completing the barrier now
+        # would negotiate against a member set about to shrink — hold until
+        # the reset flushes (bounded by admission_batch_s)
+        if (seq in self.lists and not self._pending_lost
+                and len(self.lists[seq]) == len(self.members)):
             self.expected[seq] = len(self.members)
             self.resps[seq] = self._negotiate(self.lists.pop(seq))
             self.cv.notify_all()
-        entry_epoch = self.epoch
+
+    def _await_join_locked(self, rank: int) -> bytes:
+        while rank not in self.members:
+            if self.bye:
+                self.pending_joins.discard(rank)
+                return self._shutdown_bytes()
+            # re-check on every wake: with admission batching the linger
+            # window expires on the clock, not on a member event
+            self._maybe_admit_locked()
+            if rank in self.members:
+                break
+            self.cv.wait(timeout=0.1 if self.admission_batch_s else 0.5)
+        return self._ranks_changed_bytes()
+
+    def _await_locked(self, rank: int, seq: int, entry_epoch: int) -> bytes:
         while seq not in self.resps:
             if self.bye:
                 return self._shutdown_bytes()
@@ -299,6 +446,7 @@ class CoordState:
                     self.lists[seq].pop(rank, None)
                 return self._ranks_changed_bytes()
             self.cv.wait(timeout=0.5)
+            self._flush_lost_locked()
         data = self.resps[seq]
         self.fetched[seq] = self.fetched.get(seq, 0) + 1
         if self.fetched[seq] >= self.expected.get(seq, self.world):
@@ -330,9 +478,40 @@ class CoordState:
             _blackbox.note_dead_rank(rank, reason)
             from ..metrics import drop_report
             drop_report(rank)
+            if self.admission_batch_s > 0:
+                # storm-proofing: losses observed close together coalesce
+                # into ONE epoch bump; the reset flushes once no new loss
+                # has widened the window past admission_batch_s
+                if not self._pending_lost:
+                    self._lost_first_t = time.monotonic()
+                self._pending_lost.append((rank, reason))
+                self.cv.notify_all()
+                return
             self._reset_locked(
                 f"worker lost: rank {rank} dropped its control-plane "
                 f"connection ({reason})")
+
+    def _flush_lost_locked(self, force: bool = False) -> None:
+        """Apply a coalesced loss reset once the batching window closes
+        (called from exchange entry, barrier wait wakes, and the liveness
+        monitor — whichever observes expiry first)."""
+        if not self._pending_lost:
+            return
+        if (not force and time.monotonic() - self._lost_first_t
+                < self.admission_batch_s):
+            return
+        lost, self._pending_lost = self._pending_lost, []
+        ranks = [r for r, _ in lost]
+        if len(ranks) == 1:
+            self._reset_locked(
+                f"worker lost: rank {ranks[0]} dropped its control-plane "
+                f"connection ({lost[0][1]})")
+        else:
+            reasons = "; ".join(f"rank {r}: {why}" for r, why in lost)
+            self._reset_locked(
+                f"workers lost: ranks {ranks} dropped their control-plane "
+                f"connections in one {self.admission_batch_s * 1000:g}ms "
+                f"window ({reasons})")
 
     # ---- liveness (docs/fault-tolerance.md)
     def mark_alive(self, rank: int) -> None:
@@ -340,6 +519,18 @@ class CoordState:
         or long-compiling workers keep producing frames)."""
         with self.cv:
             self.last_seen[rank] = time.monotonic()
+
+    def marks_alive(self, ranks) -> None:
+        """Batched liveness proof (hierarchical mode): every listed rank is
+        alive per its sub-coordinator, which also cancels any reconnect
+        grace clock — a rank whose frames ride a host batch never sends a
+        per-rank MSG_RESUME of its own."""
+        now = time.monotonic()
+        with self.cv:
+            for r in ranks:
+                self.last_seen[r] = now
+                self.disconnected.pop(r, None)
+                self._hb_miss_counts.pop(r, None)
 
     def rank_disconnected(self, rank: int, reason: str) -> None:
         """A serve thread lost its connection. Not yet fatal: start the
@@ -386,6 +577,7 @@ class CoordState:
         with self.cv:
             if self.bye:
                 return
+            self._flush_lost_locked()
             for rank, (t0, reason) in list(self.disconnected.items()):
                 if now - t0 > grace_s:
                     lost.append((rank, f"no reconnect within the "
@@ -442,9 +634,18 @@ class CoordState:
                 self.committed.clear()  # boundary passed with no joiners
             return
         if self.committed >= self.members:
+            if (self.admission_batch_s > 0
+                    and time.monotonic() - self._pending_join_last_t
+                    < self.admission_batch_s):
+                # admission linger (HOROVOD_ADMISSION_BATCH_MS): a join
+                # storm lands as ONE epoch bump — hold the boundary open
+                # until no new joiner has arrived for the whole window
+                return
             admitted = sorted(self.pending_joins)
             self.members |= self.pending_joins
             self.pending_joins.clear()
+            if len(admitted) > 1:
+                instruments.epoch_coalesced_joins().inc(len(admitted) - 1)
             from ..metrics import readmit_report
             for r in admitted:
                 readmit_report(r)
@@ -485,8 +686,34 @@ class CoordState:
                                                  sorted(self.members)))
         logger.warning("elastic: membership epoch %d (%s); members now %s",
                        self.epoch, reason, sorted(self.members))
+        # standby replication: every epoch change is one journal record
+        # (membership is the ONLY durable state — see MSG_REPL_HELLO)
+        self.jseq += 1
+        if self._journal_sinks:
+            rec = wire.encode_coord_journal(self.jseq, self.epoch,
+                                            sorted(self.members), reason)
+            for q in self._journal_sinks:
+                q.put((MSG_JOURNAL, rec))
+            instruments.standby_journal_lag().set(
+                max(q.qsize() for q in self._journal_sinks))
         self._publish_members_locked()
         self.cv.notify_all()
+
+    def attach_journal(self, q) -> None:
+        """Attach a standby's shipper queue: enqueue one snapshot of the
+        current membership state, then a journal record per epoch change
+        until :meth:`detach_journal` (docs/control-plane.md)."""
+        with self.cv:
+            snap = wire.encode_coord_snapshot(
+                self.jseq, self.epoch, self.world, self.elastic,
+                sorted(self.members), self.next_cache_id)
+            q.put((MSG_SNAPSHOT, snap))
+            self._journal_sinks.append(q)
+
+    def detach_journal(self, q) -> None:
+        with self.cv:
+            if q in self._journal_sinks:
+                self._journal_sinks.remove(q)
 
     def _publish_members_locked(self) -> None:
         """Best-effort membership advertisement through the launcher KV store
@@ -665,6 +892,11 @@ class CoordState:
     def _negotiate(self, per_rank) -> bytes:
         flags = 0
         self.last_negotiation = time.time()
+        if self.on_negotiate is not None:
+            # fault hook (die@coordinator / slow@coordinator): runs under
+            # self.cv by design — a brownout here stalls every rank, which
+            # is exactly the failure being modeled
+            self.on_negotiate()
         tuned = self._tune()
         invalid: set = set()
         for rank, (rflags, cached, reqs) in per_rank.items():
@@ -1068,14 +1300,21 @@ class CoordinatorServer:
         self.state = state
         self.secret = secret
         self._stop = threading.Event()
-        # coordinator-side fault injection (rank 0 hosts the server)
+        # coordinator-side fault injection (rank 0 hosts the server);
+        # die@coordinator / slow@coordinator fire per negotiation round
         self._faults = faultinject.for_rank(0)
+        if self._faults is not None:
+            state.on_negotiate = self._negotiation_fault
         # per-rank connection generation: a serve thread that loses its
         # connection reports the loss only if no newer connection has taken
         # over the rank — a stale thread unblocking late must not re-mark a
         # reconnected rank as disconnected
         self._conn_gen: Dict[int, int] = {}
         self._gen_lock = threading.Lock()
+        # every accepted connection, tracked so die() can sever them all
+        # abruptly (fault injection / standby-failover tests)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         # liveness knobs, read once (docs/fault-tolerance.md)
         self._grace_s = _env_float("HOROVOD_RECONNECT_GRACE", 10.0)
         self._hb_interval = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
@@ -1108,8 +1347,43 @@ class CoordinatorServer:
             conn.settimeout(0.5)
             if self._faults is not None:
                 conn = self._faults.wrap(conn)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              name="hvd_coord_conn", daemon=True).start()
+
+    def _negotiation_fault(self) -> None:
+        """CoordState.on_negotiate hook: apply die/slow rules at point
+        ``coordinator`` (one hit per negotiation round)."""
+        for kind, seconds in self._faults.actions_for("coordinator"):
+            if kind == "slow":
+                time.sleep(seconds)
+            elif kind == "die":
+                # sever everything off-thread: die() closes sockets, which
+                # is safe under state.cv, but never block a negotiation on
+                # socket teardown
+                threading.Thread(target=self.die, name="hvd_coord_die",
+                                 daemon=True).start()
+
+    def die(self) -> None:
+        """Abrupt coordinator death (die@coordinator, chaos tests): close
+        the listening socket and every accepted connection with no BYE and
+        no cleanup — from the workers' side, indistinguishable from
+        SIGKILL of rank 0. The state machine is left untouched so an
+        in-process rank 0 caller keeps functioning (in the real SIGKILL
+        case the whole process is gone anyway)."""
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(0.5):
@@ -1123,9 +1397,19 @@ class CoordinatorServer:
     def _serve(self, conn) -> None:
         rank = -1
         gen = 0
+        # ranks whose frames ride this connection as a host batch: all of
+        # them are disconnected together if the connection dies, and any
+        # that vanish from the batched heartbeat died locally at the host
+        batch_ranks: set = set()
+        # batch responses are written by per-batch handler threads, so
+        # writes to a sub-coordinator connection need serializing
+        send_lock = threading.Lock()
         try:
             mt, _, rank, payload = wire.recv_frame(conn, self.secret,
                                                    self._stop)
+            if mt == MSG_REPL_HELLO:
+                self._serve_repl(conn, rank)
+                return
             if mt not in (MSG_HELLO, MSG_RESUME):
                 raise ConnectionError(f"expected HELLO/RESUME, got {mt}")
             with self._gen_lock:
@@ -1196,6 +1480,29 @@ class CoordinatorServer:
                     wire.send_frame(conn, self.secret, MSG_CLOCK_RESP, seq,
                                     0, reply)
                     continue
+                if mt == MSG_BATCH:
+                    # one host's aggregated round: answer from a handler
+                    # thread — the serve loop must keep draining frames
+                    # (heartbeats, the next batch) while barriers block
+                    entries = wire.decode_batched_entries(payload)
+                    self.state.marks_alive([e[0] for e in entries])
+                    batch_ranks.update(e[0] for e in entries)
+                    threading.Thread(
+                        target=self._handle_batch,
+                        args=(conn, seq, entries, send_lock),
+                        name="hvd_coord_batch", daemon=True).start()
+                    continue
+                if mt == MSG_BATCH_HB:
+                    alive = wire.decode_batched_heartbeat(payload)
+                    self.state.marks_alive(alive)
+                    for r in sorted(batch_ranks - set(alive) - {rank}):
+                        # the sub-coordinator stopped vouching for this
+                        # rank: its local connection died
+                        self.state.rank_disconnected(
+                            r, "dropped from host batch heartbeat "
+                               f"(sub-coordinator rank {rank})")
+                    batch_ranks = set(alive) | (batch_ranks & {rank})
+                    continue
                 if mt != MSG_LIST:
                     raise ConnectionError(f"unexpected message type {mt}")
                 data = self.state.exchange(rank, seq, payload)
@@ -1214,11 +1521,75 @@ class CoordinatorServer:
             logger.warning("coordinator: rank %s connection lost (%s); "
                            "reconnect grace window open", rank, exc)
             self.state.rank_disconnected(rank, str(exc))
+            for r in sorted(batch_ranks - {rank}):
+                self.state.rank_disconnected(
+                    r, f"host batch connection lost ({exc})")
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _handle_batch(self, conn, frame_seq: int, entries,
+                      send_lock) -> None:
+        try:
+            replies, deferred = self.state.exchange_batch(entries)
+            if replies:
+                with send_lock:
+                    wire.send_frame(conn, self.secret, MSG_BATCH_RESP,
+                                    frame_seq, 0,
+                                    wire.encode_batched_entries(replies))
+            for rank, seq, payload in deferred:
+                # prospective joiners: their admission wait spans member
+                # commit rounds, so each gets its own thread and ships as
+                # a single-entry response frame whenever it completes
+                threading.Thread(
+                    target=self._handle_deferred,
+                    args=(conn, rank, seq, payload, send_lock),
+                    name="hvd_coord_join", daemon=True).start()
+        except (ConnectionError, OSError, ShutdownError):
+            pass  # the serve thread owns connection-loss reporting
+
+    def _handle_deferred(self, conn, rank: int, seq: int, payload: bytes,
+                         send_lock) -> None:
+        try:
+            data = self.state.exchange(rank, seq, payload)
+            with send_lock:
+                wire.send_frame(
+                    conn, self.secret, MSG_BATCH_RESP, 0, 0,
+                    wire.encode_batched_entries([(rank, seq, data)]))
+        except (ConnectionError, OSError, ShutdownError):
+            pass
+
+    def _serve_repl(self, conn, standby_rank: int) -> None:
+        """Replication shipper (MSG_REPL_HELLO): stream one snapshot plus a
+        journal record per epoch change to a warm standby. A clean end
+        sends BYE so the standby knows not to promote; an abrupt death
+        (SIGKILL, die@coordinator) just drops the stream — which is the
+        standby's promotion trigger (docs/control-plane.md)."""
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        self.state.attach_journal(q)
+        logger.info("coordinator: standby rank %s attached to the "
+                    "replication stream", standby_rank)
+        try:
+            while not self._stop.is_set():
+                try:
+                    mt, payload = q.get(timeout=0.5)
+                except _queue.Empty:
+                    if self.state.bye:
+                        break
+                    continue
+                wire.send_frame(conn, self.secret, mt, 0, 0, payload)
+                instruments.standby_journal_lag().set(q.qsize())
+            wire.send_frame(conn, self.secret, MSG_BYE, 0, 0)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.state.detach_journal(q)
 
     def stop(self) -> None:
         self._stop.set()
@@ -1246,31 +1617,41 @@ def _next_gen(rank: int) -> int:
         return n
 
 
-def _publish(gen: int, addr: str, secret: str) -> None:
+def _publish_key(key: str, addr: str, secret: str) -> None:
+    """Publish one address-channel key. Besides the primary ``addr.{gen}``,
+    the hierarchical/failover planes use ``addr.{gen}.h{group}`` (a host
+    sub-coordinator) and ``addr.{gen}.f{n}`` (the n-th promoted standby)."""
     payload = f"{addr}\n{secret}"
     kv_addr = os.environ.get("HVD_KV_ADDR")
     if kv_addr:
         from ..run.rendezvous import KVStoreClient
 
         KVStoreClient(kv_addr, os.environ.get("HVD_SECRET", "")).put(
-            "hvdcoord", f"addr.{gen}", payload.encode())
+            "hvdcoord", key, payload.encode())
         return
-    _jax_kv().key_value_set(f"hvdcoord/addr.{gen}", payload)
+    _jax_kv().key_value_set(f"hvdcoord/{key}", payload)
 
 
-def _resolve(gen: int, timeout: float) -> Tuple[str, str]:
+def _resolve_key(key: str, timeout: float) -> Tuple[str, str]:
     kv_addr = os.environ.get("HVD_KV_ADDR")
     if kv_addr:
         from ..run.rendezvous import KVStoreClient
 
         client = KVStoreClient(kv_addr, os.environ.get("HVD_SECRET", ""))
-        payload = client.wait("hvdcoord", f"addr.{gen}",
-                              timeout=timeout).decode()
+        payload = client.wait("hvdcoord", key, timeout=timeout).decode()
     else:
-        payload = _jax_kv().blocking_key_value_get(f"hvdcoord/addr.{gen}",
+        payload = _jax_kv().blocking_key_value_get(f"hvdcoord/{key}",
                                                    int(timeout * 1000))
     addr, _, secret = payload.partition("\n")
     return addr, secret
+
+
+def _publish(gen: int, addr: str, secret: str) -> None:
+    _publish_key(f"addr.{gen}", addr, secret)
+
+
+def _resolve(gen: int, timeout: float) -> Tuple[str, str]:
+    return _resolve_key(f"addr.{gen}", timeout)
 
 
 def has_address_channel() -> bool:
@@ -1376,8 +1757,33 @@ class CoordController:
         self._ranks_changed_reason: Optional[str] = None
         self._commit_pending = False
         self._dseq = 0
+        # ---- survivable control plane (docs/control-plane.md)
+        self._hier = os.environ.get(
+            "HOROVOD_HIERARCHICAL_COORD", "") not in ("", "0")
+        self._standby_enabled = os.environ.get(
+            "HOROVOD_STANDBY_COORD", "") not in ("", "0")
+        self._reconnect_jitter = _env_float("HOROVOD_RECONNECT_JITTER", 0.0)
+        self._fo = 0  # how many failovers this worker has followed
+        self._subcoord = None       # per-host sub-coordinator (host leaders)
+        self._standby_coord = None  # warm-standby replica (rank 1)
+        # hierarchical mode: bulk DATA/CLOCK frames bypass the
+        # sub-coordinator on a lazily-dialed direct connection to rank 0
+        self._direct_sock: Optional[socket.socket] = None
+        self._direct_lock = threading.Lock()
+        self._direct_send_lock = threading.Lock()
+        self._host0, self._port0, self._secret0 = "", 0, ""
+        # everything the warm standby needs to rebuild an equivalent
+        # CoordState at promotion time (tuner deliberately excluded: the
+        # GP/EI restarts cold rather than replicating its posterior)
+        self._state_ctor = dict(
+            world=world,
+            threshold=fusion_threshold if fusion_enabled else 0,
+            cache_capacity=cache_capacity,
+            stall_warning_s=stall_warning_s,
+            stall_shutdown_s=stall_shutdown_s)
 
         gen = _next_gen(self_rank)
+        self._gen = gen
         if self_rank == 0:
             # no launcher secret (jax-KV address path): generate one and ship
             # it over the address channel, so the TCP service never accepts
@@ -1411,11 +1817,36 @@ class CoordController:
             self._sock: Optional[socket.socket] = None
             self._addr = "in-process"
             self._host, self._port = "", 0
+            self._host0, self._port0 = "127.0.0.1", self._server.port
+            self._secret0 = self._secret
+            if self._hier and int(os.environ.get("HVD_LOCAL_RANK",
+                                                 "0")) == 0:
+                # rank 0 is (almost always) also its host's leader: its
+                # sub-coordinator dials the in-process server over loopback
+                # so host 0's local ranks use the same uniform path
+                self._start_subcoord(gen, "127.0.0.1", self._server.port,
+                                     advertise)
         else:
             self._state = None
             self._server = None
             addr, self._secret = _resolve(gen, start_timeout)
             host, port = addr.rsplit(":", 1)
+            self._host0, self._port0 = host, int(port)
+            self._secret0 = self._secret
+            if self._hier:
+                # host leaders bring up the per-host sub-coordinator, then
+                # EVERY local rank (leader included) dials it instead of
+                # rank 0 — the leader's aggregator batches the whole host
+                # into one upstream frame per round
+                local_rank = int(os.environ.get("HVD_LOCAL_RANK",
+                                                str(self_rank)))
+                group = os.environ.get("HVD_CROSS_RANK", "0")
+                if local_rank == 0:
+                    self._start_subcoord(gen, host, int(port),
+                                         _advertise_host())
+                addr, self._secret = _resolve_key(
+                    f"addr.{gen}.h{group}", start_timeout)
+                host, port = addr.rsplit(":", 1)
             # retained so the reconnect path can re-dial after a drop and so
             # connection-loss errors can say who was unreachable
             self._addr = addr
@@ -1453,6 +1884,20 @@ class CoordController:
             if self._hb_interval > 0:
                 threading.Thread(target=self._heartbeat_loop,
                                  name="hvd_heartbeat", daemon=True).start()
+            if self._standby_enabled and self_rank == 1:
+                if not self._elastic:
+                    logger.warning(
+                        "HOROVOD_STANDBY_COORD needs HVD_ELASTIC=1 (failover"
+                        " is a membership reset); standby disabled")
+                else:
+                    from .standby import StandbyCoordinator
+
+                    self._standby_coord = StandbyCoordinator(
+                        rank=self_rank, gen=gen, host=self._host0,
+                        port=self._port0, secret=self._secret0,
+                        make_state=self._make_standby_state,
+                        should_promote=lambda: not self._stop.is_set())
+                    self._standby_coord.start()
 
     # ------------------------------------------------------------- engine API
     def submit(self, entry: TensorTableEntry) -> int:
@@ -1677,13 +2122,23 @@ class CoordController:
         a MSG_RESUME handshake carrying the last seq whose response fully
         arrived. The caller then re-sends its in-flight frame under the
         original seq and the coordinator answers from its replay cache.
-        Raises a fully-attributed ShutdownError once attempts run out."""
-        backoff = self._reconnect_backoff
+        Raises a fully-attributed ShutdownError once attempts run out.
+
+        With HOROVOD_STANDBY_COORD set, attempts after the first also probe
+        the KV store for a promoted standby's address (addr.{gen}.f{n}) and
+        redirect there — that is the entire worker half of coordinator
+        failover; everything downstream is the ordinary RESUME + replay +
+        RANKS_CHANGED machinery (docs/control-plane.md)."""
         last: Exception = why
         for attempt in range(1, self._reconnect_attempts + 1):
-            if self._stop.wait(backoff):
+            delay = _backoff_schedule(self._rank, attempt,
+                                      self._reconnect_backoff,
+                                      self._reconnect_backoff_max,
+                                      self._reconnect_jitter)
+            if self._stop.wait(delay):
                 raise ShutdownError("control plane shut down")
-            backoff = min(backoff * 2, self._reconnect_backoff_max)
+            if self._standby_enabled and attempt >= 2:
+                self._probe_failover()
             try:
                 sock = socket.create_connection((self._host, self._port),
                                                 timeout=5)
@@ -1718,6 +2173,95 @@ class CoordController:
             f"{self._last_acked}, {self._reconnect_attempts} reconnect "
             f"attempts failed, last error "
             f"errno={getattr(last, 'errno', None)}: {last!r})")
+
+    # ------------------------------------- survivable control plane helpers
+    def _start_subcoord(self, gen: int, up_host: str, up_port: int,
+                        advertise: str) -> None:
+        """Bring up this host's sub-coordinator and publish its address
+        under addr.{gen}.h{group} so local ranks can find it."""
+        from .hierarchy import SubCoordinator
+
+        group = os.environ.get("HVD_CROSS_RANK", "0")
+        bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
+        self._subcoord = SubCoordinator(
+            up_host, up_port, self._secret, leader_rank=self._rank,
+            host=bind)
+        _publish_key(f"addr.{gen}.h{group}",
+                     f"{advertise}:{self._subcoord.port}", self._secret)
+
+    def _make_standby_state(self) -> "CoordState":
+        c = self._state_ctor
+        return CoordState(c["world"], c["threshold"], c["cache_capacity"],
+                          c["stall_warning_s"], c["stall_shutdown_s"],
+                          tuner=None, elastic=True)
+
+    def _probe_failover(self) -> None:
+        """A dead primary may have left a promoted standby behind: look for
+        the next failover address with a short timeout and, if published,
+        aim all further reconnect attempts (and direct dials) at it."""
+        try:
+            addr, secret = _resolve_key(
+                f"addr.{self._gen}.f{self._fo + 1}", timeout=0.3)
+        except Exception:
+            return  # nothing promoted (yet); keep redialing the old address
+        self._fo += 1
+        host, port = addr.rsplit(":", 1)
+        self._addr = addr
+        self._host, self._port, self._secret = host, int(port), secret
+        self._host0, self._port0, self._secret0 = host, int(port), secret
+        with self._direct_lock:
+            if self._direct_sock is not None:
+                try:
+                    self._direct_sock.close()
+                except OSError:
+                    pass
+                self._direct_sock = None
+        _blackbox.record(_blackbox.K_FAILOVER, "rank_%d" % self._rank,
+                         "redialing promoted standby at %s (failover %d)"
+                         % (addr, self._fo), rank=self._rank)
+        logger.warning("control plane: rank %d following coordinator "
+                       "failover %d to %s", self._rank, self._fo, addr)
+
+    def _direct_request_reply(self, msg_type: int, resp_type: int,
+                              frame_seq: int, payload: bytes) -> bytes:
+        """Hierarchical mode: DATA/CLOCK exchanges carry bulk payloads and
+        per-rank state, so they bypass the sub-coordinator on a lazily
+        dialed direct connection to rank 0 instead of funneling through
+        one host process. One redial on connection loss; the coordinator's
+        replay caches make the re-send idempotent."""
+        last: Optional[Exception] = None
+        for attempt in range(2):
+            try:
+                with self._direct_lock:
+                    sock = self._direct_sock
+                    if sock is None:
+                        sock = socket.create_connection(
+                            (self._host0, self._port0), timeout=5)
+                        sock.settimeout(0.5)
+                        wire.send_frame(sock, self._secret0, MSG_HELLO, 0,
+                                        self._rank)
+                        self._direct_sock = sock
+                with self._direct_send_lock:
+                    wire.send_frame(sock, self._secret0, msg_type,
+                                    frame_seq, self._rank, payload)
+                while True:
+                    mt, rseq, _, data = wire.recv_frame(
+                        sock, self._secret0, self._stop)
+                    if mt == resp_type and rseq == frame_seq:
+                        return data
+            except (ConnectionError, OSError) as exc:
+                last = exc
+                with self._direct_lock:
+                    if self._direct_sock is not None:
+                        try:
+                            self._direct_sock.close()
+                        except OSError:
+                            pass
+                        self._direct_sock = None
+                if self._stop.is_set():
+                    raise ShutdownError("control plane shut down")
+        raise ConnectionError(
+            f"direct control connection to rank 0 lost: {last!r}")
 
     def push_metrics(self) -> None:
         """Ship this rank's registry snapshot to the coordinator as a
@@ -1785,9 +2329,14 @@ class CoordController:
         the job's globally-unique trace id."""
         from .. import tracing as _tracing
 
+        # sub-coordinators do not answer CLOCK: in hierarchical mode probe
+        # rank 0 directly so offsets measure the rank-0 wire, not the relay
+        rr = (self._direct_request_reply if self._hier
+              else self._request_reply)
+
         def probe(t_local_us):
-            data = self._request_reply(MSG_CLOCK, MSG_CLOCK_RESP, 0,
-                                       wire.encode_clock_probe(t_local_us))
+            data = rr(MSG_CLOCK, MSG_CLOCK_RESP, 0,
+                      wire.encode_clock_probe(t_local_us))
             server_us, tid = wire.decode_clock_reply(data)
             if tid:
                 _tracing.set_trace_id(tid)
@@ -1873,8 +2422,12 @@ class CoordController:
             else:
                 if self._faults is not None:
                     self._faults.fire("exchange")
-                data = self._request_reply(MSG_DATA, MSG_DATA_RESP,
-                                           frame_seq, payload)
+                if self._hier:
+                    data = self._direct_request_reply(
+                        MSG_DATA, MSG_DATA_RESP, frame_seq, payload)
+                else:
+                    data = self._request_reply(MSG_DATA, MSG_DATA_RESP,
+                                               frame_seq, payload)
         except (ConnectionError, OSError) as exc:
             raise ShutdownError(
                 f"control-plane connection lost during data exchange "
@@ -1897,6 +2450,10 @@ class CoordController:
     def interrupt(self) -> None:
         """Unblock a tick in flight (called from the user thread on
         shutdown)."""
+        if self._standby_coord is not None:
+            # an intentionally-stopping rank 1 must never read the ensuing
+            # connection teardown as a dead coordinator and promote itself
+            self._standby_coord.stop()
         self._send_bye()
         self._stop.set()
 
@@ -1921,6 +2478,8 @@ class CoordController:
             self.push_traces()
         except Exception:
             pass
+        if self._standby_coord is not None:
+            self._standby_coord.stop()
         self._send_bye()
         self._stop.set()
         with self._lock:
@@ -1935,6 +2494,15 @@ class CoordController:
                 self._sock.close()
             except OSError:
                 pass
+        with self._direct_lock:
+            if self._direct_sock is not None:
+                try:
+                    self._direct_sock.close()
+                except OSError:
+                    pass
+                self._direct_sock = None
+        if self._subcoord is not None:
+            self._subcoord.stop()
         if self._server is not None:
             # set_bye already ran (via _send_bye), so any rank still blocked
             # in an exchange has been released with a shutdown response;
@@ -1991,6 +2559,21 @@ class CoordController:
         if self._state is not None:
             return self._state.cache_stats()
         return (self._hits, self._misses)
+
+
+def _backoff_schedule(rank: int, attempt: int, base: float, cap: float,
+                      jitter: float) -> float:
+    """Reconnect delay before ``attempt`` (1-based): bounded exponential
+    backoff, optionally spread per-rank by ``HOROVOD_RECONNECT_JITTER`` so
+    a mass reconnect (every worker losing the coordinator at once) does
+    not land on the new coordinator as one synchronized thundering herd.
+    The jitter term is deterministic per (rank, attempt), keeping chaos
+    tests reproducible: delay in [backoff, backoff * (1 + jitter)]."""
+    delay = min(base * (2 ** (attempt - 1)), cap)
+    if jitter > 0:
+        u = ((rank * 2654435761 + attempt * 97) % 1024) / 1024.0
+        delay *= 1.0 + jitter * u
+    return delay
 
 
 def _advertise_host() -> str:

@@ -1,0 +1,371 @@
+"""Hierarchical negotiation: the per-host sub-coordinator tier.
+
+Flat mode puts every rank on its own TCP connection to rank 0, which makes
+rank 0's negotiation work O(world) frames per round — fine at 32 ranks,
+a storm at 1024. With ``HOROVOD_HIERARCHICAL_COORD`` set, each host's
+local-rank-0 process runs a :class:`SubCoordinator`: local ranks speak the
+UNCHANGED downstream protocol (HELLO/LIST/RESP/HEARTBEAT/BYE) to it over
+loopback, and the sub-coordinator ships ONE ``MSG_BATCH`` frame per round
+upstream to rank 0, carrying every local rank's request list as a
+``(rank, seq, payload)`` entry. Rank 0 answers with ``MSG_BATCH_RESP``
+frames whose entries self-identify the same way, so responses need no 1:1
+frame pairing — deferred joiner admissions ship later as single-entry
+frames. Rank 0's per-round work drops to O(hosts).
+
+The batching core (:class:`HostAggregator`) is deliberately socketless so
+tests and benchmarks can drive thousands of fake ranks through it
+in-process; :class:`SubCoordinator` is the thin TCP shell around it.
+
+Liveness is vouched per host: the sub-coordinator sends ``MSG_BATCH_HB``
+listing its currently-connected local ranks; a rank missing from the list
+(its local connection died) enters the coordinator's ordinary reconnect
+grace window, exactly as a flat-mode connection loss would.
+
+See docs/control-plane.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Set, Tuple
+
+from ..utils.env import env_float as _env_float
+from . import wire
+from .coordinator import (MSG_BATCH, MSG_BATCH_HB, MSG_BATCH_RESP,
+                          MSG_BLACKBOX, MSG_BYE, MSG_HEARTBEAT, MSG_HELLO,
+                          MSG_LIST, MSG_METRICS, MSG_RESP, MSG_RESUME,
+                          MSG_TRACE, _backoff_schedule)
+from ..exceptions import ShutdownError
+
+logger = logging.getLogger("horovod_tpu")
+
+Entry = Tuple[int, int, bytes]  # (rank, seq, payload)
+
+
+class AggregatorClosed(ConnectionError):
+    """The sub-coordinator is shutting down (or lost rank 0 for good);
+    subclassing ConnectionError lets the worker's ordinary reconnect path
+    handle the downstream connection teardown that follows."""
+
+
+class HostAggregator:
+    """Socketless batching core: collects one control frame per local rank,
+    releases them upstream as a single batch, and routes the entries of
+    whatever response frames come back to the blocked submitters.
+
+    A batch flushes when every rank currently expected to tick has
+    deposited a frame, or when ``linger_s`` elapses after the first
+    deposit — whichever comes first. Ranks with an entry already in flight
+    upstream (deferred joiners blocked in admission) are not waited for,
+    so one slow admission never adds linger latency to the members' rounds.
+    """
+
+    def __init__(self, flush_fn: Callable[[List[Entry]], None],
+                 linger_s: float = 0.005):
+        self._flush_fn = flush_fn
+        self._linger_s = linger_s
+        self._cv = threading.Condition()
+        self._ranks: Set[int] = set()          # ranks with a live local conn
+        self._awaiting: Set[int] = set()       # ranks with an entry upstream
+        self._pending: Dict[int, Tuple[int, bytes]] = {}  # rank -> (seq, pl)
+        self._replies: Dict[Tuple[int, int], bytes] = {}
+        self._first_t = 0.0
+        self._closed = False
+        self.flushes = 0  # batches shipped (test observability)
+
+    def register(self, rank: int) -> None:
+        with self._cv:
+            self._ranks.add(rank)
+            self._cv.notify_all()
+
+    def unregister(self, rank: int) -> None:
+        with self._cv:
+            self._ranks.discard(rank)
+            self._awaiting.discard(rank)
+            self._pending.pop(rank, None)
+            self._cv.notify_all()
+
+    def ranks(self) -> List[int]:
+        with self._cv:
+            return sorted(self._ranks)
+
+    def submit(self, rank: int, seq: int, payload: bytes) -> bytes:
+        """Deposit one rank's frame and block until its reply arrives.
+        Strict request/reply per rank upstream of this call means at most
+        one live entry per rank; a duplicate (rank, seq) after a local
+        reconnect simply re-ships, and the coordinator's replay cache makes
+        that idempotent."""
+        key = (rank, seq)
+        with self._cv:
+            if self._closed:
+                raise AggregatorClosed("sub-coordinator shut down")
+            self._pending[rank] = (seq, payload)
+            if self._first_t == 0.0:
+                self._first_t = time.monotonic()
+            self._cv.notify_all()
+        while True:
+            batch = self._take_due_batch()
+            if batch:
+                # network I/O happens outside the lock; whichever submitter
+                # wins the pop ships the whole host's round
+                self._flush_fn(batch)
+            with self._cv:
+                if key in self._replies:
+                    return self._replies.pop(key)
+                if self._closed:
+                    raise AggregatorClosed("sub-coordinator shut down")
+                self._cv.wait(timeout=0.005)
+
+    def _take_due_batch(self) -> List[Entry]:
+        with self._cv:
+            if not self._pending:
+                return []
+            waiting_for = self._ranks - self._awaiting
+            full = bool(waiting_for) and set(self._pending) >= waiting_for
+            lingered = (self._first_t > 0.0 and
+                        time.monotonic() - self._first_t >= self._linger_s)
+            if not (full or lingered):
+                return []
+            entries = [(r, s, p)
+                       for r, (s, p) in sorted(self._pending.items())]
+            self._pending.clear()
+            self._first_t = 0.0
+            self._awaiting.update(r for r, _, _ in entries)
+            self.flushes += 1
+            return entries
+
+    def deliver(self, rank: int, seq: int, data: bytes) -> None:
+        with self._cv:
+            self._awaiting.discard(rank)
+            self._replies[(rank, seq)] = data
+            self._cv.notify_all()
+
+    def inflight(self) -> List[Entry]:
+        """Entries shipped upstream with no reply yet — what a reconnect
+        must re-send. Payloads are not retained here; see SubCoordinator's
+        inflight ledger (this accessor reports ranks only for tests)."""
+        with self._cv:
+            return sorted(self._awaiting)  # type: ignore[return-value]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class SubCoordinator:
+    """Per-host relay: downstream server speaking the flat worker protocol
+    to local ranks, one upstream connection to rank 0 speaking batches."""
+
+    def __init__(self, up_host: str, up_port: int, secret: str,
+                 leader_rank: int, host: str = "127.0.0.1"):
+        self._up_addr = (up_host, up_port)
+        self._secret = secret
+        self._leader = leader_rank
+        self._stop = threading.Event()
+        self._jitter = _env_float("HOROVOD_RECONNECT_JITTER", 0.0)
+        self._hb_interval = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
+        linger = _env_float("HOROVOD_HIERARCHY_LINGER_MS", 5.0) / 1000.0
+        self.agg = HostAggregator(self._ship, linger_s=linger)
+        # entries shipped upstream and not yet answered: the reconnect path
+        # re-sends them all (idempotent via the coordinator replay caches)
+        self._inflight: Dict[Tuple[int, int], bytes] = {}
+        self._inflight_lock = threading.Lock()
+        self._bseq = 0
+        self._up_send_lock = threading.Lock()
+        self._up = self._dial_upstream(MSG_HELLO)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept_loop, name="hvd_sub_accept",
+                         daemon=True).start()
+        threading.Thread(target=self._recv_loop, name="hvd_sub_upstream",
+                         daemon=True).start()
+        if self._hb_interval > 0:
+            threading.Thread(target=self._hb_loop, name="hvd_sub_hb",
+                             daemon=True).start()
+
+    # --------------------------------------------------------------- upstream
+    def _dial_upstream(self, hello_type: int) -> socket.socket:
+        sock = socket.create_connection(self._up_addr, timeout=5)
+        sock.settimeout(0.5)
+        payload = (wire.encode_resume(-1) if hello_type == MSG_RESUME
+                   else b"")
+        wire.send_frame(sock, self._secret, hello_type, 0, self._leader,
+                        payload)
+        return sock
+
+    def _next_bseq(self) -> int:
+        with self._inflight_lock:
+            self._bseq += 1
+            return self._bseq
+
+    def _ship(self, entries: List[Entry]) -> None:
+        """HostAggregator flush hook: record the entries as in flight, then
+        send one MSG_BATCH. Send errors are swallowed — the upstream recv
+        loop owns reconnect, and reconnect re-ships the inflight ledger."""
+        with self._inflight_lock:
+            for r, s, p in entries:
+                self._inflight[(r, s)] = p
+        self._send_batch(entries)
+
+    def _send_batch(self, entries: List[Entry]) -> None:
+        payload = wire.encode_batched_entries(entries)
+        try:
+            with self._up_send_lock:
+                wire.send_frame(self._up, self._secret, MSG_BATCH,
+                                self._next_bseq(), self._leader, payload)
+        except (ConnectionError, OSError):
+            pass
+
+    def _forward(self, mt: int, rank: int, payload: bytes) -> None:
+        """Fire-and-forget relay of telemetry/BYE frames, rank preserved."""
+        try:
+            with self._up_send_lock:
+                wire.send_frame(self._up, self._secret, mt, 0, rank, payload)
+        except (ConnectionError, OSError):
+            pass
+
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                mt, _, _, payload = wire.recv_frame(self._up, self._secret,
+                                                    self._stop)
+            except ShutdownError:
+                return
+            except (ConnectionError, OSError) as exc:
+                if self._stop.is_set():
+                    return
+                if not self._reconnect_upstream(exc):
+                    logger.warning(
+                        "sub-coordinator (leader rank %d): rank 0 stayed "
+                        "unreachable; releasing local ranks", self._leader)
+                    self.agg.close()
+                    return
+                continue
+            if mt == MSG_BATCH_RESP:
+                for rank, seq, data in wire.decode_batched_entries(payload):
+                    with self._inflight_lock:
+                        self._inflight.pop((rank, seq), None)
+                    self.agg.deliver(rank, seq, data)
+            elif mt == MSG_BYE:
+                self.agg.close()
+                return
+            # anything else on the upstream socket is ignored: the batch
+            # protocol owns this connection
+
+    def _reconnect_upstream(self, why: Exception) -> bool:
+        for attempt in range(1, 9):
+            delay = _backoff_schedule(self._leader, attempt, 0.05, 2.0,
+                                      self._jitter)
+            if self._stop.wait(delay):
+                return False
+            try:
+                sock = self._dial_upstream(MSG_RESUME)
+            except (ConnectionError, OSError):
+                continue
+            with self._up_send_lock:
+                old, self._up = self._up, sock
+            try:
+                old.close()
+            except OSError:
+                pass
+            with self._inflight_lock:
+                entries = [(r, s, p)
+                           for (r, s), p in sorted(self._inflight.items())]
+            if entries:
+                self._send_batch(entries)
+            logger.warning(
+                "sub-coordinator (leader rank %d): reconnected upstream "
+                "after %s (attempt %d, re-shipped %d inflight entries)",
+                self._leader, why, attempt, len(entries))
+            return True
+        return False
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self._hb_interval):
+            alive = self.agg.ranks()
+            if not alive:
+                continue
+            try:
+                with self._up_send_lock:
+                    wire.send_frame(self._up, self._secret, MSG_BATCH_HB, 0,
+                                    self._leader,
+                                    wire.encode_batched_heartbeat(alive))
+            except (ConnectionError, OSError):
+                pass  # recv loop owns reconnect
+
+    # ------------------------------------------------------------- downstream
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(0.5)
+            threading.Thread(target=self._serve, args=(conn,),
+                             name="hvd_sub_conn", daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        rank = -1
+        try:
+            mt, _, rank, _ = wire.recv_frame(conn, self._secret, self._stop)
+            if mt not in (MSG_HELLO, MSG_RESUME):
+                raise ConnectionError(
+                    f"sub-coordinator expected HELLO/RESUME, got {mt}")
+            # a RESUME needs no upstream replay here: the worker re-sends
+            # its in-flight frame itself, and submit() re-ships it
+            self.agg.register(rank)
+            while True:
+                mt, seq, rank, payload = wire.recv_frame(conn, self._secret,
+                                                         self._stop)
+                if mt == MSG_BYE:
+                    # global shutdown: rank 0 sets bye and tears this
+                    # host's upstream down; locals see shutdown responses
+                    self._forward(MSG_BYE, rank, b"")
+                    return
+                if mt == MSG_HEARTBEAT:
+                    # local liveness is the open connection itself; the
+                    # periodic MSG_BATCH_HB vouches for it upstream
+                    continue
+                if mt in (MSG_METRICS, MSG_TRACE, MSG_BLACKBOX):
+                    self._forward(mt, rank, payload)
+                    continue
+                if mt != MSG_LIST:
+                    # DATA/CLOCK bypass the hierarchy on direct rank-0
+                    # connections; seeing one here is a protocol bug
+                    raise ConnectionError(
+                        f"sub-coordinator: unexpected message type {mt}")
+                data = self.agg.submit(rank, seq, payload)
+                wire.send_frame(conn, self._secret, MSG_RESP, seq, 0, data)
+        except ShutdownError:
+            pass
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if rank >= 0:
+                self.agg.unregister(rank)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.agg.close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            self._up.close()
+        except OSError:
+            pass

@@ -1,0 +1,193 @@
+"""Warm-standby coordinator: replication client + promotion logic.
+
+With ``HOROVOD_STANDBY_COORD`` set on an elastic job, rank 1 runs a
+:class:`StandbyCoordinator` beside its ordinary worker role. It holds a
+second connection to rank 0 announced with ``MSG_REPL_HELLO``; the primary
+answers with one ``MSG_SNAPSHOT`` of the durable coordinator state and then
+streams a ``MSG_JOURNAL`` record per membership-epoch change.
+
+The replicated state is deliberately tiny. Rank 0's death always implies a
+membership reset — rank 0 was a member — so a promoted standby never needs
+the in-flight negotiation barriers, replay caches, or response tables: it
+rebuilds a fresh ``CoordState``, restores the durable fields (epoch,
+members, cache-id high-water mark), and immediately declares rank 0 lost.
+Every survivor then walks the PR-4 machinery it already has: reconnect with
+backoff (finding the promoted address under ``addr.{gen}.f1``), RESUME,
+replay, ``RESP_RANKS_CHANGED``, elastic restore/sync. The cache-id
+high-water mark is restored so ids the old primary handed out are never
+reused for different tensors; the ids themselves die with the epoch bump
+(survivors clear their sig caches on RANKS_CHANGED).
+
+Promotion triggers on replication-stream loss WITHOUT a prior ``MSG_BYE``
+(a clean shutdown sends BYE precisely so the standby stands down), after a
+few quick re-dials to ride out transient blips. One failover deep by
+design: the promoted coordinator does not accept a new standby.
+
+See docs/control-plane.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Callable, List, Optional
+
+from ..metrics import instruments
+from .. import blackbox as _blackbox
+from ..exceptions import ShutdownError
+from . import wire
+from .coordinator import (MSG_BYE, MSG_JOURNAL, MSG_REPL_HELLO, MSG_SNAPSHOT,
+                          CoordinatorServer, _advertise_host, _publish_key)
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class StandbyCoordinator:
+    """Rank 1's warm standby: replicates the primary's durable state and
+    promotes itself when the replication stream dies unannounced."""
+
+    def __init__(self, rank: int, gen: int, host: str, port: int,
+                 secret: str, make_state: Callable,
+                 should_promote: Callable[[], bool]):
+        self._rank = rank
+        self._gen = gen
+        self._addr = (host, port)
+        self._secret = secret
+        self._make_state = make_state
+        self._should_promote = should_promote
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # replica of the primary's durable state, updated per frame
+        self._have_snapshot = False
+        self._jseq = 0
+        self._epoch = 0
+        self._world = 0
+        self._elastic = True
+        self._members: List[int] = []
+        self._next_cache_id = 0
+        self.promoted = False
+        self.server: Optional[CoordinatorServer] = None
+        self._thread = threading.Thread(
+            target=self._run, name="hvd_standby", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Intentional stand-down (worker shutdown/interrupt): never treat
+        the teardown that follows as a dead primary."""
+        self._stop.set()
+        with self._lock:
+            server = self.server
+        if server is not None:
+            # release any exchange still blocked in the promoted state
+            # machine with a proper shutdown response before freeing the
+            # port — survivors see a clean coordinated shutdown, not a
+            # second dead coordinator
+            server.state.set_bye()
+            server.stop()
+
+    # ------------------------------------------------------------ replication
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(self._addr, timeout=5)
+        sock.settimeout(0.5)
+        wire.send_frame(sock, self._secret, MSG_REPL_HELLO, 0, self._rank)
+        return sock
+
+    def _run(self) -> None:
+        sock: Optional[socket.socket] = None
+        for _ in range(5):
+            try:
+                sock = self._dial()
+                break
+            except (ConnectionError, OSError):
+                if self._stop.wait(0.2):
+                    return
+        if sock is None:
+            logger.warning("standby: never reached the primary's "
+                           "replication endpoint; standby inactive")
+            return
+        try:
+            while not self._stop.is_set():
+                try:
+                    mt, _, _, payload = wire.recv_frame(sock, self._secret,
+                                                        self._stop)
+                except ShutdownError:
+                    return
+                except (ConnectionError, OSError) as exc:
+                    if self._stop.is_set():
+                        return
+                    redialed = self._redial()
+                    if redialed is not None:
+                        sock = redialed
+                        continue
+                    if self._have_snapshot and self._should_promote():
+                        self._promote(exc)
+                    return
+                if mt == MSG_SNAPSHOT:
+                    (self._jseq, self._epoch, self._world, self._elastic,
+                     self._members,
+                     self._next_cache_id) = wire.decode_coord_snapshot(
+                         payload)
+                    self._have_snapshot = True
+                    instruments.standby_journal_lag().set(0)
+                elif mt == MSG_JOURNAL:
+                    (self._jseq, self._epoch, self._members,
+                     _reason) = wire.decode_coord_journal(payload)
+                elif mt == MSG_BYE:
+                    # clean coordinator end: stand down, never promote
+                    logger.info("standby: primary said BYE; standing down")
+                    return
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _redial(self) -> Optional[socket.socket]:
+        """A few quick re-dials distinguish a transient blip from a dead
+        primary; the real grace period is the workers' reconnect window."""
+        for _ in range(3):
+            if self._stop.wait(0.3):
+                return None
+            try:
+                return self._dial()
+            except (ConnectionError, OSError):
+                continue
+        return None
+
+    # -------------------------------------------------------------- promotion
+    def _promote(self, why: Exception) -> None:
+        state = self._make_state()
+        with state.cv:
+            state.epoch = self._epoch
+            state.members = set(self._members)
+            state.committed = set()
+            state.next_cache_id = self._next_cache_id
+            state.jseq = self._jseq
+        advertise = _advertise_host()
+        bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
+        server = CoordinatorServer(state, self._secret, host=bind)
+        # declare rank 0 lost BEFORE publishing the address: the first
+        # worker to find us must already see the post-failover epoch, never
+        # a window where the old membership looks intact
+        state.rank_lost(0, "coordinator failover: rank 0 died (%s); "
+                           "standby (rank 1) promoted" % (why,))
+        with self._lock:
+            self.server = server
+            self.promoted = True
+        _publish_key(f"addr.{self._gen}.f1",
+                     f"{advertise}:{server.port}", self._secret)
+        instruments.coord_failovers().inc()
+        _blackbox.record(_blackbox.K_FAILOVER, "rank_%d" % self._rank,
+                         "standby promoted to coordinator at %s:%d "
+                         "(epoch %d -> %d, members %s)"
+                         % (advertise, server.port, self._epoch,
+                            state.epoch, sorted(state.members)),
+                         rank=self._rank)
+        logger.warning(
+            "standby: replication stream died (%s); PROMOTED to "
+            "coordinator at %s:%d, epoch %d, members %s",
+            why, advertise, server.port, state.epoch,
+            sorted(state.members))

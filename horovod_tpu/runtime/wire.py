@@ -48,7 +48,9 @@ _HEAD = struct.Struct("<BIi")
 # rate and would wash everything else out of the ring.
 _FRAME_NAMES = {1: "HELLO", 2: "LIST", 3: "RESP", 4: "BYE", 7: "METRICS",
                 8: "HEARTBEAT", 9: "RESUME", 10: "TRACE", 11: "CLOCK",
-                12: "CLOCK_RESP", 13: "BLACKBOX"}
+                12: "CLOCK_RESP", 13: "BLACKBOX", 14: "BATCH",
+                15: "BATCH_RESP", 16: "BATCH_HB", 17: "REPL_HELLO",
+                18: "SNAPSHOT", 19: "JOURNAL"}
 
 
 def _frame_limit() -> int:
@@ -734,3 +736,111 @@ def decode_data_result(buf: bytes):
     n = rd.u32()
     payload = rd.buf[rd.off:rd.off + n]
     return status, epoch, nparticipants, members, payload
+
+
+# --------------------------------------------------------------------------
+# Hierarchical control plane (MSG_BATCH / MSG_BATCH_RESP / MSG_BATCH_HB).
+# A per-host sub-coordinator aggregates its local ranks' negotiation frames
+# and ships ONE batched frame per round to rank 0, which answers with one
+# batched response — rank 0 does O(hosts) frame work per round instead of
+# O(ranks) (docs/control-plane.md). Entries are opaque (rank, seq, payload)
+# triples: the inner payloads are ordinary request/response-list bytes, so
+# the batch layer composes with every existing codec unchanged.
+# --------------------------------------------------------------------------
+
+def encode_batched_entries(entries: List[Tuple[int, int, bytes]]) -> bytes:
+    """Shared layout for MSG_BATCH and MSG_BATCH_RESP:
+    [(rank, seq, inner_payload)]."""
+    w = Writer()
+    w.u32(len(entries))
+    for rank, seq, payload in entries:
+        w.i32(rank)
+        w.u32(seq)
+        w.u32(len(payload))
+        w.parts.append(payload)
+    return w.getvalue()
+
+
+def decode_batched_entries(buf: bytes) -> List[Tuple[int, int, bytes]]:
+    rd = Reader(buf)
+    entries = []
+    for _ in range(rd.u32()):
+        rank = rd.i32()
+        seq = rd.u32()
+        n = rd.u32()
+        entries.append((rank, seq, rd.buf[rd.off:rd.off + n]))
+        rd.off += n
+    return entries
+
+
+def encode_batched_heartbeat(ranks: List[int]) -> bytes:
+    """MSG_BATCH_HB: every listed local rank is alive as of this frame."""
+    w = Writer()
+    w.u32(len(ranks))
+    for r in ranks:
+        w.i32(r)
+    return w.getvalue()
+
+
+def decode_batched_heartbeat(buf: bytes) -> List[int]:
+    rd = Reader(buf)
+    return [rd.i32() for _ in range(rd.u32())]
+
+
+# --------------------------------------------------------------------------
+# Coordinator replication stream (MSG_REPL_HELLO / MSG_SNAPSHOT /
+# MSG_JOURNAL). A warm-standby coordinator dials rank 0, identifies itself
+# with REPL_HELLO, receives one SNAPSHOT of the membership state, then a
+# JOURNAL record per epoch change. Collective negotiation state is NOT
+# replicated: promotion always bumps the epoch (rank 0 was a member and
+# just died), which makes every worker drop in-flight negotiation and
+# re-sync from its elastic commit — so membership is the only durable
+# state (docs/control-plane.md).
+# --------------------------------------------------------------------------
+
+def encode_coord_snapshot(jseq: int, epoch: int, world: int, elastic: bool,
+                          members: List[int], next_cache_id: int) -> bytes:
+    w = Writer()
+    w.i64(jseq)
+    w.i32(epoch)
+    w.i32(world)
+    w.u8(int(elastic))
+    w.u32(len(members))
+    for r in members:
+        w.i32(r)
+    w.i32(next_cache_id)
+    return w.getvalue()
+
+
+def decode_coord_snapshot(buf: bytes):
+    """Returns (jseq, epoch, world, elastic, members, next_cache_id)."""
+    rd = Reader(buf)
+    jseq = rd.i64()
+    epoch = rd.i32()
+    world = rd.i32()
+    elastic = rd.u8() != 0
+    members = [rd.i32() for _ in range(rd.u32())]
+    next_cache_id = rd.i32()
+    return jseq, epoch, world, elastic, members, next_cache_id
+
+
+def encode_coord_journal(jseq: int, epoch: int, members: List[int],
+                         reason: str) -> bytes:
+    w = Writer()
+    w.i64(jseq)
+    w.i32(epoch)
+    w.u32(len(members))
+    for r in members:
+        w.i32(r)
+    w.str(reason)
+    return w.getvalue()
+
+
+def decode_coord_journal(buf: bytes):
+    """Returns (jseq, epoch, members, reason)."""
+    rd = Reader(buf)
+    jseq = rd.i64()
+    epoch = rd.i32()
+    members = [rd.i32() for _ in range(rd.u32())]
+    reason = rd.str()
+    return jseq, epoch, members, reason

@@ -12,7 +12,12 @@ multi-process runs.
 """
 
 import os
+import pickle
+import socket
+import subprocess
+import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -843,3 +848,744 @@ def test_stall_names_me_parsing():
                                        1: (0, [], [])})
     ctrl._rank = 1
     assert ctrl._stall_names_me(warns[0])
+
+
+# =================================================== survivable control plane
+# (docs/control-plane.md: hierarchical negotiation, coordinator failover,
+# storm-proof rendezvous)
+
+def _req_payload(name="g", flags=0, epoch=-1):
+    return wire.encode_request_list(flags, [], [meta(name)], epoch=epoch)
+
+
+class TestBatchedExchange:
+    def test_batch_completes_round_in_one_frame(self):
+        st = make_state(world=2)
+        out = st.exchange_batch([(0, 0, _req_payload()),
+                                 (1, 0, _req_payload())])
+        replies, deferred = out
+        assert deferred == []
+        assert sorted((r, s) for r, s, _ in replies) == [(0, 0), (1, 0)]
+        for _, _, data in replies:
+            _, _, resps, _, _ = wire.decode_response_list(data)[:5]
+            assert len(resps) == 1 and resps[0].tensor_names == ["g"]
+        # ONE control frame reached the state machine for the whole round
+        assert st.frames_in == 1
+
+    def test_batch_replay_is_idempotent(self):
+        st = make_state(world=2)
+        first, _ = st.exchange_batch([(0, 0, _req_payload()),
+                                      (1, 0, _req_payload())])
+        again, _ = st.exchange_batch([(0, 0, _req_payload()),
+                                      (1, 0, _req_payload())])
+        assert sorted(first) == sorted(again)  # answered from replay cache
+
+    def test_batch_and_flat_interoperate(self):
+        """One host batched, one rank flat: the same barrier serves both."""
+        st = make_state(world=3)
+        out = {}
+
+        def flat():
+            out[2] = st.exchange(2, 0, _req_payload())
+
+        t = threading.Thread(target=flat)
+        t.start()
+        replies, _ = st.exchange_batch([(0, 0, _req_payload()),
+                                        (1, 0, _req_payload())])
+        t.join(timeout=30)
+        assert not t.is_alive()
+        datas = {r: d for r, _, d in replies}
+        assert datas[0] == datas[1] == out[2]
+
+    def test_elastic_joiner_is_deferred_not_blocking(self):
+        """A joiner entry inside a batch must NOT stall the members' round
+        (its admission spans their future commits): it comes back in the
+        deferred list for the server to answer from a dedicated thread."""
+        st = make_state(world=2, elastic=True)
+        replies, deferred = st.exchange_batch(
+            [(0, 0, _req_payload(epoch=0)),
+             (1, 0, _req_payload(epoch=0)),
+             (5, 0, _req_payload(epoch=0))])
+        assert [(r, s) for r, s, _ in deferred] == [(5, 0)]
+        assert sorted(r for r, _, _ in replies) == [0, 1]
+
+
+class TestHostAggregator:
+    def _echo_agg(self, linger_s=5.0):
+        from horovod_tpu.runtime.hierarchy import HostAggregator
+
+        holder = {}
+
+        def flush(entries):
+            # upstream stand-in: echo each payload back as the reply
+            for r, s, p in entries:
+                holder["agg"].deliver(r, s, b"re:" + p)
+
+        holder["agg"] = HostAggregator(flush, linger_s=linger_s)
+        return holder["agg"]
+
+    def test_full_host_flushes_one_batch(self):
+        agg = self._echo_agg(linger_s=60.0)  # linger must NOT be needed
+        for r in range(4):
+            agg.register(r)
+        out = {}
+        ts = [threading.Thread(target=lambda r=r: out.update(
+            {r: agg.submit(r, 7, b"p%d" % r)})) for r in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert out == {r: b"re:p%d" % r for r in range(4)}
+        assert agg.flushes == 1
+
+    def test_linger_flushes_partial_batch(self):
+        agg = self._echo_agg(linger_s=0.05)
+        agg.register(0)
+        agg.register(1)  # never submits
+        t0 = time.monotonic()
+        assert agg.submit(0, 0, b"x") == b"re:x"
+        assert 0.04 <= time.monotonic() - t0 < 5.0
+        assert agg.flushes == 1
+
+    def test_close_releases_submitters(self):
+        from horovod_tpu.runtime.hierarchy import (AggregatorClosed,
+                                                   HostAggregator)
+
+        agg = HostAggregator(lambda entries: None, linger_s=60.0)
+        agg.register(0)
+        agg.register(1)
+        err = {}
+
+        def blocked():
+            try:
+                agg.submit(0, 0, b"x")
+            except AggregatorClosed as exc:
+                err["got"] = exc
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.05)
+        agg.close()
+        t.join(timeout=10)
+        assert not t.is_alive() and "got" in err
+        # AggregatorClosed must walk the worker's ConnectionError path
+        assert isinstance(err["got"], ConnectionError)
+
+
+def test_hierarchical_1024_ranks_is_o_hosts():
+    """Acceptance: 1024 fake ranks on 16 simulated hosts drive the REAL
+    CoordState through exchange_batch. Every negotiation round must reach
+    rank 0 as O(hosts) frames (16, not 1024) and complete within budget."""
+    world, hosts = 1024, 16
+    per_host = world // hosts
+    st = make_state(world=world, threshold=0)
+    payload = _req_payload()
+    for rnd in range(3):
+        frames_before = st.frames_in
+        results = {}
+
+        def host_thread(h, rnd=rnd):
+            entries = [(h * per_host + i, rnd, payload)
+                       for i in range(per_host)]
+            replies, deferred = st.exchange_batch(entries)
+            assert deferred == []
+            results[h] = replies
+
+        t0 = time.monotonic()
+        ts = [threading.Thread(target=host_thread, args=(h,))
+              for h in range(hosts)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        elapsed = time.monotonic() - t0
+        assert all(not t.is_alive() for t in ts), "round deadlocked"
+        assert elapsed < 30.0, f"1024-rank round took {elapsed:.1f}s"
+        # O(hosts): exactly one frame per simulated host reached rank 0
+        assert st.frames_in - frames_before == hosts
+        assert sum(len(r) for r in results.values()) == world
+        for replies in results.values():
+            for _, _, data in replies:
+                _, _, resps, _, _ = wire.decode_response_list(data)[:5]
+                assert len(resps) == 1
+
+
+class TestStormProofRendezvous:
+    def test_join_storm_coalesces_to_one_epoch(self, monkeypatch):
+        """64 simultaneous joiners -> exactly ONE membership epoch bump."""
+        from horovod_tpu.metrics import instruments
+
+        monkeypatch.setenv("HOROVOD_ADMISSION_BATCH_MS", "200")
+        st = make_state(world=4, elastic=True)
+        with st.cv:
+            st.committed = set(st.members)  # commit boundary already open
+        coalesced0 = instruments.epoch_coalesced_joins().value
+        out = {}
+        ts = [threading.Thread(target=lambda r=r: out.update(
+            {r: st.exchange(r, 0, _req_payload(epoch=0))}))
+            for r in range(100, 164)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert all(not t.is_alive() for t in ts)
+        assert st.epoch == 1, "join storm must cost exactly one epoch bump"
+        assert len(st.members) == 4 + 64
+        for data in out.values():
+            rflags, _, _, _, _ = wire.decode_response_list(data)[:5]
+            assert rflags & wire.RESP_RANKS_CHANGED
+        assert (instruments.epoch_coalesced_joins().value
+                - coalesced0) == 63
+
+    def test_loss_storm_coalesces_to_one_epoch(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ADMISSION_BATCH_MS", "100")
+        st = make_state(world=8, elastic=True)
+        for r in (5, 6, 7):
+            st.rank_lost(r, "test kill")
+        assert st.epoch == 0  # coalescing window still open
+        time.sleep(0.15)
+        data = st.exchange(0, 0, _req_payload(epoch=0))  # triggers flush
+        rflags, _, _, _, _ = wire.decode_response_list(data)[:5]
+        assert rflags & wire.RESP_RANKS_CHANGED
+        assert st.epoch == 1, "3 near-simultaneous losses -> ONE bump"
+        assert st.members == {0, 1, 2, 3, 4}
+        assert "workers lost: ranks [5, 6, 7]" in st.reset_reason
+        assert "lost" in st.reset_reason  # keeps WorkerLostError mapping
+
+    def test_admission_batch_off_keeps_historical_behavior(self):
+        st = make_state(world=4, elastic=True)
+        st.rank_lost(3, "a")
+        st.rank_lost(2, "b")
+        assert st.epoch == 2  # one bump per loss, exactly as before
+
+
+class TestReconnectBackoff:
+    def test_zero_jitter_matches_legacy_schedule(self):
+        from horovod_tpu.runtime.coordinator import _backoff_schedule
+
+        legacy = [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0]
+        got = [_backoff_schedule(rank, a, 0.05, 2.0, 0.0)
+               for rank in (0, 7, 511) for a in range(1, 9)]
+        assert got == legacy * 3
+
+    def test_jitter_envelope_and_dispersion(self):
+        from horovod_tpu.runtime.coordinator import _backoff_schedule
+
+        jitter = 0.5
+        for attempt in (1, 3, 5):
+            base = min(0.05 * 2 ** (attempt - 1), 2.0)
+            delays = [_backoff_schedule(r, attempt, 0.05, 2.0, jitter)
+                      for r in range(256)]
+            # bounded-jitter envelope: [backoff, backoff * (1 + jitter)]
+            assert all(base <= d <= base * (1 + jitter) + 1e-12
+                       for d in delays)
+            # a mass reconnect must actually disperse, not re-synchronize
+            assert len(set(delays)) > 200
+            spread = max(delays) - min(delays)
+            assert spread > base * jitter * 0.8
+
+    def test_jitter_is_deterministic(self):
+        from horovod_tpu.runtime.coordinator import _backoff_schedule
+
+        a = [_backoff_schedule(r, 2, 0.05, 2.0, 0.3) for r in range(32)]
+        b = [_backoff_schedule(r, 2, 0.05, 2.0, 0.3) for r in range(32)]
+        assert a == b
+
+
+class TestFlatWireByteIdentity:
+    """With the new knobs unset, every byte the flat path produces must be
+    identical to the pre-hierarchy implementation. Pinned against golden
+    hex captured from the wire codecs (any codec change that touches the
+    legacy encodings fails here)."""
+
+    GOLDEN_REQ = (
+        "010200000003000000070000000100000006000000676f6c64656e000000000700"
+        "0000666c6f617433320200000004000000000000000200000000000000ffffffff"
+        "00000000000000f03f000000000000f03f0000000000010010000000000000000000"
+        "000000e03fffffffff")
+    GOLDEN_RESP = (
+        "0000000000ffffffff01000000000000000100000006000000676f6c64656e0000"
+        "000007000000666c6f617433320000000000000000000000f03f000000000000f0"
+        "3fffffffff010000000200000004000000000000000200000000000000000000000"
+        "1000000000000000000000000ffffffff0000000000000000")
+    GOLDEN_FRAME = (
+        "0700000002050000000100000016ba5246c103e036de847bf73707e118409b449c"
+        "cf86f5682e731aebda8fed6e6cb24e177061796c6f6164")
+
+    def test_request_list_bytes_pinned(self):
+        m = wire.ReqMeta("golden", 0, "float32", (4, 2))
+        req = wire.encode_request_list(1, [3, 7], [m], score=(4096, 0.5),
+                                       epoch=-1)
+        assert req.hex() == self.GOLDEN_REQ
+
+    def test_response_list_bytes_pinned(self):
+        st = make_state(world=2, threshold=0)
+        m = wire.ReqMeta("golden", 0, "float32", (4, 2))
+        out = st._negotiate({0: (0, [], [m]), 1: (0, [], [m])})
+        assert out.hex() == self.GOLDEN_RESP
+
+    def test_frame_bytes_pinned(self):
+        a, b = socket.socketpair()
+        try:
+            wire.send_frame(a, "s3cret", 2, 5, 1, b"payload")
+            b.settimeout(5)
+            got = b.recv(65536)
+        finally:
+            a.close()
+            b.close()
+        assert got.hex() == self.GOLDEN_FRAME
+
+    def test_flat_controllers_send_only_legacy_frame_types(
+            self, monkeypatch, tmp_path):
+        """Spy on send_frame across a real 2-rank exchange with the knobs
+        unset: no frame type beyond the legacy 1-13 range may appear."""
+        from horovod_tpu.run import rendezvous
+
+        monkeypatch.delenv("HOROVOD_HIERARCHICAL_COORD", raising=False)
+        monkeypatch.delenv("HOROVOD_STANDBY_COORD", raising=False)
+        monkeypatch.delenv("HOROVOD_ADMISSION_BATCH_MS", raising=False)
+        sent_types = []
+        real = wire.send_frame
+
+        def spy(sock, secret, msg_type, seq, rank, payload=b""):
+            sent_types.append(msg_type)
+            return real(sock, secret, msg_type, seq, rank, payload)
+
+        monkeypatch.setattr(wire, "send_frame", spy)
+        secret = rendezvous.make_secret()
+        kv = rendezvous.KVStoreServer(secret).start()
+        monkeypatch.setenv("HVD_KV_ADDR", f"127.0.0.1:{kv.port}")
+        monkeypatch.setenv("HVD_SECRET", secret)
+        common = dict(world=2, fusion_threshold=64 << 20,
+                      stall_warning_s=60.0, stall_shutdown_s=0.0,
+                      cache_capacity=64, fusion_enabled=True,
+                      timeline_path=None, autotune=False, cycle_time_ms=5.0)
+        c0 = CoordController(self_rank=0, **common)
+        c1 = CoordController(self_rank=1, **common)
+        try:
+            from horovod_tpu.runtime.messages import TensorTableEntry
+            from horovod_tpu.runtime.messages import RequestType as RT
+
+            for c, r in ((c0, 0), (c1, 1)):
+                c.submit(TensorTableEntry(
+                    tensor_name="t", rank=r,
+                    request_type=RT.ALLREDUCE,
+                    array=np.zeros((4,), np.float32)))
+            out = {}
+            t = threading.Thread(target=lambda: out.update({0: c0.tick()}))
+            t.start()
+            out[1] = c1.tick()
+            t.join(timeout=30)
+            assert out[0] is not None and out[1] is not None
+        finally:
+            c0.shutdown()
+            c1.shutdown()
+            kv.stop()
+        assert sent_types, "spy never saw a frame"
+        assert max(sent_types) <= 13, (
+            f"non-legacy frame types on the flat path: "
+            f"{sorted(set(t for t in sent_types if t > 13))}")
+
+
+class TestJournalReplication:
+    def test_snapshot_and_journal_roundtrip(self):
+        snap = wire.encode_coord_snapshot(9, 4, 128, True, [1, 2, 5], 77)
+        assert wire.decode_coord_snapshot(snap) == (9, 4, 128, True,
+                                                    [1, 2, 5], 77)
+        rec = wire.encode_coord_journal(10, 5, [1, 2], "worker lost: x")
+        assert wire.decode_coord_journal(rec) == (10, 5, [1, 2],
+                                                  "worker lost: x")
+
+    def test_attach_streams_snapshot_then_journal(self):
+        import queue
+
+        from horovod_tpu.runtime.coordinator import MSG_JOURNAL, MSG_SNAPSHOT
+
+        st = make_state(world=3, elastic=True)
+        q = queue.Queue()
+        st.attach_journal(q)
+        mt, payload = q.get(timeout=5)
+        assert mt == MSG_SNAPSHOT
+        jseq, epoch, world, elastic, members, ncid = \
+            wire.decode_coord_snapshot(payload)
+        assert (jseq, epoch, world, elastic) == (0, 0, 3, True)
+        assert members == [0, 1, 2]
+        st.rank_lost(2, "test")
+        mt, payload = q.get(timeout=5)
+        assert mt == MSG_JOURNAL
+        jseq, epoch, members, reason = wire.decode_coord_journal(payload)
+        assert (jseq, epoch, members) == (1, 1, [0, 1])
+        assert "worker lost" in reason
+        st.detach_journal(q)
+        st.rank_lost(1, "test2")
+        assert q.empty()
+
+
+class TestCoordinatorFaultKinds:
+    def test_slow_spec_parses_milliseconds(self):
+        from horovod_tpu.faultinject.spec import parse_spec
+
+        rules = parse_spec("slow@coordinator:50")
+        assert len(rules) == 1
+        assert rules[0].kind == "slow"
+        assert rules[0].point == "coordinator"
+        assert abs(rules[0].seconds - 0.05) < 1e-9
+
+    def test_die_spec_parses(self):
+        from horovod_tpu.faultinject.spec import parse_spec
+
+        rules = parse_spec("die@coordinator#0")
+        assert rules[0].kind == "die"
+        assert rules[0].applies_to(0) and not rules[0].applies_to(1)
+
+    def test_slow_coordinator_delays_negotiation(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_SPEC", "slow@coordinator:80")
+        st = make_state(world=1)
+        server = CoordinatorServer(st, "")
+        try:
+            t0 = time.monotonic()
+            st.exchange(0, 0, _req_payload())
+            assert time.monotonic() - t0 >= 0.08
+        finally:
+            server.stop()
+
+    def test_die_coordinator_severs_service(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_SPEC", "die@coordinator")
+        st = make_state(world=1)
+        server = CoordinatorServer(st, "")
+        port = server.port
+        try:
+            st.exchange(0, 0, _req_payload())  # first negotiation -> die
+            deadline = time.monotonic() + 5
+            refused = False
+            while time.monotonic() < deadline:
+                try:
+                    s = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=0.5)
+                    s.close()
+                    time.sleep(0.05)
+                except OSError:
+                    refused = True
+                    break
+            assert refused, "die@coordinator left the service reachable"
+        finally:
+            server.stop()
+
+
+class TestStandbyPromotion:
+    def _kv(self, monkeypatch):
+        from horovod_tpu.run import rendezvous
+
+        secret = rendezvous.make_secret()
+        kv = rendezvous.KVStoreServer(secret).start()
+        monkeypatch.setenv("HVD_KV_ADDR", f"127.0.0.1:{kv.port}")
+        monkeypatch.setenv("HVD_SECRET", secret)
+        return kv, secret
+
+    def test_promotes_on_abrupt_death_not_on_bye(self, monkeypatch):
+        from horovod_tpu.metrics import instruments
+        from horovod_tpu.runtime.coordinator import _resolve_key
+        from horovod_tpu.runtime.standby import StandbyCoordinator
+
+        kv, secret = self._kv(monkeypatch)
+        st = make_state(world=3, elastic=True)
+        server = CoordinatorServer(st, secret)
+        failovers0 = instruments.coord_failovers().value
+        sb = StandbyCoordinator(
+            rank=1, gen=777, host="127.0.0.1", port=server.port,
+            secret=secret,
+            make_state=lambda: make_state(world=3, elastic=True),
+            should_promote=lambda: True)
+        sb.start()
+        try:
+            deadline = time.monotonic() + 10
+            while not sb._have_snapshot and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sb._have_snapshot, "standby never received the snapshot"
+            # an epoch change replicates as one journal record
+            st.rank_lost(2, "test kill")
+            deadline = time.monotonic() + 10
+            while sb._epoch != 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sb._epoch == 1 and sb._members == [0, 1]
+            # abrupt death (no BYE): the standby must promote
+            server.die()
+            deadline = time.monotonic() + 15
+            while not sb.promoted and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sb.promoted, "standby never promoted after die()"
+            assert sb.server is not None
+            # promotion itself is a membership reset losing rank 0
+            assert sb.server.state.epoch == 2
+            assert sb.server.state.members == {1}
+            assert (instruments.coord_failovers().value
+                    - failovers0) == 1
+            # workers find the promoted address under the failover key
+            addr, fsecret = _resolve_key("addr.777.f1", timeout=5)
+            assert fsecret == secret
+            host, port = addr.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=5)
+            s.close()
+        finally:
+            sb.stop()
+            server.stop()
+            kv.stop()
+
+    def test_stands_down_on_clean_bye(self, monkeypatch):
+        from horovod_tpu.runtime.standby import StandbyCoordinator
+
+        kv, secret = self._kv(monkeypatch)
+        st = make_state(world=2, elastic=True)
+        server = CoordinatorServer(st, secret)
+        sb = StandbyCoordinator(
+            rank=1, gen=778, host="127.0.0.1", port=server.port,
+            secret=secret,
+            make_state=lambda: make_state(world=2, elastic=True),
+            should_promote=lambda: True)
+        sb.start()
+        try:
+            deadline = time.monotonic() + 10
+            while not sb._have_snapshot and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sb._have_snapshot
+            st.set_bye()  # clean coordinated shutdown
+            server.stop()
+            sb._thread.join(timeout=10)
+            assert not sb._thread.is_alive()
+            assert not sb.promoted, "clean BYE must never trigger promotion"
+        finally:
+            sb.stop()
+            kv.stop()
+
+
+# --------------------------------------- integration: coordinator SIGKILL
+def _failover_train_fn():
+    """3 ranks; rank 0 (the coordinator) dies abruptly at step 5; the warm
+    standby on rank 1 promotes and ranks 1+2 finish 12 steps. Per-rank
+    gradients make the membership change observable in the parameter
+    trajectory. Returns (step, w, epoch, members) rows."""
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    state = hvd.elastic.ElasticState(w=np.array([4.0], np.float32), step=0)
+    log = []
+    target = np.float32(1.0)
+
+    @hvd.elastic.run_fn
+    def train(state):
+        ctrl = hvd.basics._engine().controller
+        while state.step < 12:
+            if hvd.rank() == 0 and state.step == 5:
+                os._exit(23)  # SIGKILL-equivalent: no BYE, server dies too
+            g = np.float32(hvd.rank() + 1) * (np.asarray(state.w) - target)
+            avg = hvd.allreduce(g, name=f"grad{state.step}",
+                                op=hvd.Average)
+            state.w = np.asarray(state.w) - np.float32(0.1) * \
+                np.asarray(avg, np.float32)
+            log.append((state.step, float(np.asarray(state.w)[0]),
+                        ctrl.epoch(), list(ctrl.members())))
+            state.step += 1
+            state.commit()
+        return log
+
+    return train(state)
+
+
+@pytest.mark.integration
+def test_coordinator_sigkill_failover_bit_identical():
+    """ISSUE acceptance: SIGKILL rank 0 mid-training with the standby
+    enabled -> training resumes on the promoted coordinator with no lost
+    or double-applied step, and both survivors hold bit-identical
+    parameters matching the expected trajectory."""
+    import cloudpickle
+
+    from horovod_tpu.run import rendezvous
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_failover_train_fn, (), {})))
+
+    procs = []
+    try:
+        for r in range(3):
+            env = dict(os.environ)
+            env.update({
+                "HVD_NUM_PROCS": "3",
+                "HVD_PROCESS_ID": str(r),
+                "HVD_KV_ADDR": addr,
+                "HVD_SECRET": secret,
+                "HVD_ELASTIC": "1",
+                "HOROVOD_STANDBY_COORD": "1",
+                "HOROVOD_RECONNECT_GRACE": "2",
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "PYTHONPATH": os.pathsep.join(
+                    [os.path.dirname(here), here]),
+            })
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+        deadline = time.time() + 180
+        blobs = {}
+        while time.time() < deadline and len(blobs) < 2:
+            for r in (1, 2):
+                if r not in blobs:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+            if len(blobs) < 2 and all(p.poll() is not None for p in procs):
+                time.sleep(1.0)  # final PUTs may still be in flight
+                for r in (1, 2):
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+                break
+            time.sleep(0.25)
+        assert len(blobs) == 2, (
+            f"survivors produced no result (got ranks {sorted(blobs)}); "
+            f"exit codes {[p.poll() for p in procs]}")
+        logs = {}
+        for r, blob in blobs.items():
+            ok, log = pickle.loads(blob)
+            assert ok, f"rank {r} raised:\n{log}"
+            logs[r] = log
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
+
+    # rank 0 must have died with its marker code, not finished
+    assert procs[0].wait(timeout=10) == 23
+
+    for r in (1, 2):
+        steps = [row[0] for row in logs[r]]
+        # every step exactly once: none lost, none double-applied
+        assert steps == list(range(12)), (r, steps)
+        epochs = {s: e for s, _, e, _ in logs[r]}
+        assert all(epochs[s] == 0 for s in range(5)), (r, epochs)
+        # the failover reset bumps the epoch exactly once
+        assert all(epochs[s] == 1 for s in range(5, 12)), (r, epochs)
+        assert logs[r][4][3] == [0, 1, 2], (r, logs[r][4])
+        assert logs[r][-1][3] == [1, 2], (r, logs[r][-1])
+
+    # bit-identical across survivors at every step
+    w1 = [row[1] for row in logs[1]]
+    w2 = [row[1] for row in logs[2]]
+    assert w1 == w2, "survivors diverged after failover"
+
+    # and on the expected trajectory: mean(rank+1) is 2.0 with members
+    # {0,1,2} (steps 0-4) and 2.5 with {1,2} (steps 5-11)
+    w = 4.0
+    for step in range(12):
+        c = 2.0 if step < 5 else 2.5
+        w = w - 0.1 * c * (w - 1.0)
+        got = w1[step]
+        assert abs(got - w) < 1e-4 * max(1.0, abs(w)), (
+            f"step {step}: got {got}, expected ~{w} — a step was lost or "
+            f"double-applied across the failover")
+
+
+# ------------------------------------- integration: hierarchical mode e2e
+def _hier_train_fn():
+    """3 ranks on one simulated host with HOROVOD_HIERARCHICAL_COORD=1:
+    ranks 1 and 2 negotiate through the host leader's sub-coordinator over
+    real sockets; results must match the flat path exactly."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = []
+    w = np.asarray(hvd.broadcast(np.ones(4, np.float32) * (r + 1),
+                                 root_rank=0, name="w0"))
+    out.append(w.tolist())
+    for i in range(5):
+        s = hvd.allreduce(np.ones(4, np.float32) * (r + 1),
+                          name=f"h{i}", op=hvd.Sum)
+        out.append(np.asarray(s).tolist())
+    hvd.shutdown()
+    return out
+
+
+@pytest.mark.integration
+def test_hierarchical_mode_end_to_end():
+    """The sub-coordinator path over real processes and sockets: host
+    leader aggregates its local ranks' frames, DATA-plane broadcast rides
+    the direct rank-0 connection, and every collective result is exact."""
+    import cloudpickle
+
+    from horovod_tpu.run import rendezvous
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_hier_train_fn, (), {})))
+
+    procs = []
+    try:
+        for r in range(3):
+            env = dict(os.environ)
+            env.update({
+                "HVD_NUM_PROCS": "3",
+                "HVD_PROCESS_ID": str(r),
+                "HVD_KV_ADDR": addr,
+                "HVD_SECRET": secret,
+                "HVD_ELASTIC": "1",
+                "HVD_LOCAL_RANK": str(r),
+                "HVD_CROSS_RANK": "0",
+                "HOROVOD_HIERARCHICAL_COORD": "1",
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "PYTHONPATH": os.pathsep.join(
+                    [os.path.dirname(here), here]),
+            })
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+        deadline = time.time() + 120
+        blobs = {}
+        while time.time() < deadline and len(blobs) < 3:
+            for r in range(3):
+                if r not in blobs:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+            if len(blobs) < 3 and all(p.poll() is not None for p in procs):
+                time.sleep(1.0)
+                for r in range(3):
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+                break
+            time.sleep(0.25)
+        assert len(blobs) == 3, (
+            f"hier job incomplete: results from {sorted(blobs)}, exit "
+            f"codes {[p.poll() for p in procs]}")
+        for r, blob in blobs.items():
+            ok, out = pickle.loads(blob)
+            assert ok, f"rank {r} raised:\n{out}"
+            assert out[0] == [1.0] * 4          # broadcast from rank 0
+            for row in out[1:]:
+                assert row == [6.0] * 4         # 1+2+3 summed exactly
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
