@@ -157,16 +157,19 @@ def bench_allgather(sizes_mb, iters, warmup):
     return results
 
 
-_COMPRESSION_MODES = ("none", "bf16", "int8", "int8-dcn")
+_COMPRESSION_MODES = ("none", "bf16", "int8", "int8-dcn", "int4", "adaptive")
 
 
 def bench_compression(sizes_mb, iters, warmup, modes):
-    """Wire-mode sweep through the eager engine: same fp32 payload, four
-    wire formats. Reports the bytes each mode actually moves (the
+    """Wire-mode sweep through the eager engine: same fp32 payload, each
+    wire format. Reports the bytes each mode actually moves (the
     executor's per-rank reduce+gather accounting — int8 pays 1 byte/elem +
-    one f32 scale per block, on both hops) and the resulting wire GB/s.
-    ``int8-dcn`` runs on a synthetic 2-host topology (HVD_LOCAL_SIZE=2) so
-    the mixed bf16-ICI/int8-DCN program actually compiles.
+    one f32 scale per block, int4 packs two values per byte, on both hops)
+    and the resulting wire GB/s. ``int8-dcn`` runs on a synthetic 2-host
+    topology (HVD_LOCAL_SIZE=2) so the mixed bf16-ICI/int8-DCN program
+    actually compiles. ``adaptive`` feeds the bitwidth selector during
+    warmup (extended past the decision interval) so the timed iterations
+    ride the converged per-bucket grid.
     """
     import horovod_tpu as hvd
     from horovod_tpu import testing
@@ -182,14 +185,29 @@ def bench_compression(sizes_mb, iters, warmup, modes):
                 import time as _t
 
                 from horovod_tpu import basics
+                from horovod_tpu.ops import adaptive as _ad
 
                 c = comp.by_name(mode)
+                observe = getattr(c, "observe", None)
+                if observe is not None:
+                    comp.AdaptiveCompressor.reset()
+                # the selector re-decides every interval() observations —
+                # warm up past the first boundary so timing sees the
+                # converged grid
+                warm = (max(warmup, _ad.interval() + 2)
+                        if observe is not None else warmup)
                 x = np.arange(nelem, dtype=np.float32) / nelem - 0.5
-                for _ in range(warmup):
-                    hvd.allreduce(x, name="cb", op=hvd.Sum, compression=c)
+                for _ in range(warm):
+                    out = hvd.allreduce(x, name="cb", op=hvd.Sum,
+                                        compression=c)
+                    if observe is not None:
+                        observe("cb", np.asarray(out))
                 t0 = _t.perf_counter()
                 for _ in range(iters):
-                    hvd.allreduce(x, name="cb", op=hvd.Sum, compression=c)
+                    out = hvd.allreduce(x, name="cb", op=hvd.Sum,
+                                        compression=c)
+                    if observe is not None:
+                        observe("cb", np.asarray(out))
                 dt = (_t.perf_counter() - t0) / iters
                 ex = basics._engine()._executor
                 return dt, ex.last_wire_mode, ex.last_wire_bytes
@@ -209,6 +227,7 @@ def bench_compression(sizes_mb, iters, warmup, modes):
             fp32_bytes = comp.wire_footprint(nelem, "none")
             results.append({
                 "path": "compression", "mode": mode, "size_mb": mb, "n": 4,
+                "wire_mode": outs[0][1],  # the grid that actually compiled
                 "time_us": round(dt * 1e6, 1),
                 "wire_bytes": wire_bytes,
                 "wire_ratio_vs_fp32": round(wire_bytes / fp32_bytes, 4),
@@ -299,6 +318,15 @@ def main(argv=None):
                     help="synthetic model depth for --bucket-mb")
     ap.add_argument("--np", type=int, default=8, dest="np_",
                     help="cluster size for --bucket-mb")
+    ap.add_argument("--history", default=None,
+                    help="JSONL perf-history file (benchmarks/history.py); "
+                         "with --path compression the headline "
+                         "allreduce_compressed_algbw_gbps appends to it")
+    ap.add_argument("--check-regression", action="store_true",
+                    help="exit 3 when the headline metric regresses "
+                         "against --history")
+    ap.add_argument("--regression-window", type=int, default=None)
+    ap.add_argument("--regression-tolerance", type=float, default=None)
     args = ap.parse_args(argv)
     sizes = [float(s) for s in args.sizes_mb.split(",")]
 
@@ -337,12 +365,50 @@ def main(argv=None):
             print(json.dumps({"metric": "allreduce_int8_wire_ratio",
                               "value": biggest["wire_ratio_vs_fp32"],
                               "size_mb": biggest["size_mb"]}))
+        if "int8" in by_mode and "adaptive" in by_mode:
+            # the ISSUE acceptance: the adaptive wire moves <= 60% of
+            # int8's bytes on at least one bucket-size config
+            i8 = {r["size_mb"]: r["wire_bytes"] for r in by_mode["int8"]}
+            ratios = {r["size_mb"]: r["wire_bytes"] / i8[r["size_mb"]]
+                      for r in by_mode["adaptive"] if r["size_mb"] in i8}
+            if ratios:
+                mb, ratio = min(ratios.items(), key=lambda kv: kv[1])
+                print(json.dumps({"metric": "allreduce_adaptive_vs_int8_bytes",
+                                  "value": round(ratio, 4), "size_mb": mb,
+                                  "meets_60pct_target": ratio <= 0.6}))
         best = max(results, key=lambda r: r["effective_algbw_gbps"])
-        print(json.dumps({"metric": "allreduce_compressed_algbw_gbps",
-                          "value": best["effective_algbw_gbps"],
-                          "unit": "GB/s",
-                          "config": {k: best[k]
-                                     for k in ("mode", "size_mb", "n")}}))
+        result = {"metric": "allreduce_compressed_algbw_gbps",
+                  "value": best["effective_algbw_gbps"],
+                  "unit": "GB/s",
+                  "config": {k: best[k] for k in ("mode", "size_mb", "n")}}
+        print(json.dumps(result))
+        rc = 0
+        if args.history:
+            from benchmarks.history import (append_record, check_regression,
+                                            load_history)
+
+            # compare against the trajectory BEFORE appending: today's run
+            # must not vote in its own baseline
+            if args.check_regression:
+                verdict = check_regression(
+                    load_history(args.history, metric=result["metric"]),
+                    result["value"],
+                    **{k: v for k, v in (
+                        ("window", args.regression_window),
+                        ("tolerance", args.regression_tolerance))
+                       if v is not None})
+                print("# regression check: %s" % json.dumps(verdict),
+                      file=sys.stderr)
+                if verdict["regression"]:
+                    print(f"# REGRESSION: {result['metric']} = "
+                          f"{result['value']} fell below the floor "
+                          f"{verdict['floor']} (baseline "
+                          f"{verdict['baseline']} over "
+                          f"{verdict['samples']} runs)", file=sys.stderr)
+                    rc = 3
+            append_record(args.history, result)
+        if rc:
+            sys.exit(rc)
         return results
 
     if args.path == "allgather":

@@ -54,6 +54,14 @@ python -m pytest tests/test_blackbox.py -q
 stage "overlap: bucketed backward drain, fused kernels, hvdprof overlap %"
 python -m pytest tests/test_overlap.py -q
 
+stage "compression v2: int4 wire, adaptive bitwidth selector, convergence gate"
+python -m pytest tests/test_adaptive.py -q
+python -m pytest tests/test_compression.py -q -k "Int4 or int4 or adaptive"
+# adaptive wire must hit the <=60% of int8 byte target on the microbench
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/allreduce_bench.py --compression int8,int4,adaptive \
+        --sizes-mb 0.25 --iters 3
+
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # includes tests/test_spark_real.py (real-pyspark scenarios; they skip
 # when pyspark is absent from the image)

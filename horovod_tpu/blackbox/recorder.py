@@ -40,6 +40,7 @@ K_SIGNAL = "signal"            # process signal received
 K_ANOMALY = "anomaly"          # live anomaly-watch detection
 K_FAILOVER = "failover"        # coordinator failover (standby promotion or
                                # a worker redialing the promoted standby)
+K_BITWIDTH = "bitwidth"        # adaptive-wire bitwidth decision change
 
 DEFAULT_EVENTS = 4096
 

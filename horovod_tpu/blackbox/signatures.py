@@ -36,6 +36,8 @@ STRAGGLER_SKEW_S = 1.0
 RECONNECT_STORM_COUNT = 3
 #: ok->miss heartbeat transitions that constitute a flap
 HEARTBEAT_FLAP_TRANSITIONS = 2
+#: bitwidth decision changes for ONE bucket that constitute thrash
+BITWIDTH_THRASH_FLIPS = 4
 
 
 def make_signature(sig_id: str, severity: str, summary: str,
@@ -270,6 +272,39 @@ def detect_coordinator_failover(bundle) -> List[dict]:
     return sigs
 
 
+def detect_bitwidth_thrash(bundle) -> List[dict]:
+    """An adaptive-wire bucket whose bitwidth selector keeps flipping
+    (many K_BITWIDTH decision changes for one bucket name) is thrashing:
+    its gradient statistics sit on a decision boundary, and every flip
+    recompiles the bucket's wire program. Raise HOROVOD_ADAPTIVE_TOL or
+    HOROVOD_ADAPTIVE_INTERVAL, or pin the mode with
+    HOROVOD_COMPRESSION=int8."""
+    flips: Dict[str, int] = {}
+    last: Dict[str, str] = {}
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_BITWIDTH:
+            continue
+        name = ev.get("name") or "?"
+        detail = ev.get("detail") or ""
+        # count real flips only once per rank-interleaved stream: every
+        # rank records the same decision sequence, so dedupe on transition
+        if detail == last.get(name):
+            continue
+        last[name] = detail
+        flips[name] = flips.get(name, 0) + 1
+    sigs = []
+    for name, n in sorted(flips.items()):
+        if n >= BITWIDTH_THRASH_FLIPS:
+            sigs.append(make_signature(
+                "bitwidth_thrash", SEV_WARNING,
+                "adaptive wire thrashing: bucket '%s' changed bitwidth "
+                "%d times (raise HOROVOD_ADAPTIVE_TOL / "
+                "HOROVOD_ADAPTIVE_INTERVAL or pin HOROVOD_COMPRESSION)"
+                % (name, n),
+                bucket=name, flips=n))
+    return sigs
+
+
 #: every event-based detector the doctor runs, in reporting order
 DETECTORS = (
     detect_collective_deadlock,
@@ -280,6 +315,7 @@ DETECTORS = (
     detect_straggler,
     detect_reconnect_storm,
     detect_heartbeat_flap,
+    detect_bitwidth_thrash,
 )
 
 

@@ -90,6 +90,21 @@ def quantization_ratio():
         agg="max")
 
 
+def bitwidth_decisions():
+    return get_registry().counter(
+        "hvd_bitwidth_decisions_total",
+        "Adaptive-wire bitwidth decision changes, labelled by the grid "
+        "switched to (ops/adaptive.py BitwidthSelector).",
+        labels=("wire",))
+
+
+def adaptive_bitwidth():
+    return get_registry().gauge(
+        "hvd_adaptive_bitwidth",
+        "Most recently selected adaptive-wire grid, in bits "
+        "(4 = int4, 8 = int8, 16 = bf16 fallback).")
+
+
 def error_feedback_roundtrips():
     return get_registry().counter(
         "hvd_error_feedback_roundtrips_total",

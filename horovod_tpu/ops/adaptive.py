@@ -1,0 +1,385 @@
+"""Per-bucket bitwidth selection for the mixed-precision wire (adaptive v2).
+
+EQuARX (PAPERS.md arXiv:2506.17615) observes that the right wire bitwidth
+is a per-tensor property of the gradient distribution: well-conditioned
+buckets survive 4-bit block quantization, heavy-tailed ones need 8 bits or
+a bf16 fallback. This module owns everything that makes that choice:
+
+* :class:`BucketStats` / :class:`BitwidthSelector` — running statistics
+  (absmax/variance EMAs and the measured relative quantization-residual
+  norm at each candidate grid) per bucket name, re-deciding the wire mode
+  every ``HOROVOD_ADAPTIVE_INTERVAL`` observations with hysteresis. The
+  statistics are computed from the *reduced* bucket (identical bytes on
+  every rank) with a deterministic sample, so every rank's selector makes
+  the same decision sequence — cross-rank agreement by construction, and
+  the coordinator's negotiation (Response.compression) still arbitrates
+  any transition race.
+* :class:`ConvergenceGate` — the A/B convergence harness (chaos-style,
+  like the PR 4/5 convergence tests): trains the same deterministic proxy
+  problem twice, once with exact gradient updates and once with
+  bitwidth-quantized + error-feedback updates, and admits a grid only at
+  measured loss parity. Pure numpy, fixed seed → identical verdict on
+  every rank, cached after the first call.
+* :class:`BitwidthTuner` — the rank-0 autotune extension: explores
+  gate-admitted bitwidth *caps* in episodes, scoring each by the wire-true
+  bytes the coordinator already aggregates, and settles on the cheapest.
+  The chosen cap broadcasts to every rank as the third ``tuned`` field
+  (runtime/wire.py) and lands here via :func:`set_autotuned_cap`.
+
+Knobs (all read per call, unset keeps the wire exactly as before):
+``HOROVOD_ADAPTIVE_TOL`` (relative residual tolerance, default 0.2),
+``HOROVOD_ADAPTIVE_INTERVAL`` (observations between decisions, default 10),
+``HOROVOD_ADAPTIVE_GATE`` (0 disables the convergence gate, default on).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+#: candidate wire modes, cheapest (most aggressive) first
+MODES = ("int4", "int8", "bf16")
+BITS = {"int4": 4, "int8": 8, "bf16": 16}
+
+#: elements of the reduced bucket sampled per observation (deterministic
+#: prefix — identical on every rank, cheap on the host)
+SAMPLE = 4096
+
+_QMAX = {4: 7.0, 8: 127.0}
+
+
+def tolerance() -> float:
+    """Relative quantization-residual tolerance (HOROVOD_ADAPTIVE_TOL).
+
+    Default 0.2: a Gaussian block at int4 measures ~0.14 relative RMS
+    residual (absmax≈3.5σ, 15 levels), so well-behaved buckets go 4-bit;
+    heavy-tailed blocks (absmax ≫ rms) exceed it and stay at int8/bf16."""
+    v = float(os.environ.get("HOROVOD_ADAPTIVE_TOL", 0.2))
+    if v <= 0:
+        raise ValueError(f"HOROVOD_ADAPTIVE_TOL={v}: must be positive")
+    return v
+
+
+def interval() -> int:
+    """Observations between bitwidth decisions (HOROVOD_ADAPTIVE_INTERVAL)."""
+    v = int(os.environ.get("HOROVOD_ADAPTIVE_INTERVAL", 10))
+    if v <= 0:
+        raise ValueError(f"HOROVOD_ADAPTIVE_INTERVAL={v}: must be positive")
+    return v
+
+
+def gate_enabled() -> bool:
+    return os.environ.get("HOROVOD_ADAPTIVE_GATE", "1").strip() not in (
+        "0", "false", "False", "off")
+
+
+# ------------------------------------------------------------- autotuned cap
+# The coordinator's BitwidthTuner broadcasts a floor on the wire grid (a
+# cap on aggressiveness): decisions may not go below cap bits. "int4" (the
+# default) is no restriction; "bf16" forbids integer grids entirely.
+_cap_lock = threading.Lock()
+_autotuned_cap = "int4"
+
+
+def set_autotuned_cap(cap: str) -> None:
+    global _autotuned_cap
+    if cap not in MODES:
+        return  # a newer coordinator speaking an unknown mode: ignore
+    with _cap_lock:
+        _autotuned_cap = cap
+
+
+def autotuned_cap() -> str:
+    with _cap_lock:
+        return _autotuned_cap
+
+
+def reset() -> None:
+    """Test hook: forget the broadcast cap and the cached gate verdicts."""
+    global _autotuned_cap
+    with _cap_lock:
+        _autotuned_cap = "int4"
+    ConvergenceGate.shared().forget()
+
+
+# ---------------------------------------------------------------- numerics
+def _block_roundtrip(x: np.ndarray, bits: int, block: int = 256) -> np.ndarray:
+    """Numpy mirror of ``compression.quantize_roundtrip`` (same formula:
+    symmetric per-block grid, scale = absmax/qmax). Kept in numpy so the
+    selector and the gate never touch jax from control-plane threads."""
+    qmax = _QMAX[bits]
+    n = x.shape[0]
+    pad = (-n) % block
+    if pad:
+        x = np.pad(x, (0, pad))
+    x2 = x.reshape(-1, block).astype(np.float32)
+    absmax = np.max(np.abs(x2), axis=1, keepdims=True)
+    scale = absmax * (1.0 / qmax)
+    safe = np.where(scale > 0.0, scale, 1.0)
+    q = np.clip(np.round(x2 / safe), -qmax, qmax)
+    y = (q * scale).reshape(-1)
+    return y[:n] if pad else y
+
+
+def _bf16_roundtrip(x: np.ndarray) -> np.ndarray:
+    """bf16 cast loss: truncate the mantissa to 8 bits (round-to-nearest
+    via the +0x8000 carry), bit-exact with an ml_dtypes cast."""
+    u = x.astype(np.float32).view(np.uint32)
+    u = (u + 0x8000 + ((u >> 16) & 1)) & 0xFFFF0000
+    return u.astype(np.uint32).view(np.float32)
+
+
+def relative_residual(x: np.ndarray, mode: str) -> float:
+    """‖x − wire(x)‖ / ‖x‖ for one candidate grid — the EF-residual-norm
+    statistic the selector tracks (what error feedback would have to carry
+    if this bucket rode that wire)."""
+    xf = np.asarray(x, dtype=np.float32).reshape(-1)
+    norm = float(np.linalg.norm(xf))
+    if norm == 0.0:
+        return 0.0
+    if mode == "bf16":
+        y = _bf16_roundtrip(xf)
+    else:
+        y = _block_roundtrip(xf, BITS[mode])
+    return float(np.linalg.norm(xf - y)) / norm
+
+
+# ------------------------------------------------------------ bucket stats
+class BucketStats:
+    """Running statistics for one bucket name (EMAs, decay 0.8)."""
+
+    __slots__ = ("count", "absmax", "var", "err", "mode")
+
+    def __init__(self):
+        self.count = 0
+        self.absmax = 0.0
+        self.var = 0.0
+        self.err: Dict[str, float] = {}
+        self.mode = "int8"  # startup default (matches the static wire)
+
+    def update(self, sample: np.ndarray) -> None:
+        a = float(np.max(np.abs(sample))) if sample.size else 0.0
+        v = float(np.var(sample)) if sample.size else 0.0
+        d = 0.8
+        self.absmax = a if self.count == 0 else d * self.absmax + (1 - d) * a
+        self.var = v if self.count == 0 else d * self.var + (1 - d) * v
+        for m in MODES:
+            e = relative_residual(sample, m)
+            prev = self.err.get(m)
+            self.err[m] = e if prev is None else d * prev + (1 - d) * e
+        self.count += 1
+
+
+class BitwidthSelector:
+    """Per-bucket int4/int8/bf16 choice from running gradient statistics.
+
+    ``observe(name, flat)`` feeds the reduced bucket after each drain;
+    ``decide(name)`` returns the wire mode the next enqueue should request.
+    Decisions refresh every :func:`interval` observations; between
+    refreshes the previous choice holds, so every rank requests the same
+    mode for the same (name, step). Hysteresis: switching to a *different*
+    mode than the current one requires its residual under 0.8×tol, while
+    the incumbent only needs tol — no flapping at the boundary.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, BucketStats] = {}
+        self._gate = ConvergenceGate.shared()
+
+    def observe(self, name: str, flat) -> None:
+        x = np.asarray(flat).reshape(-1)[:SAMPLE]
+        if not np.issubdtype(x.dtype, np.floating):
+            return
+        with self._lock:
+            st = self._stats.setdefault(name, BucketStats())
+            st.update(x.astype(np.float32))
+            if st.count % interval() == 0:
+                self._redecide(name, st)
+
+    def decide(self, name: str) -> str:
+        with self._lock:
+            st = self._stats.get(name)
+            return st.mode if st is not None else "int8"
+
+    def min_active_bits(self) -> int:
+        """Most aggressive grid currently chosen across buckets (8 before
+        any decision) — what the EF roundtrip measures against."""
+        with self._lock:
+            if not self._stats:
+                return 8
+            return min(BITS[st.mode] for st in self._stats.values())
+
+    def decisions(self) -> Dict[str, str]:
+        with self._lock:
+            return {n: st.mode for n, st in self._stats.items()}
+
+    def _redecide(self, name: str, st: BucketStats) -> None:
+        tol = tolerance()
+        cap_bits = BITS[autotuned_cap()]
+        pick = "bf16"
+        for m in MODES:  # cheapest first
+            if BITS[m] < cap_bits:
+                continue
+            if m == "int4" and not self._gate.allows("int4"):
+                continue
+            margin = tol if m == st.mode else 0.8 * tol
+            if m == "bf16" or st.err.get(m, np.inf) <= margin:
+                pick = m
+                break
+        if pick != st.mode:
+            old, st.mode = st.mode, pick
+            self._record(name, old, pick)
+
+    @staticmethod
+    def _record(name: str, old: str, new: str) -> None:
+        from .. import blackbox as _blackbox
+        from ..metrics import instruments
+
+        _blackbox.record(_blackbox.K_BITWIDTH, name, f"{old}->{new}")
+        instruments.bitwidth_decisions().labels(wire=new).inc()
+        instruments.adaptive_bitwidth().set(BITS[new])
+
+
+# -------------------------------------------------------- convergence gate
+class ConvergenceGate:
+    """A/B convergence harness gating aggressive bitwidths.
+
+    Trains one deterministic proxy problem (least-squares regression on
+    fixed-seed Gaussian data, plain gradient descent) twice: with exact
+    gradients, and with gradients pushed through the candidate wire grid
+    plus EF-SGD error feedback — the same update rule
+    ``DistributedOptimizer(error_feedback=True)`` applies to the real
+    model. A grid is admitted only if its final loss is within
+    ``rel_tol`` of the exact run's. Seeded numpy end to end, so the
+    verdict is bit-identical on every rank and cacheable.
+    """
+
+    _shared: Optional["ConvergenceGate"] = None
+
+    @classmethod
+    def shared(cls) -> "ConvergenceGate":
+        if cls._shared is None:
+            cls._shared = ConvergenceGate()
+        return cls._shared
+
+    def __init__(self, steps: int = 150, dim: int = 256, lr: float = 0.05,
+                 rel_tol: float = 0.05, seed: int = 1234):
+        self.steps = steps
+        self.dim = dim
+        self.lr = lr
+        self.rel_tol = rel_tol
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._verdicts: Dict[str, bool] = {}
+        self._losses: Dict[str, Tuple[float, float]] = {}
+
+    def forget(self) -> None:
+        with self._lock:
+            self._verdicts.clear()
+            self._losses.clear()
+
+    def allows(self, mode: str) -> bool:
+        if mode != "int4":
+            return True  # int8/bf16 shipped with their own convergence tests
+        if not gate_enabled():
+            return True
+        with self._lock:
+            v = self._verdicts.get(mode)
+            if v is None:
+                exact, quant = self._ab_losses(BITS[mode])
+                v = quant <= exact * (1.0 + self.rel_tol)
+                self._verdicts[mode] = v
+                self._losses[mode] = (exact, quant)
+            return v
+
+    def losses(self, mode: str) -> Tuple[float, float]:
+        """(exact_loss, quantized_loss) of the A/B pair; runs it if needed."""
+        with self._lock:
+            if mode not in self._losses:
+                self._losses[mode] = self._ab_losses(BITS[mode])
+            return self._losses[mode]
+
+    def _ab_losses(self, bits: int) -> Tuple[float, float]:
+        return (self._train(None), self._train(bits))
+
+    def _train(self, bits: Optional[int]) -> float:
+        rng = np.random.RandomState(self.seed)
+        n, d = 4 * self.dim, self.dim
+        x = rng.randn(n, d).astype(np.float32)
+        w_true = rng.randn(d).astype(np.float32)
+        y = x @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+        w = np.zeros(d, dtype=np.float32)
+        residual = np.zeros(d, dtype=np.float32)
+        for _ in range(self.steps):
+            g = (2.0 / n) * (x.T @ (x @ w - y))
+            if bits is not None:
+                corrected = g + residual
+                g_wire = _block_roundtrip(corrected, bits)
+                residual = corrected - g_wire
+                g = g_wire
+            w -= self.lr * g
+        return float(np.mean((x @ w - y) ** 2))
+
+
+# ---------------------------------------------------------- autotune caps
+class BitwidthTuner:
+    """Rank-0 bitwidth-cap search riding the coordinator's autotune scores.
+
+    The GP/EI native tuner keeps owning fusion threshold and cycle time;
+    bitwidth is a small discrete axis, so this explores it directly:
+    each gate-admitted cap (least → most aggressive) runs for
+    ``episode_rounds`` scored negotiation rounds, accumulating the
+    wire-true bytes the coordinator already aggregates; after the sweep
+    the cap with the fewest mean bytes/round wins (ties go to the more
+    aggressive cap) and the tuner settles. The current cap is broadcast
+    every round as the third ``tuned`` field.
+    """
+
+    def __init__(self, episode_rounds: int = 8):
+        self.episode_rounds = episode_rounds
+        gate = ConvergenceGate.shared()
+        # least aggressive first: exploration starts byte-identical to the
+        # pre-autotune wire and only then tries cheaper grids
+        self._candidates = [m for m in reversed(MODES)
+                            if m != "int4" or gate.allows("int4")]
+        self._idx = 0
+        self._rounds = 0
+        self._bytes: Dict[str, list] = {m: [] for m in self._candidates}
+        self._settled: Optional[str] = None
+
+    def active(self) -> bool:
+        return self._settled is None
+
+    def cap(self) -> str:
+        if self._settled is not None:
+            return self._settled
+        return self._candidates[self._idx]
+
+    def observe(self, round_bytes: int, round_seconds: float) -> None:
+        """One scored negotiation round under the current cap."""
+        if self._settled is not None or round_bytes <= 0:
+            return
+        cur = self._candidates[self._idx]
+        self._bytes[cur].append(float(round_bytes))
+        self._rounds += 1
+        if self._rounds >= self.episode_rounds:
+            self._rounds = 0
+            self._idx += 1
+            if self._idx >= len(self._candidates):
+                self._settle()
+
+    def _settle(self) -> None:
+        best, best_mean = None, None
+        # reversed: on a tie the later (more aggressive) candidate sticks
+        for m in self._candidates:
+            vals = self._bytes[m]
+            if not vals:
+                continue
+            mean = sum(vals) / len(vals)
+            if best_mean is None or mean < best_mean:
+                best, best_mean = m, mean
+        self._settled = best or self._candidates[-1]

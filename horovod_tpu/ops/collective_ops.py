@@ -116,10 +116,14 @@ def allreduce_async(tensor, name: Optional[str] = None, op: int = Average,
                 "prescale_factor/postscale_factor are not supported with "
                 "op=Adasum (the combine rule is scale-invariant).")
         return _enqueue(RequestType.ADASUM, tensor, name, callback=callback)
+    # adaptive wire: the enqueued string carries this bucket's current
+    # bitwidth decision ("adaptive:<mode>") so negotiation can arbitrate it
+    wire_for = getattr(compression, "wire_for", None)
+    wire = wire_for(name) if wire_for is not None else compression.wire or ""
     return _enqueue(RequestType.ALLREDUCE, tensor, name,
                     average=(op == Average),
                     prescale=prescale_factor, postscale=postscale_factor,
-                    callback=callback, wire=compression.wire or "",
+                    callback=callback, wire=wire,
                     fusable=fusable)
 
 

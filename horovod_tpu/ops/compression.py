@@ -21,9 +21,15 @@ collective program (`runtime/executor.py`). The numerics live here
 with a Pallas kernel fast path) so tests, error feedback and the executor
 share one definition.
 
-Job-wide default: ``HOROVOD_COMPRESSION={none,fp16,bf16,int8,int8-dcn}``
-(resolved by :func:`from_env`); ``HOROVOD_INT8_BLOCK`` overrides the block
-size.
+Adaptive v2 (this module + `ops/adaptive.py`): ``int4`` halves the packed
+wire again (two values per byte, scale = absmax/7), and ``adaptive`` lets a
+per-bucket selector pick int4/int8/bf16 from running statistics of the
+reduced gradients — the enqueued wire string is ``adaptive:<mode>`` so the
+coordinator negotiates the concrete bitwidth before the collective fires.
+
+Job-wide default: ``HOROVOD_COMPRESSION={none,fp16,bf16,int8,int8-dcn,
+int4,adaptive}`` (resolved by :func:`from_env`); ``HOROVOD_INT8_BLOCK``
+overrides the block size for every block-quantized mode.
 """
 
 from __future__ import annotations
@@ -48,7 +54,7 @@ def _kernels():
     return pallas_kernels
 
 
-def quantize_blocks(x, block: int | None = None):
+def quantize_blocks(x, block: int | None = None, bits: int = 8):
     """Block-quantize a float array to (int8 payload, fp32 scales).
 
     ``x`` is flattened; its length must be a multiple of ``block`` (callers
@@ -56,7 +62,15 @@ def quantize_blocks(x, block: int | None = None):
     Returns ``(q, scales)`` with ``q`` int8 of ``x.size`` elements and
     ``scales`` fp32 of ``x.size // block`` elements, where block ``i`` of
     ``x`` is approximately ``q[i*block:(i+1)*block] * scales[i]``.
+
+    ``bits`` picks the quantization grid: 8 (scale = absmax/127, the
+    default) or 4 (scale = absmax/7). The 4-bit grid is returned unpacked
+    (one int8 per value) — nibble packing is a wire-layout concern and
+    lives in ``pallas_kernels.int4_quantize_pack``; this function is the
+    numerics shared by error feedback and the tests.
     """
+    if bits not in (4, 8):
+        raise ValueError(f"quantize_blocks: bits must be 4 or 8, got {bits}")
     block = block or block_size()
     flat = jnp.ravel(x).astype(jnp.float32)
     if flat.shape[0] % block:
@@ -65,13 +79,15 @@ def quantize_blocks(x, block: int | None = None):
             f"block {block}")
     x2 = flat.reshape(-1, block)
     pk = _kernels()
-    if pk.int8_supported(x2.shape[0], block) and not pk.vma_active(x2):
+    if (bits == 8 and pk.int8_supported(x2.shape[0], block)
+            and not pk.vma_active(x2)):
         q2, s2 = pk.int8_quantize_2d(x2)
         return q2.reshape(-1), s2[:, 0]
+    qmax = 127.0 if bits == 8 else 7.0
     absmax = jnp.max(jnp.abs(x2), axis=1, keepdims=True)
-    scale = absmax * (1.0 / 127.0)
+    scale = absmax * (1.0 / qmax)
     safe = jnp.where(scale > 0.0, scale, 1.0)
-    q2 = jnp.clip(jnp.round(x2 / safe), -127.0, 127.0).astype(jnp.int8)
+    q2 = jnp.clip(jnp.round(x2 / safe), -qmax, qmax).astype(jnp.int8)
     return q2.reshape(-1), scale[:, 0]
 
 
@@ -88,12 +104,12 @@ def dequantize_blocks(q, scales, dtype=jnp.float32, block: int | None = None):
     return y2.reshape(-1).astype(dtype)
 
 
-def quantize_roundtrip(x, block: int | None = None):
+def quantize_roundtrip(x, block: int | None = None, bits: int = 8):
     """Quantize→dequantize ``x`` (any shape/float dtype), padding internally.
 
     This is the exact value the quantized wire delivers for a single-rank
     hop; error feedback (`optim/distributed.py`) uses it to compute the
-    residual the wire dropped.
+    residual the wire dropped. ``bits=4`` measures the int4 grid.
     """
     block = block or block_size()
     # metric lives here (the eager entry point), not in the jit-traced
@@ -106,7 +122,7 @@ def quantize_roundtrip(x, block: int | None = None):
     pad = (-n) % block
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    q, s = quantize_blocks(flat, block)
+    q, s = quantize_blocks(flat, block, bits=bits)
     y = dequantize_blocks(q, s, dtype=x.dtype, block=block)
     return y[:n].reshape(x.shape)
 
@@ -124,6 +140,19 @@ def wire_footprint(num_elements: int, mode: str,
         block = block or block_size()
         blocks = -(-num_elements // block)
         return 2 * (num_elements + 4 * blocks)
+    if mode == "int4":
+        # packed nibbles: half a byte per element plus the same one-f32-
+        # per-block scale overhead as int8 (wire rows are
+        # [block//2 payload bytes | 4 scale bytes])
+        block = block or block_size()
+        blocks = -(-num_elements // block)
+        return 2 * (-(-num_elements // 2) + 4 * blocks)
+    if mode == "adaptive" or mode.startswith("adaptive:"):
+        # mixed wire: the footprint is whatever concrete mode the selector
+        # negotiated for this bucket ("adaptive:<mode>"); bare "adaptive"
+        # counts the int8 startup default
+        concrete = mode.split(":", 1)[1] if ":" in mode else "int8"
+        return wire_footprint(num_elements, concrete, block)
     raise ValueError(f"unknown compression mode {mode!r}")
 
 
@@ -197,11 +226,14 @@ class _WireCompressor(NoneCompressor):
     agree on the program).
     """
 
+    #: quantization grid the wire applies (4 or 8)
+    bits = 8
+
     @classmethod
     def roundtrip(cls, tensor):
         if not jnp.issubdtype(jnp.asarray(tensor).dtype, jnp.floating):
             return tensor
-        return quantize_roundtrip(tensor)
+        return quantize_roundtrip(tensor, bits=cls.bits)
 
 
 class Int8Compressor(_WireCompressor):
@@ -215,6 +247,73 @@ class Int8DcnCompressor(_WireCompressor):
     wire = "int8-dcn"
 
 
+class Int4Compressor(_WireCompressor):
+    """int4 packed wire: two values per byte, scale = absmax/7 per block.
+    Roughly half of int8's bytes; pair with ``error_feedback=True`` — the
+    4-bit grid drops enough signal that EF is what keeps convergence at
+    parity (the convergence gate in ops/adaptive.py measures exactly
+    this)."""
+
+    wire = "int4"
+    bits = 4
+
+
+class AdaptiveCompressor(_WireCompressor):
+    """Mixed-bitwidth wire (``HOROVOD_COMPRESSION=adaptive``).
+
+    A per-bucket selector (`ops/adaptive.py`) keeps running statistics of
+    the *reduced* gradients — absmax/variance EMAs plus the measured
+    quantization-residual norm at each candidate grid — and picks the
+    cheapest of int4/int8/bf16 whose error stays under tolerance,
+    re-deciding every ``HOROVOD_ADAPTIVE_INTERVAL`` observations. The
+    statistics come from the allreduced output, which is identical on
+    every rank, so decisions are deterministic and cross-rank consistent;
+    the enqueued wire string ``adaptive:<mode>`` is still negotiated
+    through the coordinator (Response.compression wins), which resolves
+    any transition race to the least aggressive proposal.
+
+    Selector state is class-level (one per process): ranks sharing a
+    process observe identical reduced buckets, so sharing is harmless, and
+    ``reset()`` gives tests a clean slate.
+    """
+
+    wire = "adaptive:int8"  # startup default, before any statistics exist
+    _selector = None
+
+    @classmethod
+    def selector(cls):
+        if cls._selector is None:
+            from . import adaptive as _adaptive
+
+            cls._selector = _adaptive.BitwidthSelector()
+        return cls._selector
+
+    @classmethod
+    def reset(cls):
+        cls._selector = None
+
+    @classmethod
+    def wire_for(cls, name: str) -> str:
+        return "adaptive:" + cls.selector().decide(name)
+
+    @classmethod
+    def observe(cls, name: str, flat) -> None:
+        cls.selector().observe(name, flat)
+
+    @classmethod
+    def roundtrip(cls, tensor):
+        # EF residual against the most aggressive grid currently active:
+        # one residual tree serves every bucket, so this measures the
+        # worst-case wire loss (buckets on a finer grid over-correct
+        # slightly, which EF tolerates — the residual shrinks next step)
+        if not jnp.issubdtype(jnp.asarray(tensor).dtype, jnp.floating):
+            return tensor
+        bits = cls.selector().min_active_bits()
+        if bits >= 16:
+            return tensor.astype(jnp.bfloat16).astype(tensor.dtype)
+        return quantize_roundtrip(tensor, bits=bits)
+
+
 class Compression:
     """Parity with the reference's Compression namespace."""
 
@@ -223,6 +322,8 @@ class Compression:
     bf16 = BF16Compressor  # TPU-native extension
     int8 = Int8Compressor  # block-quantized wire (executor-fused)
     int8_dcn = Int8DcnCompressor
+    int4 = Int4Compressor  # packed-nibble wire (executor-fused)
+    adaptive = AdaptiveCompressor  # per-bucket mixed bitwidth
 
 
 _BY_NAME = {
@@ -233,11 +334,14 @@ _BY_NAME = {
     "int8": Int8Compressor,
     "int8-dcn": Int8DcnCompressor,
     "int8_dcn": Int8DcnCompressor,
+    "int4": Int4Compressor,
+    "adaptive": AdaptiveCompressor,
 }
 
 # wire-name → compressor, for reconstructing the negotiated mode from
 # control-plane metadata on ranks that had no local entry.
-BY_WIRE = {"int8": Int8Compressor, "int8-dcn": Int8DcnCompressor}
+BY_WIRE = {"int8": Int8Compressor, "int8-dcn": Int8DcnCompressor,
+           "int4": Int4Compressor}
 
 
 def by_name(name: str):
@@ -247,7 +351,7 @@ def by_name(name: str):
     except KeyError:
         raise ValueError(
             f"unknown compression {name!r}; expected one of "
-            "none/fp16/bf16/int8/int8-dcn") from None
+            "none/fp16/bf16/int8/int8-dcn/int4/adaptive") from None
 
 
 def from_env(default=NoneCompressor):

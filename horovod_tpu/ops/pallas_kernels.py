@@ -1684,6 +1684,101 @@ def int8_unpack(p2):
     return q, scales.reshape(rows, 1)
 
 
+# ====================================================== int4 packed wire
+# int4 halves the packed payload again: two quantized values per byte with
+# a per-block f32 scale (absmax/7, clip ±7 — the EQuARX aggressive tier).
+# Nibble layout is HALF-SPLIT: byte j of a row holds element j in the low
+# nibble and element j + block//2 in the high nibble, so pack and unpack
+# operate on contiguous half-row slices (lane-friendly) instead of a
+# strided even/odd interleave. int4 always rides packed rows —
+# ``[block//2 payload bytes | 4 raw f32 scale bytes]`` — one all_to_all +
+# one all_gather, the same wire shape as HOROVOD_PACKED_WIRE's int8 rows.
+
+INT4_QMAX = 7.0
+
+
+def int4_supported(rows: int, block: int) -> bool:
+    """Kernel path: the packed payload (block//2 bytes) must stay
+    lane-aligned, so the block needs 256-divisibility; row counts tile
+    like int8. Everything else takes the bit-identical jnp fallback."""
+    return (mode() != "off" and block % 256 == 0
+            and _pick_block(rows, 256) is not None)
+
+
+def _int4_pack_rows(x):
+    """The shared quantize+pack formula (kernel body and jnp reference both
+    call this exact chain, so the two paths are bit-identical)."""
+    xf = x.astype(jnp.float32)
+    half = xf.shape[1] // 2
+    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    scale = absmax * (1.0 / INT4_QMAX)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe), -INT4_QMAX, INT4_QMAX).astype(jnp.int8)
+    b = jnp.bitwise_or(jnp.bitwise_and(q[:, :half], jnp.int8(15)),
+                       jnp.left_shift(q[:, half:], 4)).astype(jnp.int8)
+    sbytes = lax.bitcast_convert_type(scale, jnp.int8).reshape(
+        xf.shape[0], PACK_SCALE_BYTES)
+    return jnp.concatenate([b, sbytes], axis=1)
+
+
+def _int4_quant_pack_kernel(x_ref, p_ref):
+    p_ref[...] = _int4_pack_rows(x_ref[...])
+
+
+def int4_quantize_pack_2d(x2):
+    """[rows, block] float → [rows, block//2 + 4] int8 packed rows."""
+    rows, block = x2.shape
+    br = _pick_block(rows, 256)
+    row = pl.BlockSpec((br, block), lambda i: (i, 0))
+    prow = pl.BlockSpec((br, block // 2 + PACK_SCALE_BYTES),
+                        lambda i: (i, 0))
+    return pl.pallas_call(
+        _int4_quant_pack_kernel,
+        grid=(rows // br,),
+        in_specs=[row],
+        out_specs=prow,
+        out_shape=_struct((rows, block // 2 + PACK_SCALE_BYTES), jnp.int8,
+                          x2),
+        compiler_params=_cparams("parallel"),
+        interpret=_interpret(),
+    )(x2)
+
+
+def int4_quantize_pack_ref(x2):
+    """jnp fallback — the exact kernel formula, bit-identical packed rows."""
+    return _int4_pack_rows(x2)
+
+
+def int4_quantize_pack(x2):
+    """Kernel when the shape tiles and no vma constraint applies; jnp
+    fallback otherwise. Same bits either way. ``block`` must be even
+    (two values per byte)."""
+    rows, block = x2.shape
+    if block % 2:
+        raise ValueError(
+            f"int4 packing needs an even block; got {block} "
+            "(HOROVOD_INT8_BLOCK)")
+    if int4_supported(rows, block) and not vma_active(x2):
+        return int4_quantize_pack_2d(x2)
+    return int4_quantize_pack_ref(x2)
+
+
+def int4_unpack(p2):
+    """[rows, block//2 + 4] packed int4 → ([rows, block] int8, [rows, 1]
+    f32). Sign extension is two arithmetic shifts per nibble (int8 shifts
+    are arithmetic); pure layout surgery otherwise, fused by XLA into the
+    consumer like ``int8_unpack``."""
+    rows = p2.shape[0]
+    half = p2.shape[1] - PACK_SCALE_BYTES
+    b = p2[:, :half]
+    lo = jnp.right_shift(jnp.left_shift(b, 4), 4)
+    hi = jnp.right_shift(b, 4)
+    q = jnp.concatenate([lo, hi], axis=1)
+    scales = lax.bitcast_convert_type(
+        p2[:, half:].reshape(rows, 1, PACK_SCALE_BYTES), jnp.float32)
+    return q, scales.reshape(rows, 1)
+
+
 # ============================================= fused matmul + reduce-scatter
 # The tail-linear / LM-head pattern: x [R, Kl] and w [Kl, N] are the local
 # shards of a contraction-sharded matmul, so the full product is

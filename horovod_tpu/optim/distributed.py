@@ -116,9 +116,10 @@ def _allreduce_gradients_bucketed(grads, op, compression, prefix,
         members = [dense[j] for j in idxs]
         flat = (jnp.ravel(members[0][1]) if len(members) == 1
                 else jnp.concatenate([jnp.ravel(c) for _, c, _ in members]))
-        h = ops.allreduce_async(flat, name=f"{prefix}.bucket.{i}", op=op,
+        bname = f"{prefix}.bucket.{i}"
+        h = ops.allreduce_async(flat, name=bname, op=op,
                                 compression=compression, fusable=False)
-        started.append(("bucket", h, members))
+        started.append(("bucket", h, (bname, members)))
     for pos, name, leaf in sparse_items:
         started.append(
             ("sparse", _sparse.allreduce_sparse_async(leaf, name),
@@ -127,6 +128,7 @@ def _allreduce_gradients_bucketed(grads, op, compression, prefix,
         tr.end_block(launch_span, _tracing.clock.trace_us())
         drain_span = tr.begin_block(_tracing.K_PHASE, basics.rank(),
                                     "GRAD_DRAIN", _tracing.clock.trace_us())
+    observe = getattr(compression, "observe", None)
     outs: list = [None] * len(pairs)
     try:
         for kind, h, meta in started:
@@ -135,9 +137,14 @@ def _allreduce_gradients_bucketed(grads, op, compression, prefix,
                 outs[pos] = _sparse.synchronize_sparse(
                     h, op=op, dense_shape=leaf.dense_shape)
                 continue
+            bname, members = meta
             flat = ops.synchronize(h)
+            if observe is not None:
+                # adaptive wire: feed the reduced bucket (identical on
+                # every rank) to the bitwidth selector's statistics
+                observe(bname, flat)
             off = 0
-            for pos, comp, ctx in meta:
+            for pos, comp, ctx in members:
                 n = int(comp.size)
                 outs[pos] = compression.decompress(
                     flat[off:off + n].reshape(comp.shape), ctx)
@@ -216,7 +223,7 @@ def allreduce_gradients(grads, op: int = Average,
         started.append(("dense",
                         ops.allreduce_async(comp, name=name, op=op,
                                             compression=compression),
-                        ctx))
+                        (name, ctx)))
     if tr is not None:
         # launch vs drain phases make backward/wire overlap visible in the
         # merged trace: wire spans overlapping GRAD_LAUNCH are hidden comm,
@@ -224,6 +231,7 @@ def allreduce_gradients(grads, op: int = Average,
         tr.end_block(launch_span, _tracing.clock.trace_us())
         drain_span = tr.begin_block(_tracing.K_PHASE, basics.rank(),
                                     "GRAD_DRAIN", _tracing.clock.trace_us())
+    observe = getattr(compression, "observe", None)
     outs = []
     try:
         for kind, h, meta in started:
@@ -231,7 +239,11 @@ def allreduce_gradients(grads, op: int = Average,
                 outs.append(_sparse.synchronize_sparse(
                     h, op=op, dense_shape=meta.dense_shape))
             else:
-                outs.append(compression.decompress(ops.synchronize(h), meta))
+                name, ctx = meta
+                flat = ops.synchronize(h)
+                if observe is not None:
+                    observe(name, flat)
+                outs.append(compression.decompress(flat, ctx))
     finally:
         if tr is not None:
             tr.end_block(drain_span, _tracing.clock.trace_us())

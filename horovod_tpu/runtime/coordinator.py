@@ -164,9 +164,13 @@ class CoordState:
         # the coordinated analogue of the reference controller broadcasting
         # parameter-manager updates to all workers
         self.tuner = tuner
+        # bitwidth-cap axis of the autotune search (ops/adaptive.py
+        # BitwidthTuner); created lazily on the first adaptive-wire request
+        # so non-adaptive jobs keep the exact two-field tuned broadcast
+        self.bw_tuner = None
         self.round_bytes = 0
         self.round_seconds = 0.0
-        self.tuned: Optional[Tuple[int, float]] = None
+        self.tuned: Optional[Tuple] = None
         self.stall_warning_s = stall_warning_s
         self.stall_shutdown_s = stall_shutdown_s
         # enforced watchdog (docs/fault-tolerance.md): 0 keeps the
@@ -867,26 +871,36 @@ class CoordState:
         metas = self.cache_meta.get(cid)
         return None if metas is None else metas.get(rank)
 
-    def _tune(self) -> Optional[Tuple[int, float]]:
+    def _tune(self) -> Optional[Tuple]:
         """Feed the round's aggregated score to the GP/EI and return the
-        (threshold, cycle_ms) to broadcast; must run under self.cv."""
+        tuned fields to broadcast — (threshold, cycle_ms) always, plus the
+        bitwidth cap when the adaptive wire is in play; must run under
+        self.cv."""
         if self.tuner is None:
             return None
-        if self.round_bytes > 0 and self.round_seconds > 0:
-            changed = self.tuner.update(self.round_bytes, self.round_seconds)
+        rb, rs = self.round_bytes, self.round_seconds
+        if rb > 0 and rs > 0:
+            changed = self.tuner.update(rb, rs)
             if changed:
                 self.threshold = int(self.tuner.fusion_threshold())
+            if self.bw_tuner is not None:
+                # the same wire-true score drives the bitwidth-cap search:
+                # round_bytes already reflects whatever grids the current
+                # cap allowed, so each episode scores its cap directly
+                self.bw_tuner.observe(rb, rs)
             if changed or self.tuner.active():
                 # stop logging once the GP settles (bounded file growth;
                 # the settling update itself is the last line)
                 from ..utils.autotune_log import log_sample
 
                 log_sample(os.environ.get("HOROVOD_AUTOTUNE_LOG"),
-                           self.round_bytes, self.round_seconds,
+                           rb, rs,
                            self.threshold, float(self.tuner.cycle_time_ms()))
             self.round_bytes = 0
             self.round_seconds = 0.0
         self.tuned = (self.threshold, float(self.tuner.cycle_time_ms()))
+        if self.bw_tuner is not None:
+            self.tuned = self.tuned + (self.bw_tuner.cap(),)
         return self.tuned
 
     def _negotiate(self, per_rank) -> bytes:
@@ -1081,7 +1095,8 @@ class CoordState:
             resp.postscale = m0.postscale
             resp.root_rank = m0.root_rank
             resp.tensor_dtype = m0.dtype
-            resp.compression = m0.compression
+            resp.compression = self._resolve_compression(
+                [m for k in bucket for m in singles[k][1].metas.values()])
             cids: List[int] = []
             for k in bucket:
                 kname, pk = singles[k]
@@ -1115,6 +1130,11 @@ class CoordState:
                                          invalid_ids=sorted(invalid))
 
     def _add(self, rank: int, m: ReqMeta) -> None:
+        if (self.tuner is not None and self.bw_tuner is None
+                and m.compression.startswith("adaptive")):
+            from ..ops import adaptive as _adaptive
+
+            self.bw_tuner = _adaptive.BitwidthTuner()
         p = self.table.get(m.name)
         if p is None:
             p = _Pending(self.order_ctr)
@@ -1134,6 +1154,19 @@ class CoordState:
             return n * np.dtype(m.dtype).itemsize
         except TypeError:
             return n * 2  # bfloat16 and friends
+
+    @staticmethod
+    def _resolve_compression(metas) -> str:
+        """The negotiated wire mode for a bucket. Identical proposals pass
+        through unchanged; mismatched ``adaptive:<mode>`` proposals (a
+        decision boundary racing the enqueue — _validate admits only this
+        kind of mismatch) resolve to the LEAST aggressive grid, so no rank
+        is ever forced below the precision it asked for."""
+        wires = {m.compression for m in metas}
+        if len(wires) == 1:
+            return wires.pop()
+        order = {"adaptive:int4": 0, "adaptive:int8": 1, "adaptive:bf16": 2}
+        return max(wires, key=lambda w: order.get(w, 2))
 
     @staticmethod
     def _fuse_sig(m: ReqMeta):
@@ -1182,6 +1215,15 @@ class CoordState:
                 return ("Mismatched reduction op/scale factors for tensor "
                         f"'{name}' between ranks {r0} and {r}.")
             if m.compression != m0.compression:
+                # adaptive wire: a bitwidth-decision boundary can race the
+                # enqueue, so two ranks may transiently propose different
+                # "adaptive:<mode>" grids — negotiation resolves to the
+                # least aggressive (see _resolve_compression), NOT an
+                # error. Any other mismatch (static modes, or adaptive on
+                # one rank only) is still a config error and fails fast.
+                if (m.compression.startswith("adaptive:")
+                        and m0.compression.startswith("adaptive:")):
+                    continue
                 return (f"Mismatched compression for tensor '{name}': rank "
                         f"{r0} requested "
                         f"'{m0.compression or 'none'}', rank {r} requested "
@@ -1993,6 +2035,12 @@ class CoordController:
             # engine re-reads cycle_time_ms() after each coordinated tick
             self._threshold = int(tuned[0])
             self._cycle_ms = float(tuned[1])
+            if len(tuned) > 2 and tuned[2]:
+                # third field: the autotuned bitwidth cap for the adaptive
+                # wire — every rank's selector respects it from this tick
+                from ..ops import adaptive as _adaptive
+
+                _adaptive.set_autotuned_cap(tuned[2])
         if rflags & wire.RESP_SHUTDOWN:
             if reason.startswith("stall shutdown"):
                 # abnormal abort: surface loudly (parity with the in-process

@@ -47,12 +47,19 @@ Env knobs (parity with `common.h:61-87` / `operations.cc:388-485`):
                             PR-1 wire, docs/overlap.md)
 
 Autotune and compression: quantized allreduces are scored by the bytes the
-wire actually moved (int8 payload + f32 scales, Executor.last_wire_bytes),
+wire actually moved (integer payload + f32 scales, Executor.last_wire_bytes),
 not the fp32 bucket size, so the tuner's fusion threshold learns the
-compressed wire's economics. The compression mode itself is not a tuned
-parameter — it is negotiated once through the coordinated controller's
-response metadata (Response.compression) so all ranks compile identical
-programs; per-sample flapping would recompile every bucket.
+compressed wire's economics. Static compression modes are never tuned —
+each is negotiated once through the coordinated controller's response
+metadata (Response.compression) so all ranks compile identical programs;
+per-sample flapping would recompile every bucket. The adaptive wire
+(HOROVOD_COMPRESSION=adaptive) adds one tuned axis on top: the coordinator's
+BitwidthTuner (ops/adaptive.py) searches bitwidth CAPS over the same
+wire-true scores and broadcasts the winner as the third tuned field, while
+the per-bucket int4/int8/bf16 choice under that cap still flows through
+negotiated Response.compression — decisions change at observation-interval
+boundaries, not per sample, so recompiles stay rare and every rank compiles
+the same program for the same bucket.
 """
 
 from __future__ import annotations

@@ -222,23 +222,29 @@ class Executor:
     # ------------------------------------------------- quantized wire path
     @staticmethod
     def quantized_wire_layout(length: int, world: int,
-                              block: Optional[int] = None) -> Dict[str, int]:
-        """Byte accounting of the int8 wire program for a fused bucket of
-        ``length`` fp32 elements over ``world`` ranks: each rank's row is
+                              block: Optional[int] = None,
+                              bits: int = 8) -> Dict[str, int]:
+        """Byte accounting of the quantized wire program for a fused bucket
+        of ``length`` fp32 elements over ``world`` ranks: each rank's row is
         padded to ``world`` chunks of whole quantization blocks, the
-        all-to-all moves int8 payload + f32 scales, and the all-gather
-        moves the same for the requantized reduction. ``wire_bytes`` is the
-        per-rank total for one reduce+gather round (the number the ≤28%%
-        acceptance test counts)."""
+        all-to-all moves integer payload + f32 scales, and the all-gather
+        moves the same for the requantized reduction. ``bits`` selects the
+        grid: int8 is 1 byte/element, int4 packs two values per byte.
+        ``wire_bytes`` is the per-rank total for one reduce+gather round
+        (the number the ≤28%% acceptance test counts)."""
         from ..ops import compression as comp
 
         block = block or comp.block_size()
         chunk = -(-length // world)
         chunk = -(-chunk // block) * block
         padded = chunk * world
-        payload = padded                      # int8: 1 byte/element
+        if bits == 4:
+            payload = padded // 2             # int4: two values per byte
+        else:
+            payload = padded                  # int8: 1 byte/element
         scales = (padded // block) * 4        # one f32 scale per block
         return {"block": block, "chunk": chunk, "padded": padded,
+                "bits": bits,
                 "payload_bytes": payload, "scale_bytes": scales,
                 "wire_bytes": 2 * (payload + scales)}
 
@@ -265,7 +271,11 @@ class Executor:
                     by_name.setdefault(e.tensor_name, set()).add(
                         e.compression)
             for tname, modes in by_name.items():
-                if len(modes) > 1:
+                if len(modes) > 1 and not all(
+                        m.startswith("adaptive:") for m in modes):
+                    # all-adaptive mismatches are a decision boundary
+                    # racing the enqueue, resolved below; anything else
+                    # is a config error
                     raise ValueError(
                         f"Mismatched compression for tensor '{tname}': "
                         f"ranks requested {sorted(m or 'none' for m in modes)}"
@@ -273,8 +283,22 @@ class Executor:
                         "rank)")
             wires = {e.compression
                      for es in entries_by_rank.values() if es for e in es}
-            wire = wires.pop() if len(wires) == 1 else ""
-        if wire not in ("int8", "int8-dcn"):
+            if len(wires) > 1 and all(
+                    w.startswith("adaptive:") for w in wires):
+                # a bitwidth-decision boundary can race a native tick so
+                # ranks transiently request different adaptive grids —
+                # resolve to the least aggressive one, like the
+                # coordinated planes' negotiation does
+                order = {"adaptive:int4": 0, "adaptive:int8": 1,
+                         "adaptive:bf16": 2}
+                wire = max(wires, key=lambda w: order.get(w, 2))
+            else:
+                wire = wires.pop() if len(wires) == 1 else ""
+        if wire.startswith("adaptive:"):
+            # the negotiated per-bucket bitwidth decision: the concrete
+            # grid after the prefix is what compiles
+            wire = wire.split(":", 1)[1]
+        if wire not in ("int8", "int8-dcn", "int4", "bf16"):
             return ""
         if adasum or self._world == 1:
             return ""
@@ -283,6 +307,10 @@ class Executor:
         floor = int(os.environ.get("HOROVOD_COMPRESSION_MIN_SIZE", 1024))
         if length < floor:
             return ""  # small buckets: scale overhead beats the savings
+        if wire == "int4":
+            from ..ops import compression as comp
+            if comp.block_size() % 2:
+                return "int8"  # nibble packing needs an even block
         return wire
 
     def _allreduce_q_fn(self, n: int, length: int, dtype: str, average: bool,
@@ -300,21 +328,28 @@ class Executor:
         only the slow DCN hop pays the quantization — EQuARX's insight
         applied to the NCCLHierarchical decomposition of _allreduce2_fn.
         Without a two-level topology it degrades to the flat int8 program.
+
+        ``int4`` is the same program on the 4-bit grid and ALWAYS rides
+        the packed wire — nibble packing (two values per byte + 4 scale
+        bytes per block row) IS its wire format; there is no unpacked
+        int4 layout.
         """
         from ..ops import compression as comp
         from ..ops import pallas_kernels as pk
 
         block = comp.block_size()
+        bits = 4 if wire == "int4" else 8
         # HOROVOD_PACKED_WIRE: single-buffer wire rows [int8 payload |
         # 4 scale bytes] assembled by the fused quantize+pack kernel — ONE
         # all_to_all and ONE all_gather instead of two of each, and no
         # separate scale-quantize pass. Bit-identical values (same
         # quantize formula, same f32 sum order); same wire_bytes total.
-        packed = os.environ.get(
+        packed = bits == 4 or os.environ.get(
             "HOROVOD_PACKED_WIRE", "").lower() in ("1", "on", "true")
         hier = wire == "int8-dcn" and self._mesh2 is not None
-        key = ("allreduce_q", "int8-dcn" if hier else "int8", n, length,
-               dtype, average, prescale, postscale, block, packed)
+        key = ("allreduce_q",
+               "int8-dcn" if hier else ("int4" if bits == 4 else "int8"),
+               n, length, dtype, average, prescale, postscale, block, packed)
         fn = self._fn_cache.get(key)
         if fn is None:
             jax = self._jax
@@ -336,19 +371,25 @@ class Executor:
                     x = jnp.pad(x, (0, padded - ln))
                 if packed:
                     nb = chunk // block
-                    prow = block + pk.PACK_SCALE_BYTES
-                    p = pk.int8_quantize_pack(
-                        x.reshape(padded // block, block))
+                    if bits == 4:
+                        quant_pack = pk.int4_quantize_pack
+                        unpack = pk.int4_unpack
+                        prow = block // 2 + pk.PACK_SCALE_BYTES
+                    else:
+                        quant_pack = pk.int8_quantize_pack
+                        unpack = pk.int8_unpack
+                        prow = block + pk.PACK_SCALE_BYTES
+                    p = quant_pack(x.reshape(padded // block, block))
                     wt = lax.all_to_all(p.reshape(m, nb * prow), axis, 0, 0,
                                         tiled=True)
-                    q2, s2 = pk.int8_unpack(wt.reshape(m * nb, prow))
+                    q2, s2 = unpack(wt.reshape(m * nb, prow))
                     d = (q2.astype(jnp.float32).reshape(m, nb, block)
                          * s2.reshape(m, nb, 1))
                     red = jnp.sum(d.reshape(m, chunk), axis=0)
-                    rp = pk.int8_quantize_pack(red.reshape(nb, block))
+                    rp = quant_pack(red.reshape(nb, block))
                     gp = lax.all_gather(rp.reshape(nb * prow), axis,
                                         tiled=True)
-                    rq, rs = pk.int8_unpack(gp.reshape(m * nb, prow))
+                    rq, rs = unpack(gp.reshape(m * nb, prow))
                     out = (rq.astype(jnp.float32) * rs).reshape(padded)
                     return out[:ln] if padded != ln else out
                 q, s = comp.quantize_blocks(x, block)
@@ -414,6 +455,54 @@ class Executor:
                                    in_specs=P(MESH_AXIS),
                                    out_specs=P(MESH_AXIS),
                                    check_vma=False)
+            fn = jax.jit(sm)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _allreduce_bf16_fn(self, n: int, length: int, dtype: str,
+                           average: bool, prescale: float, postscale: float):
+        """bf16 cast wire as one compiled program: psum_scatter +
+        all_gather with both hops in bfloat16 (half the exact wire's
+        bytes, no block scales). This is the adaptive selector's fallback
+        grid for heavy-tailed buckets that fail the int8/int4 residual
+        test — the entry was enqueued under the identity compressor, so
+        the cast must happen inside the executor's program, mirroring the
+        ICI legs of the int8-dcn hierarchical form."""
+        key = ("allreduce_bf16", n, length, dtype, average, prescale,
+               postscale)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            size = self._world
+            pad = (-length) % size
+
+            def body(row):  # [1, L]: this rank's contribution
+                x = row[0].astype(jnp.float32)
+                if prescale != 1.0:
+                    x = x * np.float32(prescale)
+                x = x.astype(jnp.bfloat16)  # wire format: both hops bf16
+                if pad:
+                    x = jnp.pad(x, (0, pad))
+                s = lax.psum_scatter(x, MESH_AXIS, scatter_dimension=0,
+                                     tiled=True)
+                out = lax.all_gather(s, MESH_AXIS,
+                                     tiled=True).astype(jnp.float32)
+                if pad:
+                    out = out[:length]
+                if average:
+                    out = out / np.float32(size)
+                if postscale != 1.0:
+                    out = out * np.float32(postscale)
+                return out.astype(dtype)[None]
+
+            sm = jax.shard_map(body, mesh=self._mesh,
+                               in_specs=P(MESH_AXIS),
+                               out_specs=P(MESH_AXIS),
+                               check_vma=False)
             fn = jax.jit(sm)
             self._fn_cache[key] = fn
         return fn
@@ -616,6 +705,11 @@ class Executor:
                                self._row_sharding2() if two_level else None)
         if adasum:
             fn = self._adasum_fn(world, length, dtype)
+        elif wire == "bf16":
+            fn = self._allreduce_bf16_fn(world, length, dtype,
+                                         response.average,
+                                         e0.prescale_factor,
+                                         e0.postscale_factor)
         elif wire:
             fn = self._allreduce_q_fn(world, length, dtype, response.average,
                                       e0.prescale_factor,
@@ -636,9 +730,13 @@ class Executor:
 
     def _record_wire(self, wire: str, length: int, dtype: str) -> None:
         self.last_wire_mode = wire
-        if wire:
+        if wire == "bf16":
+            # cast wire: scatter + gather, 2 bytes/element, no scales
+            self.last_wire_bytes = 2 * length * 2
+        elif wire:
             self.last_wire_bytes = self.quantized_wire_layout(
-                length, self._world)["wire_bytes"]
+                length, self._world,
+                bits=4 if wire == "int4" else 8)["wire_bytes"]
         else:
             self.last_wire_bytes = 2 * length * np.dtype(dtype).itemsize
 
@@ -671,6 +769,11 @@ class Executor:
                                self._row_sharding2() if two_level else None)
         if adasum:
             fn = self._adasum_fn(world, length, dtype)
+        elif wire == "bf16":
+            fn = self._allreduce_bf16_fn(world, length, dtype,
+                                         response.average,
+                                         response.prescale,
+                                         response.postscale)
         elif wire:
             fn = self._allreduce_q_fn(world, length, dtype, response.average,
                                       response.prescale, response.postscale,

@@ -394,7 +394,7 @@ def encode_response_list(flags: int, last_joined: int,
                          cache_assignments: List[List[int]],
                          stall_warnings: List[str],
                          shutdown_reason: str = "",
-                         tuned: Optional[Tuple[int, float]] = None,
+                         tuned: Optional[Tuple] = None,
                          epoch: int = -1,
                          members: Optional[List[int]] = None,
                          invalid_ids: Optional[List[int]] = None) -> bytes:
@@ -442,10 +442,17 @@ def encode_response_list(flags: int, last_joined: int,
     w.u32(len(stall_warnings))
     for s in stall_warnings:
         w.str(s)
-    w.u8(0 if tuned is None else 1)
+    # tuned flag byte: 0 = absent, 1 = (threshold, cycle_ms) — byte-
+    # identical to the pre-bitwidth wire — 2 adds the autotuned bitwidth
+    # cap string (adaptive wire; decoders before flag 2 never see it
+    # because the coordinator only emits 2 when a cap exists)
+    has_cap = tuned is not None and len(tuned) > 2 and tuned[2]
+    w.u8(0 if tuned is None else (2 if has_cap else 1))
     if tuned is not None:
         w.i64(int(tuned[0]))
         w.f64(float(tuned[1]))
+        if has_cap:
+            w.str(str(tuned[2]))
     w.i32(epoch)
     w.u32(0 if members is None else len(members))
     for r in (members or ()):
@@ -492,8 +499,12 @@ def decode_response_list(buf: bytes):
         assignments.append(cids)
     warnings = [rd.str() for _ in range(rd.u32())]
     tuned = None
-    if rd.remaining() and rd.u8():
-        tuned = (rd.i64(), rd.f64())
+    if rd.remaining():
+        tflag = rd.u8()
+        if tflag:
+            tuned = (rd.i64(), rd.f64())
+            if tflag >= 2:
+                tuned = tuned + (rd.str(),)
     epoch = rd.i32() if rd.remaining() >= 4 else -1
     members: Optional[List[int]] = None
     if rd.remaining() >= 4:
