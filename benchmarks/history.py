@@ -79,29 +79,44 @@ def _median(values: List[float]) -> float:
 
 def check_regression(history: List[dict], value: float,
                      window: int = DEFAULT_WINDOW,
-                     tolerance: float = DEFAULT_TOLERANCE) -> dict:
+                     tolerance: float = DEFAULT_TOLERANCE,
+                     direction: str = "higher") -> dict:
     """Verdict dict for one fresh measurement against its trajectory.
 
-    ``regression`` is True when ``value`` falls more than ``tolerance``
-    below the median of the last ``window`` recorded values. With no
-    usable history the verdict is ``no_baseline`` (never a failure — the
-    first CI run must pass so it can seed the history)."""
+    ``direction`` states which way is good: ``"higher"`` (throughput —
+    regression when ``value`` falls more than ``tolerance`` below the
+    median of the last ``window`` recorded values) or ``"lower"``
+    (latency, e.g. the serving p99 gate — regression when ``value`` rises
+    more than ``tolerance`` above it). With no usable history the verdict
+    is ``no_baseline`` (never a failure — the first CI run must pass so it
+    can seed the history)."""
+    if direction not in ("higher", "lower"):
+        raise ValueError(f"direction={direction!r}; "
+                         "expected 'higher' or 'lower'")
     values = [float(r["value"]) for r in history[-int(window):]
               if isinstance(r.get("value"), (int, float))]
     if not values:
         return {"regression": False, "reason": "no_baseline", "value": value,
                 "baseline": None, "window": int(window),
-                "tolerance": tolerance, "samples": 0}
+                "tolerance": tolerance, "samples": 0,
+                "direction": direction}
     baseline = _median(values)
-    floor = baseline * (1.0 - tolerance)
-    regressed = bool(baseline > 0 and value < floor)
+    if direction == "higher":
+        bound = baseline * (1.0 - tolerance)
+        regressed = bool(baseline > 0 and value < bound)
+        reason = "below_tolerance" if regressed else "ok"
+    else:
+        bound = baseline * (1.0 + tolerance)
+        regressed = bool(baseline > 0 and value > bound)
+        reason = "above_tolerance" if regressed else "ok"
     return {
         "regression": regressed,
-        "reason": ("below_tolerance" if regressed else "ok"),
+        "reason": reason,
         "value": value,
         "baseline": round(baseline, 4),
-        "floor": round(floor, 4),
+        "floor": round(bound, 4),  # historical name; the gate boundary
         "window": int(window),
         "tolerance": tolerance,
         "samples": len(values),
+        "direction": direction,
     }

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Serving-mode load benchmark: sustained QPS, p50/p99 latency, tokens/s.
+
+A Poisson load generator over the inference serving subsystem
+(horovod_tpu/serving/, docs/inference.md). Requests arrive with
+exponential inter-arrival times at ``--qps``, each a random prompt of
+``--prompt-len`` tokens decoding ``--max-new`` tokens; the bench waits for
+every completion and reports the sustained rate and the latency tail.
+
+Two modes:
+
+* **in-process** (default): one ``ServingEngine`` replica, submits go
+  straight to the engine. This is the deterministic perf-gate mode.
+* **pod** (``--workers N``): spawns a ``ServingFrontend`` plus N worker
+  replica subprocesses (``python -m horovod_tpu.serving.worker``) and
+  drives them through a ``ServingClient`` over the hardened control
+  plane. ``--kill-one`` SIGKILLs a worker mid-run and asserts ZERO lost
+  requests — the killed replica's in-flight work must re-admit onto the
+  survivors (exit 4 if anything is lost), which is the ISSUE-11
+  acceptance demonstration.
+
+With ``--history PATH`` the run's p99 appends to the schema-versioned
+JSONL store (benchmarks/history.py); ``--check-regression`` compares
+against the trajectory BEFORE appending with ``direction="lower"``
+(latency: smaller is better) and exits 3 when the fresh p99 rises above
+the tolerance bound.
+
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py            # smoke
+    python benchmarks/serving_bench.py --workers 2 --kill-one       # pod
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Poisson load generator for the serving subsystem")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--qps", type=float, default=16.0,
+                   help="Poisson arrival rate (requests/second)")
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="per-request completion deadline")
+    p.add_argument("--workers", type=int, default=0,
+                   help="pod mode: spawn a frontend + N worker replica "
+                        "subprocesses (0 = in-process engine)")
+    p.add_argument("--kill-one", action="store_true",
+                   help="pod mode: SIGKILL one worker mid-run and require "
+                        "zero lost requests (exit 4 on loss)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--blocks", type=int, default=256)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--history", metavar="PATH", default=None,
+                   help="append this run's p99 to a schema-versioned JSONL "
+                        "perf history (benchmarks/history.py)")
+    p.add_argument("--check-regression", action="store_true",
+                   help="with --history: compare this run's p99 against "
+                        "the recorded trajectory BEFORE appending "
+                        "(direction=lower); exit 3 above the tolerance "
+                        "bound")
+    p.add_argument("--regression-window", type=int, default=None)
+    p.add_argument("--regression-tolerance", type=float, default=None)
+    return p.parse_args(argv)
+
+
+def poisson_load(submit, args, vocab=251):
+    """Drive ``submit(prompt, max_new) -> future`` at Poisson arrivals;
+    returns (futures, submit_wall_seconds)."""
+    rng = np.random.RandomState(args.seed)
+    futs = []
+    t0 = time.monotonic()
+    next_t = t0
+    for _ in range(args.requests):
+        next_t += rng.exponential(1.0 / max(args.qps, 1e-6))
+        while True:
+            now = time.monotonic()
+            if now >= next_t:
+                break
+            time.sleep(min(0.002, next_t - now))
+        prompt = rng.randint(1, vocab, size=args.prompt_len).tolist()
+        futs.append(submit(prompt, args.max_new))
+    return futs, time.monotonic() - t0
+
+
+def run_inprocess(args):
+    from horovod_tpu.serving import ServingConfig
+    from horovod_tpu.serving.worker import build_replica_engine
+
+    cfg = ServingConfig(block_size=args.block_size, num_blocks=args.blocks,
+                        max_batch=args.max_batch, max_context=128)
+    engine = build_replica_engine(max_seq_len=128, config=cfg).start()
+    # one throwaway request compiles prefill+decode outside the timed window
+    engine.submit([1] * args.prompt_len, 2).wait(timeout=args.timeout)
+
+    t0 = time.monotonic()
+    futs, _ = poisson_load(engine.submit, args)
+    for f in futs:
+        f.wait(timeout=args.timeout)
+    wall = time.monotonic() - t0
+    engine.stop()
+    lost = [f for f in futs if not f.done() or f.state != "done"]
+    lats = [f.latency() for f in futs if f.latency() is not None]
+    toks = sum(len(f.output) for f in futs)
+    return lats, toks, wall, len(lost)
+
+
+def run_pod(args):
+    from horovod_tpu.serving import ServingClient, ServingFrontend
+
+    fe = ServingFrontend().start()
+    host, port = fe.addr[0], fe.addr[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    for i in range(args.workers):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.serving.worker",
+             "--addr", f"{host}:{port}", "--rank", str(i + 1),
+             "--max-batch", str(args.max_batch),
+             "--blocks", str(args.blocks),
+             "--block-size", str(args.block_size)],
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+    try:
+        fe.wait_for_workers(args.workers, timeout=120)
+        cli = ServingClient(host, port, name="bench")
+        # warm every replica's compile cache before the timed window
+        warm = [cli.submit([1] * args.prompt_len, 2)
+                for _ in range(args.workers * args.max_batch)]
+        for f in warm:
+            f.result(timeout=args.timeout)
+
+        t0 = time.monotonic()
+        kill_at = args.requests // 3 if args.kill_one else None
+        futs = []
+        rng = np.random.RandomState(args.seed)
+        next_t = time.monotonic()
+        for i in range(args.requests):
+            next_t += rng.exponential(1.0 / max(args.qps, 1e-6))
+            while time.monotonic() < next_t:
+                time.sleep(0.002)
+            prompt = rng.randint(1, 251, size=args.prompt_len).tolist()
+            futs.append(cli.submit(prompt, args.max_new))
+            if kill_at is not None and i == kill_at:
+                victim = procs[0]
+                print(f"# SIGKILL worker pid {victim.pid} mid-run",
+                      file=sys.stderr)
+                victim.kill()
+        lost = 0
+        lats, toks = [], 0
+        for f in futs:
+            try:
+                tokens = f.result(timeout=args.timeout)
+            except (RuntimeError, TimeoutError) as exc:
+                print(f"# LOST {f.id}: {exc}", file=sys.stderr)
+                lost += 1
+                continue
+            toks += len(tokens)
+            lats.append(f.client_latency())
+        wall = time.monotonic() - t0
+        print("# frontend: %s" % json.dumps(fe.stats()), file=sys.stderr)
+        cli.close()
+        return lats, toks, wall, lost
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+        fe.stop()
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.kill_one and args.workers < 2:
+        sys.exit("--kill-one needs --workers >= 2 (someone must survive)")
+    lats, toks, wall, lost = (run_pod(args) if args.workers
+                              else run_inprocess(args))
+    if not lats:
+        sys.exit("no requests completed")
+    p50 = float(np.percentile(lats, 50))
+    p99 = float(np.percentile(lats, 99))
+    qps = len(lats) / wall
+    tok_s = toks / wall
+    print(f"# {len(lats)}/{args.requests} requests in {wall:.2f}s "
+          f"({'pod, %d workers' % args.workers if args.workers else 'in-process'})",
+          file=sys.stderr)
+    print(f"# sustained QPS: {qps:.1f}; tokens/s: {tok_s:.0f}; "
+          f"p50: {p50 * 1e3:.1f}ms; p99: {p99 * 1e3:.1f}ms; lost: {lost}",
+          file=sys.stderr)
+    result = {
+        "metric": "serving_p99_seconds",
+        "value": round(p99, 4),
+        "unit": "s",
+        "qps": round(qps, 2),
+        "tokens_per_sec": round(tok_s, 1),
+        "p50_seconds": round(p50, 4),
+        "lost": lost,
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if lost:
+        print(f"# FAIL: {lost} request(s) lost — elastic re-admission must "
+              "leave zero behind", file=sys.stderr)
+        rc = 4
+    if args.history:
+        from benchmarks.history import (append_record, check_regression,
+                                        load_history)
+
+        # compare against the trajectory BEFORE appending: today's run
+        # must not be allowed to vote in its own baseline
+        if args.check_regression:
+            verdict = check_regression(
+                load_history(args.history, metric=result["metric"]),
+                result["value"], direction="lower",
+                **{k: v for k, v in (
+                    ("window", args.regression_window),
+                    ("tolerance", args.regression_tolerance))
+                   if v is not None})
+            print("# regression check: %s" % json.dumps(verdict),
+                  file=sys.stderr)
+            if verdict["regression"]:
+                print(f"# REGRESSION: p99 {result['value']}s rose above "
+                      f"the bound {verdict['floor']}s (baseline "
+                      f"{verdict['baseline']}s over {verdict['samples']} "
+                      "runs)", file=sys.stderr)
+                rc = rc or 3
+        append_record(args.history, {
+            "metric": result["metric"], "value": result["value"],
+            "unit": result["unit"], "qps": result["qps"],
+            "tokens_per_sec": result["tokens_per_sec"],
+            "p50_seconds": result["p50_seconds"],
+            "workers": args.workers, "requests": args.requests,
+            "prompt_len": args.prompt_len, "max_new": args.max_new,
+        })
+        print(f"# perf history appended to {args.history}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -675,6 +675,91 @@ def check_adaptive_wire() -> None:
           f"({r.stdout.strip().splitlines()[-1]})")
 
 
+def check_serving_kill() -> None:
+    """Elastic serving smoke (docs/inference.md): a frontend + 2 worker
+    replicas under sustained load must survive a SIGKILL of one replica —
+    the dead worker's in-flight requests re-admit onto the survivor, ZERO
+    requests are lost, and the frontend's /metrics endpoint keeps serving
+    the hvd_serving_* catalog (including the readmitted counter) after
+    the kill."""
+    code = (
+        "import json, os, signal, subprocess, sys, time, urllib.request\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "os.environ['HOROVOD_METRICS_PORT'] = '0'\n"
+        "import numpy as np\n"
+        "from horovod_tpu.metrics import server_port\n"
+        "from horovod_tpu.serving import ServingClient, ServingFrontend\n"
+        "fe = ServingFrontend().start()\n"
+        "host, port = fe.addr\n"
+        "env = dict(os.environ, JAX_PLATFORMS='cpu',"
+        " PALLAS_AXON_POOL_IPS='')\n"
+        "procs = [subprocess.Popen(\n"
+        "    [sys.executable, '-m', 'horovod_tpu.serving.worker',\n"
+        "     '--addr', f'{host}:{port}', '--rank', str(i + 1),\n"
+        "     '--max-batch', '4'],\n"
+        f"    env=env, cwd={REPO!r}) for i in range(2)]\n"
+        "try:\n"
+        "    fe.wait_for_workers(2, timeout=120)\n"
+        "    cli = ServingClient(host, port, name='smoke')\n"
+        "    # warm both replicas' compile caches before the timed window\n"
+        "    for f in [cli.submit([1, 2, 3], 2) for _ in range(8)]:\n"
+        "        f.result(timeout=120)\n"
+        "    rng = np.random.RandomState(0)\n"
+        "    futs = []\n"
+        "    for i in range(18):\n"
+        "        futs.append(cli.submit(\n"
+        "            rng.randint(1, 251, size=6).tolist(), 6))\n"
+        "        if i == 6:\n"
+        "            procs[0].kill()  # SIGKILL a replica mid-flight\n"
+        "        time.sleep(0.02)\n"
+        "    lost = 0\n"
+        "    for f in futs:\n"
+        "        try:\n"
+        "            f.result(timeout=120)\n"
+        "        except Exception as exc:\n"
+        "            print(f'LOST {f.id}: {exc}', file=sys.stderr)\n"
+        "            lost += 1\n"
+        "    stats = fe.stats()\n"
+        "    assert lost == 0, f'{lost} request(s) lost after worker kill'\n"
+        "    assert stats['readmitted'] >= 1, stats\n"
+        "    assert stats['completed'] >= 18, stats\n"
+        "    assert len(stats['workers']) == 1, stats\n"
+        "    mport = server_port()\n"
+        "    assert mport, 'frontend metrics endpoint did not start'\n"
+        "    body = urllib.request.urlopen(\n"
+        "        f'http://127.0.0.1:{mport}/metrics', timeout=10)"
+        ".read().decode()\n"
+        "    print(json.dumps(stats), file=sys.stderr)\n"
+        "    sys.stdout.write(body)\n"
+        "finally:\n"
+        "    for pr in procs:\n"
+        "        if pr.poll() is None:\n"
+        "            pr.terminate()\n"
+        "    for pr in procs:\n"
+        "        try:\n"
+        "            pr.wait(timeout=10)\n"
+        "        except subprocess.TimeoutExpired:\n"
+        "            pr.kill()\n"
+        "    fe.stop()\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (
+        f"serving worker-kill smoke failed:\n{r.stderr[-3000:]}")
+    from horovod_tpu.metrics import parse_prometheus
+
+    samples = parse_prometheus(r.stdout)
+    for want in ("hvd_serving_requests_total",
+                 "hvd_serving_request_latency_seconds_count"):
+        assert any(k.startswith(want) for k in samples), (
+            f"/metrics output missing {want} after the kill:\n"
+            f"{sorted(samples)[:40]}")
+    print("ok: serving smoke — SIGKILLed a replica under load, in-flight "
+          "requests re-admitted onto the survivor, zero lost, /metrics "
+          "still serving the hvd_serving_* catalog")
+
+
 def main():
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
@@ -688,10 +773,11 @@ def main():
     check_blackbox_doctor()
     check_coordinator_failover()
     check_adaptive_wire()
+    check_serving_kill()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
           "+ bucket overlap + blackbox doctor + coordinator failover "
-          "+ adaptive wire valid")
+          "+ adaptive wire + serving worker-kill valid")
 
 
 if __name__ == "__main__":

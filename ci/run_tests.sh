@@ -62,6 +62,12 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/allreduce_bench.py --compression int8,int4,adaptive \
         --sizes-mb 0.25 --iters 3
 
+stage "serving: continuous batching, paged KV cache, elastic pod serving"
+python -m pytest tests/test_serving.py -q -m "not integration"
+# in-process load bench (deterministic perf-gate mode); exit 4 on any
+# lost request, exit 3 on a p99 regression when a history is supplied
+python benchmarks/serving_bench.py --requests 12 --qps 32 --max-new 4
+
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # includes tests/test_spark_real.py (real-pyspark scenarios; they skip
 # when pyspark is absent from the image)

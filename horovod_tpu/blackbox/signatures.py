@@ -305,6 +305,29 @@ def detect_bitwidth_thrash(bundle) -> List[dict]:
     return sigs
 
 
+def detect_latency_regression(bundle) -> List[dict]:
+    """Serving-mode latency regression: the live anomaly watch flagged a
+    serving signal (request-latency p99 or admission queue depth) deviating
+    from its rolling baseline and recorded the K_ANOMALY event this
+    detector resurfaces postmortem (serving/engine.py gauges,
+    docs/inference.md). One signature per signal: the first firing is the
+    story, later ones are the same regression still burning."""
+    sigs = []
+    seen = set()
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_ANOMALY:
+            continue
+        name = ev.get("name") or ""
+        if not name.startswith("serving_") or name in seen:
+            continue
+        seen.add(name)
+        sigs.append(make_signature(
+            "latency_regression", SEV_WARNING,
+            "serving latency regression: %s" % (ev.get("detail") or name),
+            signal=name, reported_by=src))
+    return sigs
+
+
 #: every event-based detector the doctor runs, in reporting order
 DETECTORS = (
     detect_collective_deadlock,
@@ -313,6 +336,7 @@ DETECTORS = (
     detect_dead_worker,
     detect_coordinator_failover,
     detect_straggler,
+    detect_latency_regression,
     detect_reconnect_storm,
     detect_heartbeat_flap,
     detect_bitwidth_thrash,

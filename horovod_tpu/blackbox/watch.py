@@ -35,6 +35,12 @@ SIGNALS = (
     ("exposed_comm_seconds", 1e-3),
     ("straggler_skew_seconds", 0.05),
     ("wire_bytes_rate", 1024.0),
+    # serving mode (serving/engine.py gauges): per-interval request-latency
+    # p99 out of histogram bucket deltas, plus the admission queue depth.
+    # Only sampled when the serving families exist in the snapshot, so
+    # training-only jobs keep clean baselines.
+    ("serving_p99_seconds", 1e-3),
+    ("serving_queue_depth", 1.0),
 )
 
 _watch = None
@@ -115,7 +121,52 @@ class AnomalyWatch:
             snapshot, "hvd_wire_bytes_total"))
         if dwire is not None:
             out["wire_bytes_rate"] = dwire / max(self.interval, 1e-6)
+        p99 = self._serving_p99(snapshot)
+        if p99 is not None:
+            out["serving_p99_seconds"] = p99
+        if "hvd_serving_queue_depth" in snapshot:
+            out["serving_queue_depth"] = _series_total(
+                snapshot, "hvd_serving_queue_depth")
         return out
+
+    def _serving_p99(self, snapshot):
+        """This interval's request-latency p99: the bucket-count DELTAS of
+        ``hvd_serving_request_latency_seconds{stage="total"}`` between
+        samples (counts are per-bucket, last slot = +Inf overflow), read at
+        the 99th percentile — so the signal tracks the latency of requests
+        finished in this window, not the lifetime distribution."""
+        metric = snapshot.get("hvd_serving_request_latency_seconds")
+        if not metric:
+            return None
+        buckets = metric.get("buckets") or []
+        counts = None
+        for series in metric.get("series") or []:
+            if (series.get("labels") or {}).get("stage") != "total":
+                continue
+            c = [float(x) for x in series.get("counts") or []]
+            if counts is None:
+                counts = c
+            elif len(c) == len(counts):
+                counts = [a + b for a, b in zip(counts, c)]
+        if not counts:
+            return None
+        prev = self._prev.get("serving_lat_counts")
+        self._prev["serving_lat_counts"] = counts
+        if (prev is None or len(prev) != len(counts)
+                or sum(counts) < sum(prev)):  # first sample / reset
+            return None
+        delta = [max(0.0, a - b) for a, b in zip(counts, prev)]
+        total = sum(delta)
+        if total <= 0:
+            return None
+        acc = 0.0
+        for i, d in enumerate(delta):
+            acc += d
+            if acc >= 0.99 * total:
+                # overflow slot: report past the largest finite bound
+                return (buckets[i] if i < len(buckets)
+                        else buckets[-1] * 2.0 if buckets else None)
+        return buckets[-1] * 2.0 if buckets else None
 
     # ------------------------------------------------------------ decision
     def observe_snapshot(self, snapshot) -> list:
@@ -130,8 +181,12 @@ class AnomalyWatch:
             base = baseline.baseline()
             anomalous = baseline.observe(value)
             if anomalous and not self._active[name]:
+                # serving signals map to the doctor's latency_regression
+                # vocabulary; everything else keeps the generic id
+                sig_id = ("latency_regression" if name.startswith("serving_")
+                          else "anomaly:%s" % name)
                 sig = make_signature(
-                    "anomaly:%s" % name, SEV_WARNING,
+                    sig_id, SEV_WARNING,
                     "anomaly: %s=%.6g deviates from rolling baseline %.6g "
                     "(factor %g over %d samples)"
                     % (name, value, base, baseline.factor, len(baseline)),

@@ -264,3 +264,75 @@ def standby_journal_lag():
         "Journal records queued at rank 0 but not yet shipped to the "
         "warm-standby coordinator (0 = the standby is current; "
         "docs/control-plane.md).", agg="max")
+
+
+# --------------------------------------------------------------- serving
+# The inference-serving catalog (serving/, docs/inference.md). Request
+# latencies use the default LATENCY_BUCKETS, whose bucket-count deltas are
+# also what the anomaly watch derives its live p99 from.
+
+def serving_requests():
+    return get_registry().counter(
+        "hvd_serving_requests_total",
+        "Serving requests by terminal disposition (submitted / completed / "
+        "failed / rejected / readmitted).", labels=("status",))
+
+
+def serving_request_latency():
+    return get_registry().histogram(
+        "hvd_serving_request_latency_seconds",
+        "Request latency: submit-to-done (stage=total) and submit-to-first-"
+        "token (stage=first_token). p50/p99 derive from bucket counts.",
+        labels=("stage",))
+
+
+def serving_phase_seconds():
+    return get_registry().histogram(
+        "hvd_serving_phase_seconds",
+        "Engine phase wall time per step (phase=prefill|decode).",
+        labels=("phase",))
+
+
+def serving_tokens():
+    return get_registry().counter(
+        "hvd_serving_tokens_total",
+        "Tokens processed: prompt tokens prefilled (phase=prefill) and "
+        "tokens generated (phase=decode). rate(phase=decode) is the "
+        "tokens/s headline.", labels=("phase",))
+
+
+def serving_decode_batch():
+    return get_registry().histogram(
+        "hvd_serving_decode_batch",
+        "In-flight requests per batched decode step (continuous-batching "
+        "fill; max is the HOROVOD_SERVING_MAX_BATCH width).",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+
+
+def serving_queue_depth():
+    return get_registry().gauge(
+        "hvd_serving_queue_depth",
+        "Requests waiting in the admission queue (bounded by "
+        "HOROVOD_SERVING_MAX_QUEUE; sustained depth = saturation).",
+        agg="max")
+
+
+def serving_active_requests():
+    return get_registry().gauge(
+        "hvd_serving_active_requests",
+        "Requests currently in the decode batch.", agg="max")
+
+
+def serving_kv_occupancy():
+    return get_registry().gauge(
+        "hvd_serving_kv_occupancy",
+        "Fraction of KV-cache blocks allocated (the admission-control "
+        "currency; 1.0 = no new request can be admitted).", agg="max")
+
+
+def serving_kv_tokens():
+    return get_registry().gauge(
+        "hvd_serving_kv_tokens",
+        "Token slots actually written in the KV cache (live context "
+        "payload, vs the block-granular hvd_serving_kv_occupancy).",
+        agg="max")

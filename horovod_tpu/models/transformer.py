@@ -47,6 +47,40 @@ def default_attention(q, k, v):
     return flash_attention(q, k, v, causal=True)
 
 
+def cached_attention(q, k, v, past_mask):
+    """Attention for KV-cache inference (serving prefill/decode).
+
+    ``q``: new-token queries [B, T, H, Dh]; ``k``/``v``: cached past K/V
+    concatenated with the new block, [B, P+T, H, Dh]; ``past_mask``: bool
+    [B, P] validity of each cached slot (False = padding in a gathered
+    paged cache). New tokens attend causally within their own block and to
+    every valid past slot.
+
+    Masking is exact -inf: a padded slot's softmax weight is exactly 0.0
+    and contributes exactly 0.0 to the weighted sum, so — at fixed array
+    shapes — a request's output is bit-identical no matter how much
+    padding or which other requests share the batch (the property
+    ``serving/engine.py``'s batched-equals-sequential guarantee rests on;
+    asserted by tests/test_serving.py).
+    """
+    b, t, _, dh = q.shape
+    p = k.shape[1] - t
+    scale = 1.0 / float(dh) ** 0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    new_mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]  # [T, T]
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(past_mask[:, None, :], (b, t, p)),
+         jnp.broadcast_to(new_mask[None], (b, t, t))], axis=-1)
+    s = jnp.where(mask[:, None], s, -jnp.inf)
+    # every row has at least its own (causal-self) slot, so the max is
+    # finite and exp(-inf - m) underflows to exactly 0.0 for masked slots
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    probs = e / jnp.sum(e, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+
+
 class FusedLayerNorm(nn.Module):
     """Drop-in ``nn.LayerNorm`` backed by the one-pass Pallas kernels
     (``ops/pallas_kernels.fused_layer_norm``; identical-contract jnp
@@ -82,7 +116,13 @@ class Block(nn.Module):
     attn_fn: AttnFn
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None):
+        """``kv``: None for training/full-context forward (causal
+        ``attn_fn``, returns the block output alone — the seam every
+        existing caller uses unchanged), or ``(k_past, v_past, past_mask)``
+        for KV-cache inference (``cached_attention`` over past + new,
+        returns ``(output, (k_new, v_new))`` so the caller can extend its
+        cache)."""
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
@@ -99,7 +139,16 @@ class Block(nn.Module):
         # split(3) would cut each tp shard across q/k/v boundaries
         qkv = qkv.reshape(b, t, self.num_heads, 3, head_dim)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        out = self.attn_fn(q, k, v)
+        if kv is None:
+            out = self.attn_fn(q, k, v)
+            new_kv = None
+        else:
+            k_past, v_past, past_mask = kv
+            out = cached_attention(
+                q, jnp.concatenate([k_past.astype(k.dtype), k], axis=1),
+                jnp.concatenate([v_past.astype(v.dtype), v], axis=1),
+                past_mask)
+            new_kv = (k, v)
         out = dense(d_model, name="proj")(
             out.astype(self.dtype).reshape(b, t, d_model))
         x = x + out
@@ -108,7 +157,8 @@ class Block(nn.Module):
         h = dense(4 * d_model, name="mlp_in")(h)
         h = nn.gelu(h)
         h = dense(d_model, name="mlp_out")(h)
-        return x + h
+        x = x + h
+        return x if kv is None else (x, new_kv)
 
 
 #: rematerialization policies for ``TransformerLM(remat=...)``, mapping mode
@@ -135,16 +185,28 @@ class TransformerLM(nn.Module):
     remat: str = "none"  # "none" | "full" | "dots" — see REMAT_POLICIES
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0, return_hidden=False):
+    def __call__(self, tokens, pos_offset=0, return_hidden=False,
+                 kv_cache=None):
         """tokens: int [B, T_local]; pos_offset: global position of column 0
-        (nonzero when the sequence axis is sharded across devices).
+        (nonzero when the sequence axis is sharded across devices, and an
+        int array broadcastable against [B, T] — e.g. shape [B, 1] — when
+        rows sit at different positions, as in batched KV-cache decode).
 
         ``return_hidden=True`` skips the weight-tied logit head and returns
         the final-LN hidden states [B, T, d_model] — pair with
         ``lm_loss_chunked`` to compute the cross entropy without ever
         materializing the [B, T, vocab] logits (the logits alone are
         batch·seq·vocab·4 bytes; at batch 32, seq 1024, vocab 32k that is
-        4.3 GB of HBM the chunked path never allocates)."""
+        4.3 GB of HBM the chunked path never allocates).
+
+        ``kv_cache``: None (training / full-context forward, unchanged
+        return), or ``(past_k, past_v, past_mask)`` for inference serving —
+        ``past_k``/``past_v`` [num_layers, B, P, H, Dh] gathered cache
+        (P may be 0 for prefill, padded slots allowed), ``past_mask`` bool
+        [B, P] slot validity. Returns ``(logits_or_hidden, (new_k, new_v))``
+        with ``new_k``/``new_v`` [num_layers, B, T, H, Dh], the K/V of the
+        new tokens for the caller's cache (serving/engine.py writes them
+        into its paged pool)."""
         attn = self.attn_fn if self.attn_fn is not None else default_attention
         emb = nn.Embed(self.vocab_size, self.d_model,
                        embedding_init=nn.initializers.normal(0.02),
@@ -185,16 +247,27 @@ class TransformerLM(nn.Module):
                              f"{sorted(REMAT_POLICIES)}")
         use_remat, policy = REMAT_POLICIES[self.remat]
         block_cls = nn.remat(Block, policy=policy) if use_remat else Block
+        new_ks, new_vs = [], []
         for i in range(self.num_layers):
-            x = block_cls(self.num_heads, self.dtype, attn,
-                          name=f"block_{i}")(x)
+            block = block_cls(self.num_heads, self.dtype, attn,
+                              name=f"block_{i}")
+            if kv_cache is None:
+                x = block(x)
+            else:
+                past_k, past_v, past_mask = kv_cache
+                x, (nk, nv) = block(x, (past_k[i], past_v[i], past_mask))
+                new_ks.append(nk)
+                new_vs.append(nv)
         x = _ln_cls()(dtype=self.dtype, param_dtype=jnp.float32,
                       name="ln_f")(x)
         if return_hidden:
-            return x
-        # weight-tied head: logits = x @ tok_emb.T
-        logits = emb.attend(x.astype(jnp.float32))
-        return logits.astype(jnp.float32)
+            out = x
+        else:
+            # weight-tied head: logits = x @ tok_emb.T
+            out = emb.attend(x.astype(jnp.float32)).astype(jnp.float32)
+        if kv_cache is None:
+            return out
+        return out, (jnp.stack(new_ks), jnp.stack(new_vs))
 
 
 def lm_loss(logits, targets):

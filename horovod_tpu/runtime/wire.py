@@ -50,7 +50,8 @@ _FRAME_NAMES = {1: "HELLO", 2: "LIST", 3: "RESP", 4: "BYE", 7: "METRICS",
                 8: "HEARTBEAT", 9: "RESUME", 10: "TRACE", 11: "CLOCK",
                 12: "CLOCK_RESP", 13: "BLACKBOX", 14: "BATCH",
                 15: "BATCH_RESP", 16: "BATCH_HB", 17: "REPL_HELLO",
-                18: "SNAPSHOT", 19: "JOURNAL"}
+                18: "SNAPSHOT", 19: "JOURNAL", 20: "SERVE_HELLO",
+                21: "SERVE_SUBMIT", 22: "SERVE_RESULT"}
 
 
 def _frame_limit() -> int:
@@ -855,3 +856,91 @@ def decode_coord_journal(buf: bytes):
     members = [rd.i32() for _ in range(rd.u32())]
     reason = rd.str()
     return jseq, epoch, members, reason
+
+
+# --------------------------------------------------------------------------
+# Inference serving frames (MSG_SERVE_HELLO / MSG_SERVE_SUBMIT /
+# MSG_SERVE_RESULT). The serving frontend speaks the SAME hardened framing
+# as the training control plane — CRC/HMAC, frame-size bounds, heartbeats
+# (MSG_HEARTBEAT rides unchanged), reconnect-and-resubmit recovery — so
+# the PR-4 integrity and liveness machinery protects request traffic for
+# free (serving/server.py, docs/inference.md). SUBMIT flows client ->
+# frontend -> worker replica; RESULT flows back. Request ids are
+# client-chosen strings: the frontend dedupes on them, which is what makes
+# resubmit-after-reconnect exactly-once from the client's point of view.
+# --------------------------------------------------------------------------
+
+MSG_SERVE_HELLO = 20
+MSG_SERVE_SUBMIT = 21
+MSG_SERVE_RESULT = 22
+
+# MSG_SERVE_HELLO roles
+SERVE_ROLE_CLIENT = 0
+SERVE_ROLE_WORKER = 1
+
+# MSG_SERVE_RESULT statuses
+SERVE_OK = 0          # tokens carry the completed generation
+SERVE_FAILED = 1      # non-retryable (bad request / engine error)
+SERVE_REJECTED = 2    # admission backpressure — retry with backoff
+
+
+def encode_serve_hello(role: int, name: str, capacity: int) -> bytes:
+    """``capacity``: a worker's decode-batch width (its max concurrent
+    requests, the dispatcher's load-balancing weight); 0 for clients."""
+    w = Writer()
+    w.u8(role)
+    w.str(name)
+    w.u32(capacity)
+    return w.getvalue()
+
+
+def decode_serve_hello(buf: bytes):
+    """Returns (role, name, capacity)."""
+    rd = Reader(buf)
+    return rd.u8(), rd.str(), rd.u32()
+
+
+def encode_serve_submit(request_id: str, prompt: List[int],
+                        max_new_tokens: int, eos_id: Optional[int]) -> bytes:
+    w = Writer()
+    w.str(request_id)
+    w.u32(len(prompt))
+    for t in prompt:
+        w.i32(int(t))
+    w.u32(max_new_tokens)
+    w.i32(-1 if eos_id is None else int(eos_id))
+    return w.getvalue()
+
+
+def decode_serve_submit(buf: bytes):
+    """Returns (request_id, prompt, max_new_tokens, eos_id|None)."""
+    rd = Reader(buf)
+    request_id = rd.str()
+    prompt = [rd.i32() for _ in range(rd.u32())]
+    max_new = rd.u32()
+    eos = rd.i32()
+    return request_id, prompt, max_new, (None if eos < 0 else eos)
+
+
+def encode_serve_result(request_id: str, status: int, tokens: List[int],
+                        error: str = "", latency: float = 0.0) -> bytes:
+    w = Writer()
+    w.str(request_id)
+    w.u8(status)
+    w.u32(len(tokens))
+    for t in tokens:
+        w.i32(int(t))
+    w.str(error)
+    w.f64(latency)
+    return w.getvalue()
+
+
+def decode_serve_result(buf: bytes):
+    """Returns (request_id, status, tokens, error, latency)."""
+    rd = Reader(buf)
+    request_id = rd.str()
+    status = rd.u8()
+    tokens = [rd.i32() for _ in range(rd.u32())]
+    error = rd.str()
+    latency = rd.f64()
+    return request_id, status, tokens, error, latency

@@ -1,0 +1,38 @@
+"""Inference serving subsystem (docs/inference.md).
+
+Turns the training stack into a server: a continuous-batching scheduler
+admits requests against a paged KV cache, a :class:`ServingEngine` runs
+the prefill/decode loop over the sharded ``models/transformer.py``
+TransformerLM, and the ``server``/``worker``/``client`` modules put the
+whole thing behind the PR-4 hardened control plane (framed TCP with
+CRC/HMAC, heartbeats, liveness, elastic worker re-admission).
+
+Quick start (in-process, single replica)::
+
+    from horovod_tpu.serving import ServingConfig, ServingEngine
+    engine = ServingEngine(model, params,
+                           ServingConfig(num_blocks=64)).start()
+    req = engine.submit(prompt_tokens, max_new_tokens=32)
+    print(req.result(timeout=60))
+
+For the networked pod-serving mode (frontend + N worker replicas +
+clients) see ``serving/server.py`` and ``examples/serve_transformer_lm.py``.
+"""
+
+from .client import ClientRequest, ServingClient
+from .engine import ServingConfig, ServingEngine
+from .kvcache import BlockAllocator, KVCacheFull, PagedKVCache, \
+    blocks_for_tokens
+from .scheduler import (ACTIVE, DONE, FAILED, QUEUED,
+                        ContinuousBatchingScheduler, QueueFull, Request)
+from .server import ServingFrontend
+from .worker import ServingWorker, build_replica_engine
+
+__all__ = [
+    "ServingConfig", "ServingEngine",
+    "PagedKVCache", "BlockAllocator", "KVCacheFull", "blocks_for_tokens",
+    "ContinuousBatchingScheduler", "Request", "QueueFull",
+    "QUEUED", "ACTIVE", "DONE", "FAILED",
+    "ServingFrontend", "ServingWorker", "build_replica_engine",
+    "ServingClient", "ClientRequest",
+]

@@ -1,0 +1,226 @@
+"""Serving client: submit requests to a frontend, survive every outage.
+
+The client's recovery contract is deliberately dumb: it remembers the
+encoded SUBMIT payload of every unresolved request, and whenever the
+connection to the frontend (``serving/server.py``) is re-established it
+blindly resubmits all of them. Correctness comes from the frontend, not
+the client — request ids are client-chosen and the frontend dedupes on
+them (in-flight resubmits re-own the request, finished ones answer from
+the result cache), so the naive replay is exactly-once end to end.
+
+Admission backpressure (``SERVE_REJECTED``) is retried here with capped
+exponential backoff per request, invisible to the caller unless
+``max_retries`` runs out.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..runtime import wire
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class ClientRequest:
+    """Future for one submitted request."""
+
+    __slots__ = ("id", "tokens", "error", "latency", "rejections",
+                 "submitted_t", "done_t", "_event", "_failed")
+
+    def __init__(self, request_id: str):
+        self.id = request_id
+        self.tokens: List[int] = []
+        self.error = ""
+        self.latency = 0.0        # frontend-measured dispatch-to-done
+        self.rejections = 0       # backpressure retries absorbed
+        self.submitted_t = time.monotonic()
+        self.done_t: Optional[float] = None
+        self._event = threading.Event()
+        self._failed = False
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done")
+        if self._failed:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return list(self.tokens)
+
+    def client_latency(self) -> Optional[float]:
+        """Submit-to-result wall time as this client saw it (includes
+        queueing, retries and any reconnect windows)."""
+        if self.done_t is None:
+            return None
+        return self.done_t - self.submitted_t
+
+
+class ServingClient:
+    """One connection to a serving frontend."""
+
+    _ids = itertools.count()
+
+    def __init__(self, host: str, port: int, name: str = "client",
+                 secret: Optional[str] = None, max_retries: int = 64,
+                 connect_timeout: float = 30.0):
+        self.host = host
+        self.port = int(port)
+        self.name = name
+        self.secret = (secret if secret is not None
+                       else os.environ.get("HVD_SECRET", ""))
+        self.max_retries = int(max_retries)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        # rid -> (future, encoded SUBMIT payload) for every unresolved
+        # request — the replay set for reconnects
+        self._pending: Dict[str, tuple] = {}
+        self._connect(deadline=time.monotonic() + connect_timeout)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="hvd-serve-client",
+                                        daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------- wire
+    def _connect(self, deadline: Optional[float] = None) -> None:
+        delay = 0.1
+        while not self._stop.is_set():
+            try:
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=5.0)
+                sock.settimeout(1.0)
+                wire.send_frame(sock, self.secret, wire.MSG_SERVE_HELLO,
+                                0, -1,
+                                wire.encode_serve_hello(
+                                    wire.SERVE_ROLE_CLIENT, self.name, 0))
+                with self._lock:
+                    self._sock = sock
+                    replay = [p for _, p in self._pending.values()]
+                for payload in replay:
+                    self._send(wire.MSG_SERVE_SUBMIT, payload)
+                return
+            except OSError as exc:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"serving frontend {self.host}:{self.port} "
+                        f"unreachable: {exc}")
+                if self._stop.wait(delay):
+                    raise ConnectionError("client closed while connecting")
+                delay = min(delay * 2, 2.0)
+        raise ConnectionError("client closed while connecting")
+
+    def _send(self, msg_type: int, payload: bytes) -> bool:
+        with self._lock:
+            sock = self._sock
+            if sock is None:
+                return False
+            try:
+                self._seq += 1
+                wire.send_frame(sock, self.secret, msg_type, self._seq, -1,
+                                payload)
+                return True
+            except OSError:
+                return False
+
+    def _read_loop(self) -> None:
+        while not self._stop.is_set():
+            sock = self._sock
+            if sock is None:
+                try:
+                    self._connect()
+                except ConnectionError:
+                    return
+                continue
+            try:
+                frame = wire.recv_frame(sock, self.secret, self._stop)
+            except wire.ShutdownError:
+                return
+            except (ConnectionError, OSError):
+                if self._stop.is_set():
+                    return
+                logger.info("client %s: frontend connection lost; "
+                            "reconnecting and resubmitting %d request(s)",
+                            self.name, len(self._pending))
+                with self._lock:
+                    self._sock = None
+                continue
+            if frame.msg_type == wire.MSG_SERVE_RESULT:
+                self._on_result(frame.payload)
+
+    # ----------------------------------------------------------- results
+    def _on_result(self, payload: bytes) -> None:
+        rid, status, tokens, error, latency = \
+            wire.decode_serve_result(payload)
+        with self._lock:
+            entry = self._pending.get(rid)
+        if entry is None:
+            return
+        fut, submit_payload = entry
+        if status == wire.SERVE_REJECTED:
+            fut.rejections += 1
+            if fut.rejections <= self.max_retries:
+                delay = min(0.05 * (2 ** min(fut.rejections, 6)), 2.0)
+                timer = threading.Timer(
+                    delay, lambda: self._send(wire.MSG_SERVE_SUBMIT,
+                                              submit_payload))
+                timer.daemon = True
+                timer.start()
+                return
+            error = error or "rejected; retry budget exhausted"
+            status = wire.SERVE_FAILED
+        with self._lock:
+            self._pending.pop(rid, None)
+        fut.tokens = tokens
+        fut.error = error
+        fut.latency = latency
+        fut._failed = status != wire.SERVE_OK
+        fut.done_t = time.monotonic()
+        fut._event.set()
+
+    # ------------------------------------------------------------ public
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               eos_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> ClientRequest:
+        rid = (request_id if request_id is not None
+               else f"{self.name}-{next(ServingClient._ids)}")
+        payload = wire.encode_serve_submit(rid, prompt, max_new_tokens,
+                                           eos_id)
+        fut = ClientRequest(rid)
+        with self._lock:
+            self._pending[rid] = (fut, payload)
+        # a failed send is fine: the reconnect replay will carry it
+        self._send(wire.MSG_SERVE_SUBMIT, payload)
+        return fut
+
+    def generate(self, prompt: List[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = 120.0) -> List[int]:
+        return self.submit(prompt, max_new_tokens,
+                           eos_id=eos_id).result(timeout)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._reader.join(timeout=5)

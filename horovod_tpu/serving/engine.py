@@ -1,0 +1,339 @@
+"""Serving engine: continuous-batching generation over a paged KV cache.
+
+One engine = one model replica. The engine owns the compiled prefill and
+decode programs, the :class:`~.kvcache.PagedKVCache`, and the
+:class:`~.scheduler.ContinuousBatchingScheduler`; :meth:`ServingEngine.step`
+is one iteration of the serving loop (admit → prefill → batched decode),
+and :meth:`start` runs it on a background thread so callers just
+:meth:`submit` and wait.
+
+Shape stability is the design invariant: prompts pad to the fixed
+``prompt_pad`` bucket, the decode batch pads to the fixed ``max_batch``,
+and the KV gather pads to the fixed ``max_context`` — so the engine
+compiles exactly TWO programs (one prefill, one decode) and, because
+``cached_attention`` masks padding to exactly 0.0 contribution at those
+fixed shapes, a request's generated tokens are bit-identical whether it
+decodes alone or batched with any mix of neighbors (asserted by
+tests/test_serving.py).
+
+Tensor parallelism rides the training shardings: pass ``mesh=`` (a
+``parallel/tensor.py`` dp×tp mesh) and the engine places the parameters
+with ``shard_params_tp`` before compiling — GSPMD inserts the row-parallel
+psums in the serving forward exactly as it does in the train step.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..metrics import instruments
+from .kvcache import PagedKVCache
+from .scheduler import (ACTIVE, DONE, FAILED, ContinuousBatchingScheduler,
+                        QueueFull, Request)
+
+__all__ = ["ServingConfig", "ServingEngine", "QueueFull", "Request"]
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else default
+
+
+class ServingConfig:
+    """Engine knobs (env defaults in parentheses; docs/knobs.md):
+
+    * ``block_size`` — KV-cache block granularity in tokens
+      (``HOROVOD_SERVING_BLOCK_SIZE``, 16).
+    * ``num_blocks`` — KV pool size in blocks
+      (``HOROVOD_SERVING_BLOCKS``, 256). Pool bytes per layer =
+      ``2 * num_blocks * block_size * d_model * dtype_bytes``.
+    * ``max_batch`` — decode-batch width, the max concurrent in-flight
+      requests (``HOROVOD_SERVING_MAX_BATCH``, 8).
+    * ``max_queue`` — bounded admission queue
+      (``HOROVOD_SERVING_MAX_QUEUE``, 128).
+    * ``max_context`` — per-request token window, prompt + generated
+      (``HOROVOD_SERVING_MAX_CONTEXT``, default the model's
+      ``max_seq_len``); also the fixed KV gather width.
+    * ``prefill_per_step`` — admissions per engine iteration (1).
+    * ``eos_id`` — generation stop token (None = length-only).
+    """
+
+    def __init__(self, block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 prefill_per_step: int = 1,
+                 eos_id: Optional[int] = None,
+                 cache_dtype=np.float32):
+        self.block_size = (block_size if block_size is not None
+                           else _env_int("HOROVOD_SERVING_BLOCK_SIZE", 16))
+        self.num_blocks = (num_blocks if num_blocks is not None
+                           else _env_int("HOROVOD_SERVING_BLOCKS", 256))
+        self.max_batch = (max_batch if max_batch is not None
+                          else _env_int("HOROVOD_SERVING_MAX_BATCH", 8))
+        self.max_queue = (max_queue if max_queue is not None
+                          else _env_int("HOROVOD_SERVING_MAX_QUEUE", 128))
+        self.max_context = max_context  # None: resolved from the model
+        self.prefill_per_step = int(prefill_per_step)
+        self.eos_id = eos_id
+        self.cache_dtype = cache_dtype
+
+
+class ServingEngine:
+    """Continuous-batching generation engine for one model replica."""
+
+    def __init__(self, model, params, config: Optional[ServingConfig] = None,
+                 mesh=None, tp_axis: str = "tp"):
+        import jax
+
+        self.model = model
+        self.config = cfg = config or ServingConfig()
+        if cfg.max_context is None:
+            cfg.max_context = int(model.max_seq_len)
+        if cfg.max_context > int(model.max_seq_len):
+            raise ValueError(
+                f"max_context {cfg.max_context} exceeds the model's "
+                f"max_seq_len {model.max_seq_len}")
+        # the fixed prompt bucket: prompts pad to one compiled width
+        self.prompt_pad = cfg.max_context
+        head_dim = model.d_model // model.num_heads
+        self.cache = PagedKVCache(
+            cfg.num_blocks, cfg.block_size, model.num_layers,
+            model.num_heads, head_dim, dtype=cfg.cache_dtype)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, max_batch=cfg.max_batch, max_queue=cfg.max_queue,
+            max_context=cfg.max_context,
+            prefill_per_step=cfg.prefill_per_step)
+        if mesh is not None:
+            from ..parallel.tensor import shard_params_tp
+
+            params = shard_params_tp(params, mesh, tp_axis)
+        self.params = params
+        self._jit_prefill = jax.jit(self._prefill_fn)
+        self._jit_decode = jax.jit(self._decode_fn)
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = None
+        self._tokens_out = 0
+        self._started_t = time.monotonic()
+
+    # ---------------------------------------------------- compiled kernels
+    def _empty_past(self, batch: int):
+        import jax.numpy as jnp
+
+        m = self.model
+        shape = (m.num_layers, batch, 0, m.num_heads,
+                 m.d_model // m.num_heads)
+        z = jnp.zeros(shape, jnp.float32)
+        return z, z, jnp.zeros((batch, 0), bool)
+
+    def _prefill_fn(self, params, tokens):
+        """tokens [1, prompt_pad] -> (logits [1, prompt_pad, V],
+        k, v [L, 1, prompt_pad, H, Dh])."""
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens,
+            kv_cache=self._empty_past(tokens.shape[0]))
+        return logits, nk, nv
+
+    def _decode_fn(self, params, tokens, past_k, past_v, past_mask, pos):
+        """tokens [max_batch, 1], past [L, max_batch, max_context, H, Dh],
+        pos [max_batch, 1] -> (next_token [max_batch], logits
+        [max_batch, V], k, v [L, max_batch, 1, H, Dh])."""
+        import jax.numpy as jnp
+
+        logits, (nk, nv) = self.model.apply(
+            {"params": params}, tokens, pos_offset=pos,
+            kv_cache=(past_k, past_v, past_mask))
+        last = logits[:, -1]
+        return jnp.argmax(last, axis=-1).astype(jnp.int32), last, nk, nv
+
+    # ----------------------------------------------------------- requests
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               request_id: Optional[str] = None,
+               eos_id: Optional[int] = None,
+               callback=None) -> Request:
+        """Queue one generation request; raises :class:`QueueFull` when the
+        admission queue is at capacity and ``ValueError`` when the request
+        cannot fit ``max_context``. The returned :class:`Request` is a
+        future: ``result(timeout)`` blocks for the generated tokens."""
+        if len(prompt) > self.prompt_pad:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the serving "
+                f"prompt bucket {self.prompt_pad}")
+        req = Request(prompt, max_new_tokens,
+                      eos_id=eos_id if eos_id is not None
+                      else self.config.eos_id,
+                      request_id=request_id, callback=callback)
+        self.scheduler.submit(req)
+        instruments.serving_requests().labels(status="submitted").inc()
+        self._observe_gauges()
+        self._wake.set()
+        return req
+
+    # ---------------------------------------------------------- main loop
+    def step(self) -> bool:
+        """One serving iteration: admit + prefill, then one batched decode
+        token for every in-flight request. Returns True if any work ran."""
+        import jax.numpy as jnp
+
+        prefills, decodes = self.scheduler.schedule()
+        did = False
+        for req in prefills:
+            t0 = time.monotonic()
+            self._prefill(req)
+            instruments.serving_phase_seconds().labels(phase="prefill") \
+                .observe(time.monotonic() - t0)
+            did = True
+        # requests that finished at prefill (max_new=1 / instant eos) left
+        # the active set inside _prefill; decode the remainder
+        decodes = [r for r in decodes if r.state == ACTIVE]
+        if decodes:
+            t0 = time.monotonic()
+            self._decode(decodes, jnp)
+            instruments.serving_phase_seconds().labels(phase="decode") \
+                .observe(time.monotonic() - t0)
+            did = True
+        if did:
+            self._observe_gauges()
+        return did
+
+    def _prefill(self, req: Request) -> None:
+        import jax.numpy as jnp
+
+        n = len(req.prompt)
+        toks = np.zeros((1, self.prompt_pad), np.int32)
+        toks[0, :n] = req.prompt
+        logits, nk, nv = self._jit_prefill(self.params, jnp.asarray(toks))
+        # the prompt's K/V enters the paged pool; pad positions discarded
+        self.cache.append(req.id, np.asarray(nk[:, 0, :n]),
+                          np.asarray(nv[:, 0, :n]))
+        first = int(np.asarray(jnp.argmax(logits[0, n - 1], axis=-1)))
+        req.first_token_t = time.monotonic()
+        req.output.append(first)
+        self._tokens_out += 1
+        instruments.serving_tokens().labels(phase="prefill").inc(n)
+        instruments.serving_tokens().labels(phase="decode").inc()
+        if self._finished(req, first):
+            self._complete(req)
+
+    def _decode(self, decodes: List[Request], jnp) -> None:
+        b = self.config.max_batch
+        ids = [r.id for r in decodes]
+        k, v, mask, lengths = self.cache.gather(
+            ids + [""] * (b - len(ids)), self.config.max_context)
+        toks = np.zeros((b, 1), np.int32)
+        pos = np.zeros((b, 1), np.int32)
+        for row, req in enumerate(decodes):
+            # invariant: the last generated token's K/V is not cached yet —
+            # it is this step's input, at position == cached length
+            toks[row, 0] = req.output[-1]
+            pos[row, 0] = lengths[row]
+        next_tok, _, nk, nv = self._jit_decode(
+            self.params, jnp.asarray(toks), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(mask), jnp.asarray(pos))
+        next_tok = np.asarray(next_tok)
+        nk = np.asarray(nk)
+        nv = np.asarray(nv)
+        instruments.serving_decode_batch().observe(len(decodes))
+        for row, req in enumerate(decodes):
+            self.cache.append(req.id, nk[:, row], nv[:, row])
+            tok = int(next_tok[row])
+            req.output.append(tok)
+            self._tokens_out += 1
+            instruments.serving_tokens().labels(phase="decode").inc()
+            if self._finished(req, tok):
+                self._complete(req)
+
+    def _finished(self, req: Request, tok: int) -> bool:
+        if req.eos_id is not None and tok == req.eos_id:
+            return True
+        return len(req.output) >= req.max_new_tokens
+
+    def _complete(self, req: Request) -> None:
+        self.scheduler.complete(req, DONE)
+        lat = req.latency()
+        instruments.serving_requests().labels(status="completed").inc()
+        instruments.serving_request_latency().labels(stage="total") \
+            .observe(lat)
+        if req.first_token_t is not None:
+            instruments.serving_request_latency().labels(
+                stage="first_token").observe(
+                req.first_token_t - req.submitted_t)
+
+    def _observe_gauges(self) -> None:
+        instruments.serving_queue_depth().set(self.scheduler.queue_depth())
+        instruments.serving_active_requests().set(
+            self.scheduler.active_count())
+        instruments.serving_kv_occupancy().set(self.cache.occupancy())
+        instruments.serving_kv_tokens().set(self.cache.used_tokens)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ServingEngine":
+        """Run the serving loop on a background thread."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="hvd-serving-engine",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                did = self.step()
+            except Exception as exc:  # a broken step fails its requests,
+                logger.exception("serving engine step failed")  # not the loop
+                for req in self.scheduler.drain(f"engine step failed: {exc}"):
+                    instruments.serving_requests().labels(
+                        status="failed").inc()
+                did = False
+            if not did:
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    def stop(self, drain_error: str = "engine stopped") -> None:
+        self._stop.set()
+        self._wake.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+        for req in self.scheduler.drain(drain_error):
+            instruments.serving_requests().labels(status="failed").inc()
+
+    def run_until_idle(self, timeout: float = 60.0) -> None:
+        """Drive the loop inline (no background thread) until every
+        submitted request completes — the deterministic mode tests and the
+        bit-parity assertions use."""
+        deadline = time.monotonic() + timeout
+        while self.scheduler.has_work():
+            if time.monotonic() > deadline:
+                raise TimeoutError("serving engine did not go idle")
+            self.step()
+
+    # ------------------------------------------------------------- status
+    def stats(self) -> dict:
+        s = self.scheduler
+        return {
+            "queue_depth": s.queue_depth(),
+            "active": s.active_count(),
+            "completed": s.completed,
+            "failed": s.failed,
+            "rejected": s.rejected,
+            "kv_blocks_used": self.cache.used_blocks,
+            "kv_blocks_total": self.cache.num_blocks,
+            "kv_occupancy": round(self.cache.occupancy(), 4),
+            "kv_tokens": self.cache.used_tokens,
+            "tokens_generated": self._tokens_out,
+            "uptime_s": round(time.monotonic() - self._started_t, 3),
+        }

@@ -1,0 +1,207 @@
+"""Paged KV cache for inference serving (docs/inference.md).
+
+The cache is a fixed pool of ``num_blocks`` blocks of ``block_size`` token
+slots each, per transformer layer — the vLLM paged-attention idea scaled to
+this repo's correctness-first CPU/TPU-host serving loop: requests own
+*block tables* (lists of pool block indices), tokens append into the last
+block until it fills, and freeing a request returns whole blocks to the
+free list. Fragmentation is therefore bounded at one partial block per
+request, and admission control can reason in whole blocks.
+
+Compute-side, :meth:`PagedKVCache.gather` flattens each request's blocks
+into one padded ``[num_layers, B, capacity, H, Dh]`` window plus a slot
+validity mask — the shape-stable operand ``models/transformer.py``'s
+``cached_attention`` masks exactly (padding contributes exactly 0.0), which
+is what makes batched decode bit-identical to sequential decode.
+
+Occupancy accounting is two-level, matching the ``hvd_serving_kv_*``
+gauges: *blocks* (allocated out of the pool — the admission currency) and
+*tokens* (slots actually written — the live-context payload).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class KVCacheFull(RuntimeError):
+    """The block pool cannot satisfy an allocation (admission control
+    should have prevented this — seeing it means a reservation bug)."""
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` slots (ceil division, min 1 so a
+    zero-token reservation still owns an append target)."""
+    return max(1, -(-int(tokens) // int(block_size)))
+
+
+class BlockAllocator:
+    """Free-list allocator over a fixed pool of block ids."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise KVCacheFull(
+                f"requested {n} KV blocks with {len(self._free)} free "
+                f"(pool {self.num_blocks})")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"freeing unknown block id {b}")
+        self._free.extend(blocks)
+        if len(self._free) > self.num_blocks:
+            raise ValueError("double free: free list exceeds pool size")
+
+
+class PagedKVCache:
+    """Block-pooled per-layer K/V storage with per-request block tables.
+
+    ``shape``: (num_layers, num_heads, head_dim). The pool arrays live on
+    the host (numpy): the serving loop writes decode-step K/V back from
+    device and gathers padded windows per step — the layout a future
+    device-resident paged-attention kernel would consume directly.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 num_layers: int, num_heads: int, head_dim: int,
+                 dtype=np.float32):
+        self.block_size = int(block_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (self.num_layers, self.allocator.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k_pool = np.zeros(shape, dtype)
+        self.v_pool = np.zeros(shape, dtype)
+        # request id -> (block table, tokens written)
+        self._tables: Dict[str, Tuple[List[int], int]] = {}
+
+    # ---------------------------------------------------------- accounting
+    @property
+    def num_blocks(self) -> int:
+        return self.allocator.num_blocks
+
+    @property
+    def used_blocks(self) -> int:
+        return self.allocator.used_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    @property
+    def used_tokens(self) -> int:
+        return sum(used for _, used in self._tables.values())
+
+    def occupancy(self) -> float:
+        """Fraction of the pool's blocks allocated (the admission-facing
+        number the ``hvd_serving_kv_occupancy`` gauge exports)."""
+        return self.allocator.used_blocks / max(1, self.num_blocks)
+
+    def length(self, request_id: str) -> int:
+        return self._tables[request_id][1]
+
+    def block_table(self, request_id: str) -> List[int]:
+        return list(self._tables[request_id][0])
+
+    def requests(self) -> List[str]:
+        return sorted(self._tables)
+
+    # ---------------------------------------------------------- lifecycle
+    def allocate(self, request_id: str, max_tokens: int) -> int:
+        """Reserve the whole block budget for a request up front
+        (prompt + max generated tokens). Upfront reservation is the
+        admission-control contract: an admitted request can NEVER stall
+        mid-decode on a full pool. Returns the block count."""
+        if request_id in self._tables:
+            raise ValueError(f"request {request_id!r} already allocated")
+        n = blocks_for_tokens(max_tokens, self.block_size)
+        blocks = self.allocator.allocate(n)
+        self._tables[request_id] = (blocks, 0)
+        return n
+
+    def free(self, request_id: str) -> int:
+        """Release a finished request's blocks; returns the count."""
+        blocks, _ = self._tables.pop(request_id)
+        self.allocator.free(blocks)
+        return len(blocks)
+
+    # ------------------------------------------------------------- writes
+    def append(self, request_id: str, k: np.ndarray, v: np.ndarray) -> None:
+        """Write new-token K/V for one request. ``k``/``v``:
+        [num_layers, T, H, Dh] (T tokens, typically the prompt at prefill
+        and 1 at decode)."""
+        blocks, used = self._tables[request_id]
+        t = k.shape[1]
+        if used + t > len(blocks) * self.block_size:
+            raise KVCacheFull(
+                f"request {request_id!r}: {used}+{t} tokens exceeds its "
+                f"{len(blocks)}-block reservation")
+        for i in range(t):
+            slot = used + i
+            blk = blocks[slot // self.block_size]
+            off = slot % self.block_size
+            self.k_pool[:, blk, off] = k[:, i]
+            self.v_pool[:, blk, off] = v[:, i]
+        self._tables[request_id] = (blocks, used + t)
+
+    # ------------------------------------------------------------- reads
+    def gather(self, request_ids: List[str], capacity: int):
+        """Padded decode operand for a batch of requests.
+
+        Returns ``(k, v, mask, lengths)``: ``k``/``v``
+        [num_layers, B, capacity, H, Dh], ``mask`` bool [B, capacity]
+        (True = slot holds a real token), ``lengths`` int32 [B]. Request
+        ids absent from the cache (batch-padding slots) yield all-False
+        rows. ``capacity`` is FIXED by the engine so every decode step
+        compiles to one program and stays shape-stable (the bit-parity
+        precondition)."""
+        b = len(request_ids)
+        shape = (self.num_layers, b, int(capacity), self.num_heads,
+                 self.head_dim)
+        k = np.zeros(shape, self.k_pool.dtype)
+        v = np.zeros(shape, self.v_pool.dtype)
+        mask = np.zeros((b, int(capacity)), bool)
+        lengths = np.zeros((b,), np.int32)
+        for row, rid in enumerate(request_ids):
+            entry = self._tables.get(rid)
+            if entry is None:
+                continue
+            blocks, used = entry
+            if used > capacity:
+                raise ValueError(
+                    f"request {rid!r} holds {used} tokens > gather "
+                    f"capacity {capacity}")
+            if used:
+                nb = blocks_for_tokens(used, self.block_size)
+                flat = self.k_pool[:, blocks[:nb]].reshape(
+                    self.num_layers, nb * self.block_size,
+                    self.num_heads, self.head_dim)
+                k[:, row, :used] = flat[:, :used]
+                flat = self.v_pool[:, blocks[:nb]].reshape(
+                    self.num_layers, nb * self.block_size,
+                    self.num_heads, self.head_dim)
+                v[:, row, :used] = flat[:, :used]
+            mask[row, :used] = True
+            lengths[row] = used
+        return k, v, mask, lengths

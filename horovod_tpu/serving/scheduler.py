@@ -1,0 +1,224 @@
+"""Continuous-batching request scheduler (docs/inference.md).
+
+The scheduling model is the orca/vLLM-style iteration-level loop: every
+engine step admits at most ``prefill_per_step`` queued requests (each
+prefill is a full-prompt forward) and then decodes ONE token for EVERY
+in-flight request as a single batched forward — new requests join the
+decode batch at the next step instead of waiting for a full batch to
+drain, and short requests leave without stalling long ones.
+
+Admission control is reservation-based: a request is admitted only when
+its whole KV budget (prompt + max_new_tokens, rounded up to blocks) fits
+the free pool AND a decode-batch slot is free. Admitted work therefore
+never deadlocks on cache space mid-flight; everything else waits in a
+bounded FCFS queue, and a full queue rejects at submit time (the
+backpressure signal the serving frontend turns into a retryable
+``SERVE_REJECTED``).
+
+Fairness is FCFS at admission plus every-request-every-step at decode:
+there is no priority lane, so the only reordering possible is a large
+request waiting for blocks while smaller later arrivals fit — bounded by
+``strict_fifo`` (default True: the queue head blocks admission until it
+fits, trading utilization for no-starvation).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .kvcache import PagedKVCache, blocks_for_tokens
+
+# request lifecycle states
+QUEUED = "queued"
+ACTIVE = "active"      # prefilled; in the decode batch
+DONE = "done"
+FAILED = "failed"
+
+
+class QueueFull(RuntimeError):
+    """Admission control rejected the submit: the bounded request queue is
+    at capacity. Retryable — back off and resubmit."""
+
+
+class Request:
+    """One in-flight generation request."""
+
+    _ids = itertools.count()
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "state",
+                 "output", "error", "submitted_t", "admitted_t",
+                 "first_token_t", "done_t", "callback", "_done_event")
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None, request_id: Optional[str]
+                 = None, callback: Optional[Callable] = None):
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.id = (request_id if request_id is not None
+                   else "req-%d" % next(Request._ids))
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.state = QUEUED
+        self.output: List[int] = []      # generated tokens (prompt excluded)
+        self.error = ""
+        self.submitted_t = time.monotonic()
+        self.admitted_t = None
+        self.first_token_t = None
+        self.done_t = None
+        self.callback = callback
+        self._done_event = threading.Event()
+
+    # ------------------------------------------------------------- result
+    def done(self) -> bool:
+        return self._done_event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done_event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done_event.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done")
+        if self.state == FAILED:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return list(self.output)
+
+    def latency(self) -> Optional[float]:
+        if self.done_t is None:
+            return None
+        return self.done_t - self.submitted_t
+
+    def finish(self, state: str, error: str = "") -> None:
+        self.state = state
+        self.error = error
+        self.done_t = time.monotonic()
+        self._done_event.set()
+        if self.callback is not None:
+            self.callback(self)
+
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+
+class ContinuousBatchingScheduler:
+    """Admission + iteration-level batching over a :class:`PagedKVCache`.
+
+    Thread-safe: the serving frontend submits from connection threads
+    while the engine thread runs :meth:`schedule` / completion paths.
+    """
+
+    def __init__(self, cache: PagedKVCache, max_batch: int = 8,
+                 max_queue: int = 128, max_context: Optional[int] = None,
+                 prefill_per_step: int = 1, strict_fifo: bool = True):
+        self.cache = cache
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.max_context = (int(max_context) if max_context is not None
+                            else cache.num_blocks * cache.block_size)
+        self.prefill_per_step = max(1, int(prefill_per_step))
+        self.strict_fifo = bool(strict_fifo)
+        self.lock = threading.RLock()
+        self.waiting: List[Request] = []
+        self.active: List[Request] = []
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+
+    # ---------------------------------------------------------- admission
+    def submit(self, request: Request) -> Request:
+        """Queue a request, or raise :class:`QueueFull` (bounded queue) /
+        ``ValueError`` (oversized for the configured context window)."""
+        need = len(request.prompt) + request.max_new_tokens
+        if need > self.max_context:
+            raise ValueError(
+                f"request {request.id}: prompt {len(request.prompt)} + "
+                f"max_new {request.max_new_tokens} exceeds the "
+                f"max_context window {self.max_context}")
+        with self.lock:
+            if len(self.waiting) >= self.max_queue:
+                self.rejected += 1
+                raise QueueFull(
+                    f"request queue at capacity ({self.max_queue}); "
+                    "retry with backoff")
+            self.waiting.append(request)
+        return request
+
+    def _admissible(self, request: Request) -> bool:
+        return (len(self.active) < self.max_batch
+                and self.cache.allocator.can_allocate(
+                    blocks_for_tokens(request.total_tokens(),
+                                      self.cache.block_size)))
+
+    # --------------------------------------------------------- scheduling
+    def schedule(self):
+        """One iteration's work: ``(prefills, decodes)``.
+
+        ``prefills``: newly admitted requests (KV blocks now reserved,
+        state ACTIVE) for the engine to prefill this step, at most
+        ``prefill_per_step``. ``decodes``: every request already active
+        BEFORE this call — they get one decode token this step. Prefilled
+        requests join the decode batch at the NEXT step (their first token
+        comes out of the prefill forward itself)."""
+        with self.lock:
+            decodes = list(self.active)
+            prefills: List[Request] = []
+            i = 0
+            while (len(prefills) < self.prefill_per_step
+                   and i < len(self.waiting)):
+                req = self.waiting[i]
+                if self._admissible(req):
+                    self.waiting.pop(i)
+                    self.cache.allocate(req.id, req.total_tokens())
+                    req.admitted_t = time.monotonic()
+                    req.state = ACTIVE
+                    self.active.append(req)
+                    prefills.append(req)
+                elif self.strict_fifo:
+                    break  # the queue head waits; nobody overtakes it
+                else:
+                    i += 1
+            return prefills, decodes
+
+    # --------------------------------------------------------- completion
+    def complete(self, request: Request, state: str = DONE,
+                 error: str = "") -> None:
+        """Retire a request: free its KV blocks, update counters, fire its
+        callback/event."""
+        with self.lock:
+            if request in self.active:
+                self.active.remove(request)
+            if request.id in self.cache.requests():
+                self.cache.free(request.id)
+            if state == DONE:
+                self.completed += 1
+            else:
+                self.failed += 1
+        request.finish(state, error)
+
+    # ------------------------------------------------------------- status
+    def queue_depth(self) -> int:
+        with self.lock:
+            return len(self.waiting)
+
+    def active_count(self) -> int:
+        with self.lock:
+            return len(self.active)
+
+    def has_work(self) -> bool:
+        with self.lock:
+            return bool(self.waiting or self.active)
+
+    def drain(self, error: str) -> List[Request]:
+        """Fail everything queued or active (engine shutdown); returns the
+        drained requests."""
+        with self.lock:
+            doomed = self.waiting + self.active
+            self.waiting = []
+        for req in doomed:
+            self.complete(req, FAILED, error)
+        return doomed

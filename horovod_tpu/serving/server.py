@@ -1,0 +1,394 @@
+"""Serving frontend: request routing over the hardened control plane.
+
+The frontend is the serving pod's coordinator-analog: one TCP listener
+speaking the ``runtime/wire.py`` framing (CRC32 + optional HMAC, bounded
+frames) to two kinds of peers that both introduce themselves with
+``MSG_SERVE_HELLO`` — *workers* (model replicas running a
+:class:`~.engine.ServingEngine`, ``serving/worker.py``) and *clients*
+(``serving/client.py``). Clients submit ``MSG_SERVE_SUBMIT`` frames; the
+dispatcher routes each to the least-loaded live worker and relays the
+worker's ``MSG_SERVE_RESULT`` back to whichever client owns the request.
+
+Fault tolerance is the PR-2/PR-4 recipe applied to requests instead of
+gradients:
+
+* **Liveness** — workers heartbeat (``MSG_HEARTBEAT``) every
+  ``HOROVOD_HEARTBEAT_INTERVAL``; a worker silent past the grace window
+  (or whose socket drops) is declared dead.
+* **Elastic re-admission** — a dead worker's in-flight requests do NOT
+  error: they re-enter the dispatch queue and land on surviving replicas
+  (counted by ``hvd_serving_requests_total{status="readmitted"}``). A
+  rejoining worker just HELLOs again and starts taking load.
+* **Exactly-once for clients** — request ids are client-chosen; the
+  frontend keeps an LRU of finished results and answers duplicate submits
+  from it, so a client that reconnects and blindly resubmits everything
+  unresolved (the ``client.py`` recovery move) never double-generates.
+* **Observability** — worker ``MSG_METRICS`` reports merge into the
+  frontend's ``/metrics`` endpoint via the PR-3 dead-rank ledger
+  (``store_report``/``drop_report``), so pod-level serving dashboards
+  survive replica churn.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import (drop_report, instruments, maybe_start_server,
+                       readmit_report, store_report)
+from ..runtime import wire
+from ..runtime.coordinator import MSG_HEARTBEAT, MSG_METRICS
+
+logger = logging.getLogger("horovod_tpu")
+
+#: completed results kept for duplicate-submit answers
+RESULT_CACHE = 4096
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else default
+
+
+class _Peer:
+    """One connected socket (worker or client) with a write lock — results
+    and relays are sent from multiple threads."""
+
+    def __init__(self, sock: socket.socket, name: str):
+        self.sock = sock
+        self.name = name
+        self.send_lock = threading.Lock()
+        self.alive = True
+        self.last_seen = time.monotonic()
+
+    def send(self, secret: str, msg_type: int, seq: int,
+             payload: bytes) -> bool:
+        try:
+            with self.send_lock:
+                wire.send_frame(self.sock, secret, msg_type, seq, -1,
+                                payload)
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Worker(_Peer):
+    def __init__(self, sock: socket.socket, name: str, capacity: int):
+        super().__init__(sock, name)
+        self.capacity = max(1, capacity)
+        self.inflight = 0  # guarded by the frontend lock
+        self.metrics_rank: Optional[int] = None
+
+
+class _Pending:
+    """One request the frontend has accepted but not answered."""
+
+    __slots__ = ("request_id", "payload", "client", "worker", "submitted_t")
+
+    def __init__(self, request_id: str, payload: bytes,
+                 client: Optional[_Peer]):
+        self.request_id = request_id
+        self.payload = payload           # the SUBMIT payload, relay-ready
+        self.client = client
+        self.worker: Optional[str] = None
+        self.submitted_t = time.monotonic()
+
+
+class ServingFrontend:
+    """Accepts workers and clients; routes requests; survives worker loss.
+
+    ``max_backlog`` bounds requests waiting for worker capacity — beyond
+    it, submits answer ``SERVE_REJECTED`` (clients back off and retry).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None, max_backlog: int = 1024,
+                 heartbeat_grace: Optional[float] = None):
+        self.secret = (secret if secret is not None
+                       else os.environ.get("HVD_SECRET", ""))
+        hb = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
+        self.heartbeat_grace = (heartbeat_grace if heartbeat_grace
+                                is not None else 3.0 * hb)
+        self.max_backlog = int(max_backlog)
+        self._stop = threading.Event()
+        self.lock = threading.RLock()
+        self.workers: Dict[str, _Worker] = {}
+        self.pending: Dict[str, _Pending] = {}
+        self.backlog: collections.deque = collections.deque()  # request ids
+        self.results: "collections.OrderedDict[str, Tuple[int, List[int], str, float]]" = \
+            collections.OrderedDict()
+        self.readmitted = 0
+        self.completed = 0
+        self._seq = 0
+        self._threads: List[threading.Thread] = []
+        self.listener = socket.create_server((host, port))
+        self.listener.settimeout(0.2)
+        self.addr = self.listener.getsockname()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "ServingFrontend":
+        for fn, name in ((self._accept_loop, "hvd-serve-accept"),
+                         (self._liveness_loop, "hvd-serve-liveness")):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        maybe_start_server()
+        logger.info("serving frontend listening on %s:%d", *self.addr[:2])
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self.lock:
+            peers = list(self.workers.values())
+        for p in peers:
+            p.close()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _next_seq(self) -> int:
+        with self.lock:
+            self._seq += 1
+            return self._seq
+
+    # ------------------------------------------------------------- accept
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self.listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            sock.settimeout(1.0)
+            threading.Thread(target=self._handshake, args=(sock,),
+                             name="hvd-serve-peer", daemon=True).start()
+
+    def _handshake(self, sock: socket.socket) -> None:
+        try:
+            frame = wire.recv_frame(sock, self.secret, self._stop)
+            if frame.msg_type != wire.MSG_SERVE_HELLO:
+                raise wire.FrameError(
+                    f"expected SERVE_HELLO, got type {frame.msg_type}")
+            role, name, capacity = wire.decode_serve_hello(frame.payload)
+        except (ConnectionError, OSError) as exc:
+            logger.info("serving handshake failed: %s", exc)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        if role == wire.SERVE_ROLE_WORKER:
+            self._run_worker(_Worker(sock, name, capacity))
+        else:
+            self._run_client(_Peer(sock, name))
+
+    # ------------------------------------------------------------ workers
+    def _run_worker(self, w: _Worker) -> None:
+        with self.lock:
+            old = self.workers.get(w.name)
+            if old is not None:
+                old.close()
+            self.workers[w.name] = w
+        logger.info("serving worker %r joined (capacity %d)", w.name,
+                    w.capacity)
+        self._drain_backlog()
+        try:
+            while not self._stop.is_set() and w.alive:
+                frame = wire.recv_frame(w.sock, self.secret, self._stop)
+                w.last_seen = time.monotonic()
+                if frame.msg_type == wire.MSG_SERVE_RESULT:
+                    self._on_result(w, frame.payload)
+                elif frame.msg_type == MSG_METRICS:
+                    rank, ts, snap = wire.decode_metrics_report(
+                        frame.payload)
+                    w.metrics_rank = rank
+                    # a frame from a live connection proves the rank is
+                    # back — lift any dead-rank ledger entry first
+                    readmit_report(rank)
+                    store_report(rank, snap, ts)
+                elif frame.msg_type == MSG_HEARTBEAT:
+                    pass  # last_seen bump above is the whole point
+        except (ConnectionError, OSError) as exc:
+            if not self._stop.is_set():
+                logger.warning("serving worker %r lost: %s", w.name, exc)
+        finally:
+            self._drop_worker(w)
+
+    def _drop_worker(self, w: _Worker) -> None:
+        w.close()
+        if w.metrics_rank is not None:
+            drop_report(w.metrics_rank)
+        with self.lock:
+            if self.workers.get(w.name) is w:
+                del self.workers[w.name]
+            orphans = [p for p in self.pending.values()
+                       if p.worker == w.name]
+            for p in orphans:
+                p.worker = None
+                self.backlog.appendleft(p.request_id)
+            self.readmitted += len(orphans)
+        for _ in orphans:
+            instruments.serving_requests().labels(status="readmitted").inc()
+        if orphans:
+            logger.warning(
+                "re-admitting %d in-flight request(s) from dead worker %r",
+                len(orphans), w.name)
+        self._drain_backlog()
+
+    def _liveness_loop(self) -> None:
+        while not self._stop.wait(min(1.0, self.heartbeat_grace / 3)):
+            now = time.monotonic()
+            with self.lock:
+                stale = [w for w in self.workers.values()
+                         if now - w.last_seen > self.heartbeat_grace]
+            for w in stale:
+                logger.warning(
+                    "serving worker %r silent for %.1fs — declaring dead",
+                    w.name, now - w.last_seen)
+                w.close()  # the reader thread unblocks and drops it
+
+    # ------------------------------------------------------------ clients
+    def _run_client(self, c: _Peer) -> None:
+        logger.info("serving client %r connected", c.name)
+        try:
+            while not self._stop.is_set() and c.alive:
+                frame = wire.recv_frame(c.sock, self.secret, self._stop)
+                if frame.msg_type == wire.MSG_SERVE_SUBMIT:
+                    self._on_submit(c, frame.payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            c.close()
+            with self.lock:
+                # keep pending requests running; results for a vanished
+                # client stay in the dedupe cache for its reconnect
+                for p in self.pending.values():
+                    if p.client is c:
+                        p.client = None
+
+    def _on_submit(self, c: _Peer, payload: bytes) -> None:
+        request_id, _, _, _ = wire.decode_serve_submit(payload)
+        with self.lock:
+            done = self.results.get(request_id)
+            if done is not None:  # duplicate of a finished request
+                status, tokens, error, latency = done
+                c.send(self.secret, wire.MSG_SERVE_RESULT, self._seq,
+                       wire.encode_serve_result(request_id, status, tokens,
+                                                error, latency))
+                return
+            p = self.pending.get(request_id)
+            if p is not None:     # duplicate of an in-flight request —
+                p.client = c      # re-own it (client reconnected)
+                return
+            if len(self.pending) >= self.max_backlog:
+                instruments.serving_requests().labels(
+                    status="rejected").inc()
+                c.send(self.secret, wire.MSG_SERVE_RESULT, self._seq,
+                       wire.encode_serve_result(
+                           request_id, wire.SERVE_REJECTED, [],
+                           "frontend backlog full; retry with backoff"))
+                return
+            p = _Pending(request_id, payload, c)
+            self.pending[request_id] = p
+            self.backlog.append(request_id)
+            instruments.serving_requests().labels(status="submitted").inc()
+        self._drain_backlog()
+
+    # ---------------------------------------------------------- dispatch
+    def _drain_backlog(self) -> None:
+        """Assign queued requests to the least-loaded live workers."""
+        while True:
+            with self.lock:
+                if not self.backlog:
+                    return
+                candidates = [w for w in self.workers.values()
+                              if w.alive and w.inflight < w.capacity]
+                if not candidates:
+                    instruments.serving_queue_depth().set(len(self.backlog))
+                    return
+                w = min(candidates, key=lambda x: x.inflight / x.capacity)
+                rid = self.backlog.popleft()
+                p = self.pending.get(rid)
+                if p is None:
+                    continue
+                p.worker = w.name
+                w.inflight += 1
+                instruments.serving_queue_depth().set(len(self.backlog))
+            if not w.send(self.secret, wire.MSG_SERVE_SUBMIT,
+                          self._next_seq(), p.payload):
+                # send failed: the reader thread will reap the worker and
+                # re-admit; nothing to do here
+                logger.warning("dispatch to worker %r failed", w.name)
+
+    def _on_result(self, w: _Worker, payload: bytes) -> None:
+        request_id, status, tokens, error, latency = \
+            wire.decode_serve_result(payload)
+        with self.lock:
+            p = self.pending.pop(request_id, None)
+            if p is None:
+                return  # duplicate result (worker resend) — already done
+            if w.inflight > 0:
+                w.inflight -= 1
+            if status == wire.SERVE_REJECTED:
+                # worker-side backpressure: the request goes back in line
+                # rather than bouncing to the client
+                p.worker = None
+                self.pending[request_id] = p
+                self.backlog.append(request_id)
+                self.readmitted += 1
+            else:
+                self.results[request_id] = (status, tokens, error, latency)
+                while len(self.results) > RESULT_CACHE:
+                    self.results.popitem(last=False)
+                self.completed += 1
+                client = p.client
+        if status == wire.SERVE_REJECTED:
+            instruments.serving_requests().labels(status="readmitted").inc()
+            self._drain_backlog()
+            return
+        total = time.monotonic() - p.submitted_t
+        instruments.serving_request_latency().labels(stage="frontend") \
+            .observe(total)
+        if client is not None:
+            client.send(self.secret, wire.MSG_SERVE_RESULT,
+                        self._next_seq(),
+                        wire.encode_serve_result(request_id, status, tokens,
+                                                 error, total))
+        self._drain_backlog()
+
+    # ------------------------------------------------------------- status
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "workers": sorted(self.workers),
+                "pending": len(self.pending),
+                "backlog": len(self.backlog),
+                "completed": self.completed,
+                "readmitted": self.readmitted,
+            }
+
+    def wait_for_workers(self, n: int, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if len(self.workers) >= n:
+                    return
+            time.sleep(0.05)
+        raise TimeoutError(f"fewer than {n} serving workers joined")

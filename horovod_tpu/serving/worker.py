@@ -1,0 +1,279 @@
+"""Serving worker: one model replica dialing the frontend.
+
+A worker owns a :class:`~.engine.ServingEngine` and a single control-plane
+connection to the frontend (``serving/server.py``). The protocol from the
+worker's side:
+
+1. connect, ``MSG_SERVE_HELLO(role=worker, name, capacity=max_batch)``;
+2. ``MSG_SERVE_SUBMIT`` frames feed :meth:`ServingEngine.submit`; each
+   request's completion callback ships ``MSG_SERVE_RESULT`` back;
+3. heartbeats (``MSG_HEARTBEAT``) every ``HOROVOD_HEARTBEAT_INTERVAL`` and
+   ``MSG_METRICS`` registry snapshots every ``HOROVOD_METRICS_INTERVAL``
+   keep the frontend's liveness and pod ``/metrics`` views current.
+
+Recovery mirrors the PR-4 worker-side control plane: a dropped connection
+triggers reconnect-with-backoff and a fresh HELLO; in-flight generations
+keep running through the outage, their results park in an unsent list and
+replay after reconnect (the frontend dedupes by request id, so replaying
+a result the frontend already re-admitted elsewhere is harmless).
+
+``python -m horovod_tpu.serving.worker --addr HOST:PORT`` is the replica
+entry point the CI pod-smoke and the worker-kill tests spawn; every
+replica builds the identical deterministic tiny model from a fixed PRNG
+seed, standing in for "every replica restored the same checkpoint".
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import local_snapshot
+from ..runtime import wire
+from ..runtime.coordinator import MSG_HEARTBEAT, MSG_METRICS
+from .engine import ServingConfig, ServingEngine
+from .scheduler import DONE, QueueFull, Request
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else default
+
+
+class ServingWorker:
+    """Runs one engine replica against a frontend address."""
+
+    def __init__(self, host: str, port: int, engine: ServingEngine,
+                 name: str = "worker-0", rank: int = 0,
+                 secret: Optional[str] = None):
+        self.host = host
+        self.port = int(port)
+        self.engine = engine
+        self.name = name
+        self.rank = int(rank)
+        self.secret = (secret if secret is not None
+                       else os.environ.get("HVD_SECRET", ""))
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._seq = 0
+        # request id -> encoded RESULT payload not yet delivered (either
+        # the connection was down at completion, or the send failed)
+        self._unsent: Dict[str, bytes] = {}
+        self._unsent_lock = threading.Lock()
+        self._seen: Dict[str, bool] = {}  # dedupe of in-flight resubmits
+
+    # -------------------------------------------------------------- wire
+    def _send(self, msg_type: int, payload: bytes) -> bool:
+        sock = self._sock
+        if sock is None:
+            return False
+        try:
+            with self._send_lock:
+                self._seq += 1
+                wire.send_frame(sock, self.secret, msg_type, self._seq,
+                                self.rank, payload)
+            return True
+        except OSError:
+            return False
+
+    def _connect(self) -> socket.socket:
+        """Dial + HELLO with capped exponential backoff, forever (the
+        frontend may be restarting — serving workers outlive it)."""
+        delay = 0.1
+        while not self._stop.is_set():
+            try:
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=5.0)
+                sock.settimeout(1.0)
+                wire.send_frame(
+                    sock, self.secret, wire.MSG_SERVE_HELLO, 0, self.rank,
+                    wire.encode_serve_hello(wire.SERVE_ROLE_WORKER,
+                                            self.name,
+                                            self.engine.config.max_batch))
+                return sock
+            except OSError as exc:
+                logger.info("worker %s: frontend unreachable (%s); "
+                            "retrying in %.1fs", self.name, exc, delay)
+                if self._stop.wait(delay):
+                    break
+                delay = min(delay * 2, 5.0)
+        raise wire.ShutdownError("serving worker stopped")
+
+    # ---------------------------------------------------------- requests
+    def _on_submit(self, payload: bytes) -> None:
+        rid, prompt, max_new, eos = wire.decode_serve_submit(payload)
+        with self._unsent_lock:
+            if rid in self._seen:
+                # duplicate dispatch (frontend resend race): the original
+                # submission's callback / unsent replay will answer
+                return
+            self._seen[rid] = True
+            if len(self._seen) > 8192:
+                for k in list(self._seen)[:4096]:
+                    del self._seen[k]
+        try:
+            self.engine.submit(prompt, max_new, request_id=rid,
+                               eos_id=eos, callback=self._on_done)
+        except QueueFull:
+            self._queue_result(rid, wire.encode_serve_result(
+                rid, wire.SERVE_REJECTED, [],
+                "replica queue full"))
+        except ValueError as exc:
+            self._queue_result(rid, wire.encode_serve_result(
+                rid, wire.SERVE_FAILED, [], str(exc)))
+
+    def _on_done(self, req: Request) -> None:
+        if req.state == DONE:
+            payload = wire.encode_serve_result(
+                req.id, wire.SERVE_OK, req.output, "",
+                req.latency() or 0.0)
+        else:
+            payload = wire.encode_serve_result(
+                req.id, wire.SERVE_FAILED, [], req.error)
+        self._queue_result(req.id, payload)
+
+    def _queue_result(self, rid: str, payload: bytes) -> None:
+        with self._unsent_lock:
+            self._unsent[rid] = payload
+        self._flush_results()
+
+    def _flush_results(self) -> None:
+        with self._unsent_lock:
+            items: List[Tuple[str, bytes]] = list(self._unsent.items())
+        for rid, payload in items:
+            if not self._send(wire.MSG_SERVE_RESULT, payload):
+                return  # connection down; replay after reconnect
+            with self._unsent_lock:
+                self._unsent.pop(rid, None)
+
+    # ---------------------------------------------------------- heartbeat
+    def _heartbeat_loop(self) -> None:
+        hb = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
+        metrics_every = _env_float("HOROVOD_METRICS_INTERVAL", 10.0)
+        last_metrics = 0.0
+        while not self._stop.wait(min(hb, 1.0)):
+            self._send(MSG_HEARTBEAT, b"")
+            now = time.monotonic()
+            if now - last_metrics >= metrics_every:
+                last_metrics = now
+                self._send(MSG_METRICS, wire.encode_metrics_report(
+                    self.rank, time.time(), local_snapshot()))
+
+    # ----------------------------------------------------------- run loop
+    def run(self) -> None:
+        """Serve until :meth:`stop`: engine loop + heartbeats in the
+        background, this thread reading frontend frames (reconnecting on
+        every connection failure)."""
+        self.engine.start()
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              name="hvd-serve-worker-hb", daemon=True)
+        hb.start()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._sock = self._connect()
+                except wire.ShutdownError:
+                    return
+                logger.info("worker %s connected to frontend", self.name)
+                self._flush_results()  # replay results from the outage
+                try:
+                    while not self._stop.is_set():
+                        frame = wire.recv_frame(self._sock, self.secret,
+                                                self._stop)
+                        if frame.msg_type == wire.MSG_SERVE_SUBMIT:
+                            self._on_submit(frame.payload)
+                except wire.ShutdownError:
+                    return
+                except (ConnectionError, OSError) as exc:
+                    if self._stop.is_set():
+                        return
+                    logger.warning("worker %s: frontend connection lost "
+                                   "(%s); reconnecting", self.name, exc)
+                    self._sock = None
+        finally:
+            self.engine.stop()
+            hb.join(timeout=2)
+
+    def start(self) -> "ServingWorker":
+        threading.Thread(target=self.run, name="hvd-serve-worker",
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def build_replica_engine(vocab_size: int = 251, num_layers: int = 2,
+                         num_heads: int = 2, d_model: int = 64,
+                         max_seq_len: int = 128,
+                         config: Optional[ServingConfig] = None,
+                         seed: int = 0) -> ServingEngine:
+    """Deterministic tiny-replica engine: every process that calls this
+    with the same arguments holds bit-identical parameters (fixed PRNG
+    seed), standing in for 'restored the same checkpoint' in tests,
+    benchmarks and the CI pod smoke."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
+                          num_heads=num_heads, d_model=d_model,
+                          max_seq_len=max_seq_len)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = config or ServingConfig(max_context=max_seq_len)
+    if cfg.max_context is None or cfg.max_context > max_seq_len:
+        cfg.max_context = max_seq_len
+    return ServingEngine(model, params, cfg)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="horovod_tpu serving worker replica")
+    ap.add_argument("--addr", required=True, help="frontend HOST:PORT")
+    ap.add_argument("--name", default=None)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=251)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--max-seq", type=int, default=128)
+    ap.add_argument("--blocks", type=int, default=None)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    args = ap.parse_args(argv)
+    host, port = args.addr.rsplit(":", 1)
+    cfg = ServingConfig(block_size=args.block_size, num_blocks=args.blocks,
+                        max_batch=args.max_batch, max_context=args.max_seq)
+    engine = build_replica_engine(
+        vocab_size=args.vocab, num_layers=args.layers, num_heads=args.heads,
+        d_model=args.d_model, max_seq_len=args.max_seq, config=cfg)
+    name = args.name or f"worker-{args.rank}"
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s " + name + " %(message)s")
+    worker = ServingWorker(host, int(port), engine, name=name,
+                           rank=args.rank)
+    try:
+        worker.run()
+    except KeyboardInterrupt:
+        worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
