@@ -297,6 +297,102 @@ def bench_bucket_overlap(bucket_mbs, iters, warmup, layers=16, np_=8):
     return results
 
 
+def bench_straggler_chaos(chaos, iters, warmup, np_=4, victim=1,
+                          deadline="3x"):
+    """Straggler-chaos acceptance bench (docs/fault-tolerance.md): the same
+    eager allreduce loop run twice — clean, then with ``chaos`` (e.g.
+    ``slow@rank:500``) injected on ``victim`` — with the straggler policy
+    armed (HOROVOD_STRAGGLER_DEADLINE). The claim under test: once the
+    policy excludes the slow rank, the SURVIVORS' step time tracks the
+    group median, not the victim's injected delay.
+
+    Point ``rank`` is the per-process engine-tick hook (elastic mode); the
+    in-process cluster shares one engine across rank threads, so it is
+    mapped to ``collective`` — the per-rank enqueue hook — which models
+    the same thing: one rank chronically late into every round. Runs with
+    HVD_TPU_NATIVE=0 in both phases so the Python controller (the one
+    that implements exclusion in-process) negotiates both sides of the
+    comparison."""
+    import horovod_tpu as hvd
+    from horovod_tpu import faultinject, testing
+
+    kind, _, rest = chaos.partition("@")
+    point, _, chaos_args = rest.partition(":")
+    if point == "rank":
+        point = "collective"
+    spec = f"{kind}@{point}" + (f":{chaos_args}" if chaos_args else "")
+    spec += f"#{victim}"
+    faultinject.parse_spec(spec)  # fail fast on a bad --chaos value
+
+    nelem = 1 << 16
+
+    def worker():
+        import time as _t
+
+        from horovod_tpu.metrics import instruments
+
+        x = np.arange(nelem, dtype=np.float32) + hvd.rank()
+        # in the chaos phase, extend the warmup past the policy's patience
+        # window so the exclusion has engaged before the timed iterations
+        # begin (same fixed count on every rank — the loop must stay in
+        # lockstep). patience late rounds + the exclusion-effective round
+        # + slack for arrival jitter around the relative floor.
+        extra = ((int(os.environ.get("HOROVOD_STRAGGLER_PATIENCE", "2")) + 5)
+                 if os.environ.get("HOROVOD_FAULT_SPEC") else 0)
+        for i in range(warmup + extra):
+            hvd.allreduce(x, name="chaos_g")
+        steps = []
+        for i in range(iters):
+            t0 = _t.perf_counter()
+            hvd.allreduce(x, name="chaos_g")
+            steps.append(_t.perf_counter() - t0)
+        return (sum(steps) / len(steps),
+                instruments.partial_collectives().value)
+
+    def run_phase(env):
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            if hvd.is_initialized():
+                hvd.shutdown()
+            faultinject.reset_shared()
+            return testing.run_cluster(worker, np=np_)
+        finally:
+            hvd.shutdown()
+            faultinject.reset_shared()
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    base_env = {"HVD_TPU_NATIVE": "0"}
+    outs = run_phase(base_env)
+    baseline = sorted(o[0] for i, o in enumerate(outs)
+                      if i != victim)[(np_ - 1) // 2]
+    chaos_env = dict(base_env)
+    chaos_env.update({
+        "HOROVOD_FAULT_SPEC": spec,
+        "HOROVOD_STRAGGLER_DEADLINE": deadline,
+        "HOROVOD_STRAGGLER_PATIENCE": os.environ.get(
+            "HOROVOD_STRAGGLER_PATIENCE", "2"),
+    })
+    outs = run_phase(chaos_env)
+    chaos_step = sorted(o[0] for i, o in enumerate(outs)
+                        if i != victim)[(np_ - 1) // 2]
+    partial_rounds = max(o[1] for o in outs)
+    result = {
+        "path": "straggler-chaos", "n": np_, "victim": victim,
+        "chaos": spec, "deadline": deadline,
+        "baseline_step_us": round(baseline * 1e6, 1),
+        "chaos_step_us": round(chaos_step * 1e6, 1),
+        "partial_rounds": int(partial_rounds),
+        "step_ratio": round(chaos_step / baseline, 3) if baseline else 0.0,
+    }
+    print(json.dumps(result))
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes-mb", default="0.0625,0.25,1,4,16,64",
@@ -318,6 +414,21 @@ def main(argv=None):
                     help="synthetic model depth for --bucket-mb")
     ap.add_argument("--np", type=int, default=8, dest="np_",
                     help="cluster size for --bucket-mb")
+    ap.add_argument("--chaos", default=None,
+                    help="straggler-chaos acceptance run: a fault rule "
+                         "like 'slow@rank:500' or 'flaky_slow@rank:500:0.5' "
+                         "injected on --chaos-victim while the straggler "
+                         "policy is armed; reports survivors' step-time "
+                         "ratio vs a clean run and exits 3 past "
+                         "--chaos-budget")
+    ap.add_argument("--chaos-victim", type=int, default=1,
+                    help="rank the --chaos rule applies to (default 1)")
+    ap.add_argument("--chaos-budget", type=float, default=1.5,
+                    help="max allowed chaos/clean step-time ratio "
+                         "(default 1.5, the ISSUE acceptance bound)")
+    ap.add_argument("--straggler-deadline", default="3x",
+                    help="HOROVOD_STRAGGLER_DEADLINE for the chaos phase "
+                         "(default 3x = 3x the median arrival spread)")
     ap.add_argument("--history", default=None,
                     help="JSONL perf-history file (benchmarks/history.py); "
                          "with --path compression the headline "
@@ -331,6 +442,50 @@ def main(argv=None):
     sizes = [float(s) for s in args.sizes_mb.split(",")]
 
     import horovod_tpu as hvd
+
+    if args.chaos is not None:
+        r = bench_straggler_chaos(args.chaos, args.iters, args.warmup,
+                                  np_=args.np_, victim=args.chaos_victim,
+                                  deadline=args.straggler_deadline)
+        result = {"metric": "straggler_chaos_step_ratio",
+                  "value": r["step_ratio"], "unit": "x",
+                  "config": {k: r[k] for k in ("chaos", "n", "victim",
+                                               "deadline")}}
+        print(json.dumps(result))
+        rc = 0
+        if r["step_ratio"] > args.chaos_budget:
+            print(f"# REGRESSION: straggler_chaos_step_ratio = "
+                  f"{r['step_ratio']} exceeds the --chaos-budget "
+                  f"{args.chaos_budget} (survivors' step time did not "
+                  f"track the median rank)", file=sys.stderr)
+            rc = 3
+        if args.history:
+            from benchmarks.history import (append_record, check_regression,
+                                            load_history)
+
+            # ratio: LOWER is better; compare before appending, same as
+            # the compression headline below
+            if args.check_regression:
+                verdict = check_regression(
+                    load_history(args.history, metric=result["metric"]),
+                    result["value"], direction="lower",
+                    **{k: v for k, v in (
+                        ("window", args.regression_window),
+                        ("tolerance", args.regression_tolerance))
+                       if v is not None})
+                print("# regression check: %s" % json.dumps(verdict),
+                      file=sys.stderr)
+                if verdict["regression"]:
+                    print(f"# REGRESSION: {result['metric']} = "
+                          f"{result['value']} rose above the ceiling "
+                          f"{verdict['floor']} (baseline "
+                          f"{verdict['baseline']} over "
+                          f"{verdict['samples']} runs)", file=sys.stderr)
+                    rc = 3
+            append_record(args.history, result)
+        if rc:
+            sys.exit(rc)
+        return [r]
 
     if args.bucket_mb is not None:
         bucket_mbs = [float(b) for b in args.bucket_mb.split(",")]

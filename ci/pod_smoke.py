@@ -541,7 +541,10 @@ def check_coordinator_failover() -> None:
                 "HVD_SECRET": secret,
                 "HVD_ELASTIC": "1",
                 "HOROVOD_STANDBY_COORD": "1",
-                "HOROVOD_RECONNECT_GRACE": "2",
+                # failover doesn't wait on the grace (promotion declares
+                # rank 0 lost explicitly); a tight value only risks a
+                # loaded host spuriously losing a live survivor
+                "HOROVOD_RECONNECT_GRACE": "15",
                 "HOROVOD_BLACKBOX": "1",
                 "HOROVOD_BLACKBOX_DIR": bbdir,
                 "JAX_PLATFORMS": "cpu",
@@ -608,6 +611,178 @@ def check_coordinator_failover() -> None:
           "survivors resumed on the promoted standby with bit-identical "
           f"parameters (sha256 {digests[1][:12]}…); hvddoctor named the "
           "coordinator failover")
+
+
+def _straggler_smoke_fn():
+    """2-rank elastic job for the straggler smoke: every rank times its
+    steps past a warmup window (long enough for the policy to exclude the
+    injected straggler), so rank 0's timed mean reflects the adapted
+    steady state. Returns (rank, mean_timed_step_s, partial_rounds)."""
+    import os
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.metrics import instruments
+    from horovod_tpu.run import rendezvous
+
+    hvd.init()
+    r = hvd.rank()
+    warmup, timed = 8, 12
+    x = np.ones((1 << 14,), np.float32) * (r + 1)
+    times = []
+    for step in range(warmup + timed):
+        t0 = time.monotonic()
+        try:
+            hvd.allreduce(x, name="s%d" % step, op=hvd.Average)
+        except hvd.WorkerLostError:
+            # escalation variant: the victim was promoted away and this
+            # round absorbed the epoch bump (elastic.run_fn's job in a
+            # real training loop). The events we came for are recorded.
+            if not os.environ.get("HVD_SMOKE_DUMP"):
+                raise
+            break
+        if step >= warmup:
+            times.append(time.monotonic() - t0)
+    partial = float(instruments.partial_collectives().value)
+    if os.environ.get("HVD_SMOKE_DUMP"):
+        # escalation variant: the victim was promoted away mid-run; force
+        # the dump so hvddoctor can read the exclusion/escalation events
+        from horovod_tpu import blackbox
+
+        blackbox.dump("straggler smoke postmortem", force=True)
+    else:
+        # rank 0 hosts the coordinator: hold it until the (possibly
+        # excluded, trailing) peer drains its solo rounds, or its last
+        # steps die with ShutdownError
+        kv = rendezvous.KVStoreClient(os.environ["HVD_KV_ADDR"],
+                                      os.environ["HVD_SECRET"])
+        kv.put("sdone", str(r), b"1")
+        if r == 0:
+            deadline = time.time() + 60
+            while time.time() < deadline and \
+                    kv.get("sdone", "1") is None:
+                time.sleep(0.2)
+    hvd.shutdown()
+    return (r, sum(times) / len(times) if times else 0.0, partial)
+
+
+def _run_straggler_smoke_job(extra_env, want_ranks):
+    """Launch _straggler_smoke_fn on 2 task.py processes; return
+    {rank: payload} for the ranks in want_ranks (others may die —
+    the escalation variant removes the victim on purpose)."""
+    import pickle
+    import time
+
+    import cloudpickle
+
+    from horovod_tpu.run import rendezvous
+
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_straggler_smoke_fn, (), {})))
+    procs = []
+    try:
+        for r in range(2):
+            env = dict(os.environ)
+            env.update({
+                "HVD_NUM_PROCS": "2",
+                "HVD_PROCESS_ID": str(r),
+                "HVD_KV_ADDR": addr,
+                "HVD_SECRET": secret,
+                "HVD_ELASTIC": "1",
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "PYTHONPATH": os.pathsep.join(
+                    [REPO, os.path.dirname(os.path.abspath(__file__))]),
+            })
+            env.update(extra_env)
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 180
+        blobs = {}
+        while time.time() < deadline and len(blobs) < len(want_ranks):
+            for r in want_ranks:
+                if r not in blobs:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+            time.sleep(0.25)
+        assert len(blobs) == len(want_ranks), (
+            f"straggler smoke ranks {sorted(want_ranks)} produced no "
+            f"result (got {sorted(blobs)}); exit codes "
+            f"{[p.poll() for p in procs]}")
+        out = {}
+        for r, blob in blobs.items():
+            ok, payload = pickle.loads(blob)
+            assert ok, f"rank {r} raised:\n{payload}"
+            out[r] = payload
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
+
+
+def check_straggler_adaptive() -> None:
+    """Straggler-adaptive smoke (docs/fault-tolerance.md): a 2-process run
+    with rank 1 injected 300 ms slow per step must (a) keep rank 0's
+    steady-state step time within 1.5x the fault-free baseline — the
+    policy excluded the victim instead of waiting on it — with partial
+    rounds actually counted, and (b) under a tight MAX_SKIP, escalate the
+    victim to rank_lost and leave a blackbox bundle from which
+    ``bin/hvddoctor`` names the chronic straggler."""
+    import tempfile
+
+    base = _run_straggler_smoke_job({}, want_ranks=(0, 1))
+    chaos = _run_straggler_smoke_job({
+        "HOROVOD_FAULT_SPEC": "slow@rank:300#1",
+        "HOROVOD_STRAGGLER_DEADLINE": "3x",
+        "HOROVOD_STRAGGLER_PATIENCE": "2",
+        "HOROVOD_STRAGGLER_MAX_SKIP": "10000",
+    }, want_ranks=(0, 1))
+    base_step = base[0][1]
+    chaos_step = chaos[0][1]
+    # 1.5x the acceptance budget, plus a 50 ms absolute floor so two
+    # near-zero means on a loaded CI host can't produce a spurious ratio;
+    # an un-excluded victim costs >=300 ms/step, far past either bound
+    assert chaos_step <= max(1.5 * base_step, base_step + 0.05), (
+        f"step time did not track the healthy rank: baseline "
+        f"{base_step * 1e3:.1f} ms vs chaos {chaos_step * 1e3:.1f} ms")
+    assert chaos[0][2] > 0, (
+        "no partial rounds counted — the straggler was never excluded")
+
+    bbdir = tempfile.mkdtemp(prefix="hvd_straggler_smoke_")
+    _run_straggler_smoke_job({
+        "HOROVOD_FAULT_SPEC": "slow@rank:300#1",
+        "HOROVOD_STRAGGLER_DEADLINE": "3x",
+        "HOROVOD_STRAGGLER_PATIENCE": "1",
+        "HOROVOD_STRAGGLER_MAX_SKIP": "2",
+        "HVD_SMOKE_DUMP": "1",
+        "HOROVOD_BLACKBOX": "1",
+        "HOROVOD_BLACKBOX_DIR": bbdir,
+    }, want_ranks=(0,))
+    hvddoctor = os.path.join(REPO, "bin", "hvddoctor")
+    d = subprocess.run([sys.executable, hvddoctor, bbdir],
+                       capture_output=True, text=True, timeout=60)
+    assert d.returncode == 0, (
+        f"hvddoctor rejected the bundle:\n{d.stderr[-2000:]}")
+    assert "chronic straggler" in d.stdout, (
+        f"hvddoctor did not name the chronic straggler:\n"
+        f"{d.stdout[-3000:]}")
+    assert "rank 1" in d.stdout, (
+        f"diagnosis does not name the victim rank:\n{d.stdout[-3000:]}")
+    print(f"ok: straggler smoke — victim excluded (baseline "
+          f"{base_step * 1e3:.1f} ms, chaos {chaos_step * 1e3:.1f} ms, "
+          f"{chaos[0][2]:.0f} partial rounds); escalation variant left a "
+          "bundle and hvddoctor named the chronic straggler")
 
 
 def check_adaptive_wire() -> None:
@@ -772,12 +947,14 @@ def main():
     check_bucket_overlap()
     check_blackbox_doctor()
     check_coordinator_failover()
+    check_straggler_adaptive()
     check_adaptive_wire()
     check_serving_kill()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
           "+ bucket overlap + blackbox doctor + coordinator failover "
-          "+ adaptive wire + serving worker-kill valid")
+          "+ straggler adaptive + adaptive wire + serving worker-kill "
+          "valid")
 
 
 if __name__ == "__main__":

@@ -34,6 +34,13 @@ python -m pytest tests/test_faultinject.py -q
 stage "chaos: data-plane integrity (grad guard, consistency audit, watchdog)"
 python -m pytest tests/test_integrity.py tests/test_stall.py -q
 
+stage "chaos: straggler-adaptive execution (policy, partial rounds, EF rejoin)"
+python -m pytest tests/test_straggler.py -q -m "not integration"
+# acceptance: with a 500 ms chronic straggler injected, the surviving
+# ranks' step time must stay within 1.5x the fault-free baseline
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/allreduce_bench.py --chaos slow@rank:500 --iters 6
+
 stage "controlplane: hierarchical negotiation, coordinator failover, storms"
 python -m pytest tests/test_coord.py -q -m "not integration"
 # the control-plane integrations run on plain CPU (elastic Popen harness):

@@ -38,6 +38,8 @@ RECONNECT_STORM_COUNT = 3
 HEARTBEAT_FLAP_TRANSITIONS = 2
 #: bitwidth decision changes for ONE bucket that constitute thrash
 BITWIDTH_THRASH_FLIPS = 4
+#: exclusion episodes for one rank past which it is chronic, not noise
+CHRONIC_STRAGGLER_EPISODES = 3
 
 
 def make_signature(sig_id: str, severity: str, summary: str,
@@ -305,6 +307,53 @@ def detect_bitwidth_thrash(bundle) -> List[dict]:
     return sigs
 
 
+def detect_chronic_straggler(bundle) -> List[dict]:
+    """A rank the straggler policy (runtime/straggler.py) excluded over
+    and over. Each exclusion records a K_EXCLUDED event carrying a
+    cumulative ``episode=N`` counter and the rank's host, so a rank whose
+    episodes reach CHRONIC_STRAGGLER_EPISODES — or that was escalated to
+    rank_lost outright — points at the MACHINE, not the step: name the
+    host so the operator can drain or replace it."""
+    episodes: Dict[int, int] = {}
+    hosts: Dict[int, str] = {}
+    escalated: Dict[int, str] = {}
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_EXCLUDED:
+            continue
+        m = re.match(r"rank_(\d+)$", ev.get("name") or "")
+        if not m:
+            continue
+        r = int(m.group(1))
+        detail = ev.get("detail") or ""
+        hm = re.search(r"host=(\S+)", detail)
+        if hm and hm.group(1) not in ("", "?"):
+            hosts[r] = hm.group(1)
+        em = re.search(r"episode=(\d+)", detail)
+        if detail.startswith("excluded") and "self" not in detail:
+            # the episode counter is cumulative per policy lifetime, so
+            # its max IS the count — robust to rank-interleaved streams
+            # that replay the same episode from several recorders
+            n = int(em.group(1)) if em else episodes.get(r, 0) + 1
+            episodes[r] = max(episodes.get(r, 0), n)
+        elif detail.startswith("escalated"):
+            escalated[r] = detail
+    sigs = []
+    for r in sorted(set(episodes) | set(escalated)):
+        n = episodes.get(r, 0)
+        if r not in escalated and n < CHRONIC_STRAGGLER_EPISODES:
+            continue
+        host = hosts.get(r, "?")
+        tail = (" and was escalated to rank_lost" if r in escalated else "")
+        sigs.append(make_signature(
+            "chronic_straggler",
+            SEV_CRITICAL if r in escalated else SEV_WARNING,
+            "chronic straggler: rank %d (host %s) was excluded from "
+            "%d collective round group(s)%s — suspect the machine, "
+            "not the workload" % (r, host, n, tail),
+            rank=r, host=host, episodes=n, escalated=r in escalated))
+    return sigs
+
+
 def detect_latency_regression(bundle) -> List[dict]:
     """Serving-mode latency regression: the live anomaly watch flagged a
     serving signal (request-latency p99 or admission queue depth) deviating
@@ -336,6 +385,7 @@ DETECTORS = (
     detect_dead_worker,
     detect_coordinator_failover,
     detect_straggler,
+    detect_chronic_straggler,
     detect_latency_regression,
     detect_reconnect_storm,
     detect_heartbeat_flap,

@@ -185,12 +185,19 @@ class AnomalyWatch:
                 # vocabulary; everything else keeps the generic id
                 sig_id = ("latency_regression" if name.startswith("serving_")
                           else "anomaly:%s" % name)
+                evidence = {"signal": name, "value": value,
+                            "baseline": base}
+                if name == "straggler_skew_seconds":
+                    # a skew anomaly and a repeat-excluded rank are the
+                    # same machine seen live vs postmortem; point the
+                    # operator at the doctor signature that names it
+                    evidence["related"] = "chronic_straggler"
                 sig = make_signature(
                     sig_id, SEV_WARNING,
                     "anomaly: %s=%.6g deviates from rolling baseline %.6g "
                     "(factor %g over %d samples)"
                     % (name, value, base, baseline.factor, len(baseline)),
-                    signal=name, value=value, baseline=base)
+                    **evidence)
                 fired.append(sig)
                 logger.warning("anomaly watch: %s", sig["summary"])
                 _record(K_ANOMALY, name, sig["summary"])

@@ -13,6 +13,13 @@ control network, not ICI, so elastic mode is for jobs where "keeps training
 through a preemption" beats raw step time (docs/elastic.md). Only ALLREDUCE
 and BROADCAST are supported — exactly what :class:`~..elastic.state.ElasticState`
 sync and gradient averaging need.
+
+Straggler-adaptive rounds (runtime/straggler.py): the coordinator may combine
+an allreduce over a subgroup that excludes this rank. The DATA_OK reply then
+carries the actual contributor list; a sender absent from it keeps its fused
+contribution in a per-name error-feedback residual and folds it into the NEXT
+round's send, so no gradient mass is silently dropped — the same EF discipline
+the quantized wire applies to quantization error (ops/quantize.py).
 """
 
 from __future__ import annotations
@@ -31,6 +38,10 @@ class ElasticExecutor:
     :class:`~..runtime.executor.Executor` (``execute`` + wire accounting
     attrs) so the engine is agnostic."""
 
+    # the data plane divides by the REAL participant count (DATA_OK carries
+    # it), so the engine must not rescale partial averages a second time
+    partial_aware = True
+
     def __init__(self, state, controller):
         self._state = state
         self._controller = controller
@@ -38,6 +49,18 @@ class ElasticExecutor:
         # no quantized mode, so mode stays "" and autotune scores raw bytes
         self.last_wire_mode: str = ""
         self.last_wire_bytes: int = 0
+        # EF residuals, keyed by tensor name, in WIRE space (post-prescale):
+        # a contribution the subgroup round dropped, waiting to fold into
+        # this rank's next send of the same tensor
+        self._residuals: Dict[str, np.ndarray] = {}
+
+    def residual_mass(self) -> float:
+        """Sum of |residual| over all tensors — the EF accounting surface
+        the chaos tests (and DistributedOptimizer.straggler_residual_mass)
+        assert against: non-zero while excluded, exactly 0.0 after the
+        fold-back round lands."""
+        return float(sum(float(np.abs(r).sum())
+                         for r in self._residuals.values()))
 
     def execute(self, response: Response,
                 entries_by_rank: Dict[int, List[TensorTableEntry]]):
@@ -76,6 +99,18 @@ class ElasticExecutor:
                 else np.zeros((0,), dtype=dtype))
         if rt == ResponseType.ALLREDUCE and response.prescale != 1.0:
             flat = flat * dtype.type(response.prescale)
+        if rt == ResponseType.ALLREDUCE and self._residuals:
+            # EF fold-in: add any residual carried from rounds where this
+            # rank's contribution was dropped (same wire space as flat —
+            # post-prescale — so the two compose exactly)
+            flat = np.array(flat, copy=True)
+            off = 0
+            for name, shape in zip(response.tensor_names, shapes):
+                n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                res = self._residuals.get(name)
+                if res is not None and res.size == n:
+                    flat[off:off + n] += res.astype(dtype, copy=False)
+                off += n
 
         from ..runtime.messages import RequestType
 
@@ -85,6 +120,25 @@ class ElasticExecutor:
             op, response.root_rank, flat)
         # one send + one receive of the fused buffer
         self.last_wire_bytes = 2 * int(flat.size) * dtype.itemsize
+
+        if rt == ResponseType.ALLREDUCE:
+            # EF accounting against the ACTUAL contributor list of this
+            # round (None = everyone made it in). A sender the combine
+            # dropped banks what it sent (entry + any folded residual) for
+            # the next round; a sender the combine included starts clean.
+            contributors = getattr(self._controller,
+                                   "last_data_contributors", None)
+            dropped = (contributors is not None
+                       and self_rank not in contributors)
+            off = 0
+            for name, shape in zip(response.tensor_names, shapes):
+                n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                if dropped and name in by_name:
+                    self._residuals[name] = np.array(flat[off:off + n],
+                                                     copy=True)
+                else:
+                    self._residuals.pop(name, None)
+                off += n
 
         combined = np.asarray(combined, dtype=dtype)
         if rt == ResponseType.ALLREDUCE:

@@ -49,6 +49,13 @@ class Injector:
                 key = id(rule)
                 n = self._hits.get(key, 0) + 1
                 self._hits[key] = n
+                if rule.kind == "flaky_slow":
+                    # deterministic coin flip on the hit index (Knuth
+                    # multiplicative hash): same (rule, hit) always decides
+                    # the same way, so flaky runs replay exactly
+                    u = ((n * 2654435761) % (2 ** 32)) / 2.0 ** 32
+                    if u >= rule.prob:
+                        continue
                 if rule.nth is None or rule.nth == n:
                     fired.append((rule.kind, rule.seconds))
                     logger.warning(
@@ -70,7 +77,7 @@ class Injector:
         :meth:`actions_for` by the integrity layer, which owns the
         tensors being poisoned."""
         for kind, seconds in self.actions_for(point):
-            if kind in ("delay", "hang", "slow"):
+            if kind in ("delay", "hang", "slow", "flaky_slow"):
                 time.sleep(seconds)
             elif kind == "conn_drop" and self._drop_cb is not None:
                 self._drop_cb()

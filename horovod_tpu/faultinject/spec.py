@@ -29,18 +29,31 @@ A spec is a semicolon-separated list of rules, each of the form::
       docs/control-plane.md)
     - ``slow``       coordinator brownout: sleep ``arg`` MILLISECONDS
       inside each negotiation at point ``coordinator`` (the coordinator
-      lock is held, so every rank observes the slowdown)
+      lock is held, so every rank observes the slowdown). At point
+      ``rank`` the same sleep fires once per engine tick of the targeted
+      rank instead — a chronically slow WORKER rather than a slow
+      coordinator (``slow@rank:500#2`` = rank 2 loses 500 ms per step;
+      drives the straggler policy, runtime/straggler.py)
+    - ``flaky_slow`` like ``slow`` but intermittent: ``arg`` milliseconds,
+      fired only on the hits selected by ``arg2`` — a probability in
+      (0, 1] applied via a deterministic hash of the per-rank hit index,
+      so ``flaky_slow@rank:500:0.3#2`` slows ~30% of rank 2's steps and
+      replays IDENTICALLY run to run (no RNG; the straggler policy's
+      patience/hysteresis is tested against exactly this flapping)
 * ``point`` — a named injection site. Frame-granular kinds fire inside the
   wrapped socket at point ``frame`` (one hit per sent frame); ``tick``,
   ``exchange``, ``connect`` and ``heartbeat`` are explicit hooks in
   `runtime/coordinator.py`; ``coordinator`` is hit once per negotiation
-  inside rank 0's CoordState; ``grad`` is hit once per guarded optimizer
+  inside rank 0's CoordState; ``rank`` once per engine tick
+  (`runtime/engine.py`); ``grad`` is hit once per guarded optimizer
   step, ``param`` once per consistency audit, ``collective`` once per
   enqueued collective (`ops/collective_ops.py`).
 * ``arg`` — for ``delay`` and ``hang`` the sleep in seconds, for ``slow``
   the sleep in milliseconds, each with an optional second arg restricting
-  it to the Nth hit (default: every hit). For every other kind the
-  1-based hit index at which the rule fires once (default 1).
+  it to the Nth hit (default: every hit); for ``flaky_slow`` the sleep in
+  milliseconds with a REQUIRED second arg, the firing probability. For
+  every other kind the 1-based hit index at which the rule fires once
+  (default 1).
 * ``#ranks`` — optional comma list of ranks the rule applies to
   (default: every rank).
 
@@ -54,7 +67,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial",
-         "nan", "desync", "hang", "die", "slow")
+         "nan", "desync", "hang", "die", "slow", "flaky_slow")
 
 # kinds applied to outgoing frames by the FaultSocket wrapper (as opposed to
 # the named fire() hooks in controller code)
@@ -66,21 +79,23 @@ _TIMED_KINDS = ("delay", "hang")
 # like _TIMED_KINDS but the argument is in milliseconds (coordinator
 # brownouts are naturally sub-second; "slow@coordinator:250" reads better
 # than a fractional-seconds form)
-_MS_KINDS = ("slow",)
+_MS_KINDS = ("slow", "flaky_slow")
 
 
 class FaultRule:
     """One parsed rule; hit counting lives in the Injector."""
 
-    __slots__ = ("kind", "point", "nth", "seconds", "ranks")
+    __slots__ = ("kind", "point", "nth", "seconds", "ranks", "prob")
 
     def __init__(self, kind: str, point: str, nth: Optional[int],
-                 seconds: float, ranks: Optional[Sequence[int]]):
+                 seconds: float, ranks: Optional[Sequence[int]],
+                 prob: float = 1.0):
         self.kind = kind
         self.point = point
         self.nth = nth            # 1-based hit index; None = every hit
         self.seconds = seconds    # only meaningful for delay/hang
         self.ranks = None if ranks is None else frozenset(ranks)
+        self.prob = prob          # flaky_slow firing probability, else 1.0
 
     def applies_to(self, rank: int) -> bool:
         return self.ranks is None or rank in self.ranks
@@ -89,6 +104,8 @@ class FaultRule:
         extra = f":{self.seconds}" if self.kind in _TIMED_KINDS else ""
         if self.kind in _MS_KINDS:
             extra = f":{self.seconds * 1000.0:g}"
+        if self.kind == "flaky_slow":
+            extra += f":{self.prob:g}"
         nth = f":{self.nth}" if self.nth is not None else ""
         ranks = ("" if self.ranks is None
                  else "#" + ",".join(str(r) for r in sorted(self.ranks)))
@@ -124,8 +141,17 @@ def parse_spec(text: str) -> List[FaultRule]:
             raise ValueError(
                 f"HOROVOD_FAULT_SPEC: rule {raw!r} names no point")
         args = parts[1:]
+        prob = 1.0
         try:
-            if kind in _TIMED_KINDS or kind in _MS_KINDS:
+            if kind == "flaky_slow":
+                if len(args) < 2:
+                    raise ValueError
+                seconds = float(args[0]) / 1000.0
+                prob = float(args[1])
+                if not (0.0 < prob <= 1.0):
+                    raise ValueError
+                nth = None
+            elif kind in _TIMED_KINDS or kind in _MS_KINDS:
                 if not args:
                     raise ValueError
                 seconds = float(args[0])
@@ -141,5 +167,5 @@ def parse_spec(text: str) -> List[FaultRule]:
             raise ValueError(
                 f"HOROVOD_FAULT_SPEC: bad argument(s) {args!r} "
                 f"in rule {raw!r}")
-        rules.append(FaultRule(kind, point, nth, seconds, ranks))
+        rules.append(FaultRule(kind, point, nth, seconds, ranks, prob))
     return rules

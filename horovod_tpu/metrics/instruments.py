@@ -211,6 +211,28 @@ def straggler_skew_seconds():
         "ranks waited for the straggler.", agg="max")
 
 
+def partial_collectives():
+    return get_registry().counter(
+        "hvd_partial_collectives_total",
+        "Collectives completed over a straggler-excluded subgroup instead "
+        "of the full member set (rank 0 straggler policy).")
+
+
+def excluded_rank():
+    return get_registry().gauge(
+        "hvd_excluded_rank",
+        "Highest rank currently excluded by the straggler policy, or -1 "
+        "when every member is participating.", agg="max")
+
+
+def straggler_promotions():
+    return get_registry().counter(
+        "hvd_straggler_promotions_total",
+        "Chronically slow ranks escalated to rank_lost / hot-spare "
+        "promotion after trailing excluded past "
+        "HOROVOD_STRAGGLER_MAX_SKIP rounds.")
+
+
 def trace_dropped_events():
     return get_registry().counter(
         "hvd_trace_dropped_events_total",

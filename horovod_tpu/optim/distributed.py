@@ -356,6 +356,22 @@ class DistributedOptimizer(_GradAccumulation):
     def init(self, params):
         return self._tx.init(params)
 
+    @staticmethod
+    def straggler_residual_mass() -> float:
+        """Sum of |residual| the straggler policy is currently carrying for
+        THIS rank (elastic data plane, runtime/straggler.py): non-zero only
+        while this rank is excluded and its dropped contributions are
+        banked for the rejoin fold-back; exactly 0.0 once they land. The EF
+        accounting surface the chaos acceptance test asserts against —
+        distinct from the quantization residual above, which lives in
+        optimizer state, not the executor."""
+        try:
+            eng = basics._engine()
+        except Exception:
+            return 0.0
+        fn = getattr(getattr(eng, "_executor", None), "residual_mass", None)
+        return float(fn()) if callable(fn) else 0.0
+
     def _apply_error_feedback(self, grads):
         """corrected = grads + residual; the new residual is the part of
         ``corrected`` the lossy wire will drop this step."""

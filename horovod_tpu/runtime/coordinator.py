@@ -63,6 +63,7 @@ from ..utils.timeline import Timeline
 from .. import blackbox as _blackbox
 from .. import faultinject
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
+from . import straggler as straggler_mod
 from . import wire
 from .wire import ReqMeta
 
@@ -262,6 +263,19 @@ class CoordState:
         # per-seq participant count at negotiation time (membership may have
         # changed by the time stragglers fetch)
         self.expected: Dict[int, int] = {}
+        # ---- straggler-adaptive execution (docs/fault-tolerance.md): the
+        # deadline policy (None unless HOROVOD_STRAGGLER_DEADLINE is set AND
+        # the job is elastic — the XLA data plane cannot drop a participant
+        # mid-psum, only the host-wire elastic plane can), per-rank first
+        # deposit time of each in-flight barrier round, and rank -> host so
+        # escalation can blacklist the right machine
+        self.straggler = (straggler_mod.StragglerPolicy.from_env()
+                          if elastic else None)
+        self._deposit_t: Dict[int, Dict[int, float]] = {}
+        self.rank_hosts: Dict[int, str] = {0: socket.gethostname()}
+        # escalations the serve thread should report to the elastic driver
+        # (host, reason); drained outside the lock
+        self._promote_queue: List[Tuple[str, str]] = []
 
     # ---- client entry: one call per rank per tick
     def exchange(self, rank: int, seq: int, payload: bytes) -> bytes:
@@ -413,17 +427,67 @@ class CoordState:
             self.round_bytes += score[0]
             self.round_seconds = max(self.round_seconds, score[1])
         self.lists.setdefault(seq, {})[rank] = flags_cached_reqs_score[:3]
+        if self.straggler is not None:
+            self._observe_arrival_locked(rank, seq)
         self._maybe_negotiate_locked(seq)
         return ("wait", self.epoch)
+
+    def _observe_arrival_locked(self, rank: int, seq: int) -> None:
+        """Straggler policy bookkeeping: record this rank's first deposit
+        time for the round, and once EVERY member has deposited (excluded
+        ranks trail in late — that lateness is exactly the measurement)
+        feed the completed arrival row to the policy and act on its
+        exclusion/readmission transitions."""
+        pol = self.straggler
+        pol.note_deposit(rank, seq)
+        row = self._deposit_t.setdefault(seq, {})
+        row.setdefault(rank, time.monotonic())
+        if len(row) < len(self.members):
+            return
+        events = pol.observe_round(self._deposit_t.pop(seq))
+        for r in events["excluded"]:
+            host = self.rank_hosts.get(r, "?")
+            logger.warning(
+                "straggler policy: excluding rank %d (host %s) after %d "
+                "late rounds; collectives proceed over %d survivors",
+                r, host, pol.patience, len(self.members) - len(pol.excluded))
+            _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
+                             "excluded host=%s episode=%d"
+                             % (host, pol.episodes.get(r, 0)))
+        for r in events["readmitted"]:
+            logger.info("straggler policy: re-admitting rank %d (host %s)",
+                        r, self.rank_hosts.get(r, "?"))
+            _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
+                             "readmitted host=%s"
+                             % self.rank_hosts.get(r, "?"))
+        if events["excluded"] or events["readmitted"]:
+            instruments.excluded_rank().set(
+                max(pol.excluded) if pol.excluded else -1)
+            # the quorum just changed: barriers blocked on the old set may
+            # be complete under the new one
+            for s in sorted(self.lists):
+                self._maybe_negotiate_locked(s)
 
     def _maybe_negotiate_locked(self, seq: int) -> None:
         # a coalescing loss reset is pending: completing the barrier now
         # would negotiate against a member set about to shrink — hold until
         # the reset flushes (bounded by admission_batch_s)
-        if (seq in self.lists and not self._pending_lost
-                and len(self.lists[seq]) == len(self.members)):
+        if seq not in self.lists or self._pending_lost:
+            return
+        row = self.lists[seq]
+        if self.straggler is not None and self.straggler.excluded:
+            # partial barrier: complete once every NON-excluded member has
+            # deposited; the excluded rank trails and fetches late
+            ready = all(m in row for m in self.members
+                        if m not in self.straggler.excluded)
+        else:
+            ready = len(row) == len(self.members)
+        if ready:
+            # expected counts ALL members: the excluded rank still fetches
+            # this seq's response (after the fact), so the cached response
+            # must survive until it does
             self.expected[seq] = len(self.members)
-            self.resps[seq] = self._negotiate(self.lists.pop(seq))
+            self.resps[seq] = self._negotiate(self.lists.pop(seq), seq)
             self.cv.notify_all()
 
     def _await_join_locked(self, rank: int) -> bytes:
@@ -457,6 +521,10 @@ class CoordState:
             del self.resps[seq]
             del self.fetched[seq]
             self.expected.pop(seq, None)
+            # a trailing excluded rank's late deposit can recreate the
+            # barrier entry AFTER partial negotiation popped it; everyone
+            # (including that rank) has now fetched, so drop the remnant
+            self.lists.pop(seq, None)
         return data
 
     # ---- elastic membership (all under self.cv unless noted)
@@ -475,6 +543,8 @@ class CoordState:
                              self.inflight_data):
                 per_rank.pop(rank, None)
             self._hb_silent.discard(rank)
+            if self.straggler is not None:
+                self.straggler.forget(rank)
             instruments.elastic_rank_lost().inc()
             # flight recorder: remember the death so rank 0's bundle carries
             # a stub for the rank that will never ship its own dump; a stale
@@ -685,6 +755,13 @@ class CoordState:
         # EPOCH_SEQ_BASE, so no stale entry could match anyway)
         self.last_resp.clear()
         self.last_data_resp.clear()
+        # straggler counters are meaningless across a membership change
+        # (seqs realign, the member set shifts); episode history survives
+        # inside the policy for the chronic_straggler doctor signature
+        self._deposit_t.clear()
+        if self.straggler is not None:
+            self.straggler.reset()
+            instruments.excluded_rank().set(-1)
         _blackbox.record(_blackbox.K_EPOCH, "epoch_%d" % self.epoch,
                          "%s; members now %s" % (reason,
                                                  sorted(self.members)))
@@ -742,6 +819,38 @@ class CoordState:
         threading.Thread(target=_put, name="hvd_elastic_members",
                          daemon=True).start()
 
+    def note_rank_host(self, rank: int, host: str) -> None:
+        """Remember which machine a rank connected from (HELLO/RESUME peer
+        address) so straggler escalation can blacklist the HOST, not just
+        the rank."""
+        if host:
+            with self.cv:
+                self.rank_hosts[rank] = host
+
+    def _notify_driver_failure(self, host: str, reason: str) -> None:
+        """Report a chronically slow host to the elastic driver (when one
+        launched us) so the blacklist keeps rescheduling off it and a hot
+        spare is promoted. Off-thread: this runs from inside a negotiation
+        under self.cv and must never block the control plane on RPC."""
+        driver_addr = os.environ.get("HVD_DRIVER_ADDR")
+        if not driver_addr or not host or host == "?":
+            return
+
+        def _report():
+            try:
+                from ..run.service import DriverClient
+
+                ip, port = driver_addr.rsplit(":", 1)
+                DriverClient((ip, int(port)),
+                             os.environ.get("HVD_SECRET", "")
+                             ).notify_host_failure(host, reason)
+            except Exception:
+                logger.debug("straggler: driver failure report failed",
+                             exc_info=True)
+
+        threading.Thread(target=_report, name="hvd_straggler_promote",
+                         daemon=True).start()
+
     def _ranks_changed_bytes(self) -> bytes:
         return wire.encode_response_list(
             wire.RESP_RANKS_CHANGED, -1, [], [], [], self.reset_reason,
@@ -797,28 +906,64 @@ class CoordState:
             return self._ranks_changed_data_locked()
         agg = self.data.get(key)
         if agg is None:
+            # expected: who must contribute before combining (shrinks live
+            # with straggler exclusion); fetchers: who will FETCH the result
+            # (always every member — a trailing excluded rank still fetches,
+            # late, so the agg must survive until it does)
             agg = self.data[key] = {"parts": {}, "result": None,
                                     "nparticipants": 0, "fetched": 0,
-                                    "expected": set(self.members)}
+                                    "expected": set(self.members),
+                                    "fetchers": set(self.members),
+                                    "contributors": None}
         agg["parts"][rank] = (op, root, dtype, shape, raw)
-        if (agg["result"] is None
-                and set(agg["parts"]) >= agg["expected"]):
-            agg["result"] = self._combine(agg)
-            agg["nparticipants"] = len(agg["parts"])
-            self.cv.notify_all()
+        self._maybe_combine_locked(agg)
         while agg["result"] is None:
             if self.bye:
                 return self._data_error_locked()
             if self.epoch != epoch:
                 return self._ranks_changed_data_locked()
+            # exclusion can flip while we wait (the policy acts on control
+            # frames): re-check whether the surviving subgroup is complete
+            self._maybe_combine_locked(agg)
+            if agg["result"] is not None:
+                break
             self.cv.wait(timeout=0.5)
+        partial = set(agg["contributors"] or ()) != agg["fetchers"]
         out = wire.encode_data_result(wire.DATA_OK, epoch,
-                                      agg["nparticipants"], None,
+                                      agg["nparticipants"],
+                                      agg["contributors"] if partial
+                                      else None,
                                       agg["result"])
         agg["fetched"] += 1
-        if agg["fetched"] >= agg["nparticipants"]:
+        if agg["fetched"] >= len(agg["fetchers"]):
             self.data.pop(key, None)
         return out
+
+    def _maybe_combine_locked(self, agg: dict) -> None:
+        """Combine once every non-excluded expected rank has contributed.
+        The contributor list is snapshotted at combine time: a late part
+        that beats the combine IS included (and its sender learns it was,
+        via the members field of the reply, so its EF residual clears)."""
+        if agg["result"] is not None:
+            return
+        need = set(agg["expected"])
+        if self.straggler is not None and self.straggler.excluded:
+            survivors = need - self.straggler.excluded
+            if survivors:
+                need = survivors
+        if set(agg["parts"]) >= need:
+            op, root = next(iter(agg["parts"].values()))[:2]
+            if (op == int(RequestType.BROADCAST)
+                    and root not in agg["parts"]):
+                # a broadcast has exactly one source of truth: even an
+                # excluded root must land its part before we combine
+                return
+            agg["contributors"] = sorted(agg["parts"])
+            agg["result"] = self._combine(agg)
+            agg["nparticipants"] = len(agg["parts"])
+            if set(agg["contributors"]) != agg["fetchers"]:
+                instruments.partial_collectives().inc()
+            self.cv.notify_all()
 
     @staticmethod
     def _combine(agg: dict) -> bytes:
@@ -903,7 +1048,7 @@ class CoordState:
             self.tuned = self.tuned + (self.bw_tuner.cap(),)
         return self.tuned
 
-    def _negotiate(self, per_rank) -> bytes:
+    def _negotiate(self, per_rank, seq: int = -1) -> bytes:
         flags = 0
         self.last_negotiation = time.time()
         if self.on_negotiate is not None:
@@ -938,10 +1083,34 @@ class CoordState:
                 instruments.response_cache_misses().inc()
                 self._add(rank, m)
 
+        # straggler escalation: an excluded rank that has trailed the
+        # negotiation frontier by more than max_skip rounds is promoted
+        # away — declared lost (same reset path a dropped connection takes)
+        # and its host reported to the elastic driver so a hot spare is
+        # admitted at the next commit boundary
+        excl: set = set()
+        if self.straggler is not None:
+            if seq >= 0:
+                for r in self.straggler.on_negotiate(seq, self.members):
+                    host = self.rank_hosts.get(r, "?")
+                    reason = (f"straggler escalation: rank {r} (host {host}) "
+                              f"trailed more than "
+                              f"{self.straggler.max_skip} rounds while "
+                              f"excluded")
+                    logger.warning("coordinator: %s", reason)
+                    instruments.straggler_promotions().inc()
+                    _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
+                                     "escalated host=%s" % host)
+                    self._notify_driver_failure(host, reason)
+                    self.rank_lost(r, reason)
+                    return self._ranks_changed_bytes()
+            excl = set(self.straggler.excluded)
+
         now = time.monotonic()
-        active = set(self.members) - self.joined
+        active = set(self.members) - self.joined - excl
         epoch = self.epoch if self.elastic else -1
         emembers = sorted(self.members) if self.elastic else None
+        wexcl = sorted(excl) if excl else None
 
         # join barrier: all ranks joined and nothing pending
         # (`controller.cc:202-256`)
@@ -953,7 +1122,8 @@ class CoordState:
             return wire.encode_response_list(flags, last, [], [], [],
                                              tuned=tuned, epoch=epoch,
                                              members=emembers,
-                                             invalid_ids=sorted(invalid))
+                                             invalid_ids=sorted(invalid),
+                                             excluded=wexcl)
 
         ready: List[str] = []
         warnings: List[str] = []
@@ -1127,7 +1297,8 @@ class CoordState:
                                          assignments, warnings,
                                          self.shutdown_reason, tuned=tuned,
                                          epoch=epoch, members=emembers,
-                                         invalid_ids=sorted(invalid))
+                                         invalid_ids=sorted(invalid),
+                                         excluded=wexcl)
 
     def _add(self, rank: int, m: ReqMeta) -> None:
         if (self.tuner is not None and self.bw_tuner is None
@@ -1332,6 +1503,11 @@ class CoordState:
                 "heartbeat_misses": {str(r): n for r, n
                                      in self._hb_miss_counts.items() if n},
                 "silent_ranks": sorted(self._hb_silent),
+                "excluded_ranks": (sorted(self.straggler.excluded)
+                                   if self.straggler is not None else []),
+                "straggler_episodes": (
+                    {str(r): n for r, n in self.straggler.episodes.items()}
+                    if self.straggler is not None else {}),
             }
 
 
@@ -1457,6 +1633,13 @@ class CoordinatorServer:
             with self._gen_lock:
                 gen = self._conn_gen.get(rank, 0) + 1
                 self._conn_gen[rank] = gen
+            try:
+                # rank -> host for straggler escalation (FaultSocket proxies
+                # getpeername); best-effort — a failed lookup only costs the
+                # blacklist entry, never the connection
+                self.state.note_rank_host(rank, conn.getpeername()[0])
+            except OSError:
+                pass
             self.state.mark_alive(rank)
             if mt == MSG_RESUME:
                 self.state.rank_reconnected(rank,
@@ -1799,6 +1982,13 @@ class CoordController:
         self._ranks_changed_reason: Optional[str] = None
         self._commit_pending = False
         self._dseq = 0
+        # ---- straggler exclusion (runtime/straggler.py): the excluded set
+        # the coordinator broadcast in the last ResponseList, and the actual
+        # contributor list of the last partial data exchange (None on full
+        # rounds) — ElasticExecutor reads the latter for EF residual
+        # accounting
+        self._excluded: frozenset = frozenset()
+        self.last_data_contributors: Optional[List[int]] = None
         # ---- survivable control plane (docs/control-plane.md)
         self._hier = os.environ.get(
             "HOROVOD_HIERARCHICAL_COORD", "") not in ("", "0")
@@ -2024,9 +2214,10 @@ class CoordController:
                 f"{exc!r})")
         (rflags, last_joined, responses, assignments, warnings, reason,
          tuned, repoch, rmembers,
-         invalid_ids) = wire.decode_response_list(data)
+         invalid_ids, excluded) = wire.decode_response_list(data)
         if rflags & wire.RESP_RANKS_CHANGED:
             self._apply_ranks_changed(repoch, rmembers or [], reason)
+        self._apply_excluded(excluded)
         for resp in responses:
             resp.epoch = repoch
         if tuned is not None:
@@ -2089,6 +2280,32 @@ class CoordController:
             return None
         return (responses, handle_pairs, join_released, last_joined,
                 warnings, False)
+
+    def _apply_excluded(self, excluded) -> None:
+        """Track the coordinator's broadcast exclusion set. Logged (and
+        blackbox-recorded) only on transitions that involve THIS rank, so a
+        straggler host's own log says when it was parked and when it came
+        back — the first place an operator looks."""
+        new = frozenset(excluded or ())
+        if new == self._excluded:
+            return
+        if self._rank in new and self._rank not in self._excluded:
+            logger.warning(
+                "rank %d excluded from collectives by straggler policy "
+                "(trailing; contributions accumulate into the EF residual)",
+                self._rank)
+            _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % self._rank,
+                             "excluded self", rank=self._rank)
+        elif self._rank in self._excluded and self._rank not in new:
+            logger.info("rank %d re-admitted to collectives", self._rank)
+            _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % self._rank,
+                             "readmitted self", rank=self._rank)
+        self._excluded = new
+
+    def excluded_ranks(self) -> frozenset:
+        """Ranks currently excluded by the straggler policy (empty when the
+        policy is off — the common case)."""
+        return self._excluded
 
     def _stall_names_me(self, warning: str) -> bool:
         """True if this rank is in the warning's 'waiting on ranks [...]'
@@ -2493,6 +2710,10 @@ class CoordController:
             raise ShutdownError(raw.decode("utf-8", "replace")
                                 or "elastic data exchange failed")
         out = np.frombuffer(raw, dtype=arr.dtype).reshape(arr.shape)
+        # members rides the DATA_OK reply only on partial rounds (straggler
+        # exclusion): the actual contributor list, read by ElasticExecutor
+        # for EF residual accounting. None ⇒ everyone contributed.
+        self.last_data_contributors = list(rmembers) if rmembers else None
         return out.copy(), nparticipants
 
     def interrupt(self) -> None:

@@ -75,6 +75,7 @@ from ..exceptions import (CollectiveTimeoutError, DuplicateNameError,
                           ShutdownError)
 from ..metrics import instruments
 from .. import blackbox as _blackbox
+from .. import faultinject
 from .. import tracing as _tracing
 from ..utils.env import env_float as _env_float, env_on as _env_on
 from .executor import Executor
@@ -246,9 +247,15 @@ class Engine:
         instruments.integrity_heals().inc(0)
         instruments.collective_timeouts().inc(0)
         instruments.trace_dropped_events().inc(0)
+        instruments.partial_collectives().inc(0)
+        instruments.straggler_promotions().inc(0)
+        instruments.excluded_rank().set(-1)
         epoch_fn = getattr(self.controller, "epoch", None)
         instruments.elastic_epoch().set(
             max(0, epoch_fn()) if callable(epoch_fn) else 0)
+        # per-rank data-plane fault point (slow@rank / flaky_slow@rank):
+        # fires once per engine tick, modelling a chronically slow worker
+        self._faults = faultinject.for_rank(state.rank0)
 
     # ------------------------------------------------------------------ API
     def start(self) -> None:
@@ -368,6 +375,12 @@ class Engine:
                 if drained is not None:
                     self._finish_drain(*drained)
                     return
+                if self._faults is not None:
+                    # slow@rank / flaky_slow@rank: a chronically slow worker
+                    # is modelled as dead time in its engine loop — the spot
+                    # a real straggler loses its time (input pipeline, GC,
+                    # noisy neighbour), upstream of the control-plane tick
+                    self._faults.fire("rank")
                 tick = self.controller.tick()
                 instruments.engine_ticks().inc()
                 now = time.monotonic()
@@ -666,6 +679,23 @@ class Engine:
                 tr.mark(e.rank, e.tensor_name, _tracing.T_WIRE_START, t_ws)
         try:
             results = self._executor.execute(resp, ebr)
+            if (resp.excluded_ranks and resp.average
+                    and resp.response_type == ResponseType.ALLREDUCE
+                    and not getattr(self._executor, "partial_aware",
+                                    False)):
+                # partial collective: the executor zero-filled the excluded
+                # slots and divided by the full world; rescale so the mean
+                # is over the n_active actual contributors. A partial_aware
+                # executor (elastic) divides by the data plane's real
+                # participant count and needs no correction.
+                import numpy as _np
+
+                n_active = self._world - len(resp.excluded_ranks)
+                if n_active > 0:
+                    f = self._world / n_active
+                    results = {
+                        r: [o * _np.asarray(f, o.dtype) for o in outs]
+                        for r, outs in results.items()}
             if tr is not None:
                 t_we = _tracing.clock.trace_us()
                 for e in entries:

@@ -106,6 +106,13 @@ class Response:
     # raced this tick, and the executor must fail fast instead of exchanging
     # data with a stale member set (docs/elastic.md)
     epoch: int = -1
+    # straggler policy (runtime/straggler.py): ranks whose contribution is
+    # ABSENT from this collective — the executor zero-fills their slots, so
+    # an averaging engine must divide by world - len(excluded_ranks) instead
+    # of world. In-memory only (set by the in-process controllers); the
+    # cross-process plane carries exclusion in the ResponseList tail and
+    # corrects the average via the data plane's participant count.
+    excluded_ranks: Optional[List[int]] = None
 
 
 @dataclass

@@ -316,3 +316,9 @@ class NativeController:
     def cache_stats(self) -> Tuple[int, int]:
         return (self._lib.hvd_core_cache_hits(self._eng),
                 self._lib.hvd_core_cache_misses(self._eng))
+
+    def excluded_ranks(self) -> frozenset:
+        """The C++ core predates the straggler policy and never excludes a
+        rank — the "absent ⇒ full participation" agreement across
+        controllers (runtime/straggler.py)."""
+        return frozenset()

@@ -7,13 +7,17 @@ suite runs the same matrix against both (see tests/test_native.py).
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .. import blackbox as _blackbox
 from ..metrics import instruments
 from ..utils.env import env_float as _env_float
 from ..utils.timeline import Timeline
 from .messages import RequestType, Response, ResponseType, TensorTableEntry
+
+logger = logging.getLogger("horovod_tpu")
 
 
 class _Meta:
@@ -77,6 +81,24 @@ class PyController:
         # membership epoch mirrors the coordinated controller's counter
         self._active_ranks: Optional[set] = None
         self._epoch = -1
+        # straggler policy (runtime/straggler.py): only meaningful when this
+        # controller negotiates for SEVERAL ranks in one process; a
+        # local-only controller sees exactly one rank's arrivals, so there
+        # is no spread to act on and the policy stays off (= the
+        # NativeController, which never emits an exclusion — the
+        # "absent ⇒ full participation" agreement across controllers)
+        self._straggler = None
+        if not local_only and world > 1:
+            from . import straggler as straggler_mod
+            self._straggler = straggler_mod.StragglerPolicy.from_env()
+        self._round = 0
+        # name -> {rank: owed} solo-completion credits: one credit per
+        # partial round negotiated without that (excluded) rank. The rank
+        # trails by as many steps as there were partial rounds, so each
+        # trailing enqueue consumes ONE credit and completes as a solo
+        # self-reduction instead of stalling forever — a set would
+        # undercount a rank that is several steps behind
+        self._skipped: Dict[str, Dict[int, int]] = {}
         import threading
         self._lock = threading.Lock()
 
@@ -97,6 +119,9 @@ class PyController:
             self._last_joined = -1
             self._active_ranks = set(ranks)
             self._epoch = epoch
+            self._skipped.clear()
+            if self._straggler is not None:
+                self._straggler.reset()
         instruments.elastic_epoch().set(max(0, epoch))
         self._timeline.epoch_marker(epoch)
         return orphans
@@ -104,6 +129,14 @@ class PyController:
     def epoch(self) -> int:
         with self._lock:
             return self._epoch
+
+    def excluded_ranks(self) -> frozenset:
+        """Ranks currently excluded by the straggler policy (empty when the
+        policy is off — same accessor across all controllers)."""
+        with self._lock:
+            if self._straggler is None:
+                return frozenset()
+            return frozenset(self._straggler.excluded)
 
     def submit(self, entry: TensorTableEntry) -> int:
         with self._lock:
@@ -215,6 +248,28 @@ class PyController:
                     "joined.")
         return None
 
+    def _observe_full_row(self, row: Dict[int, float]) -> None:
+        """Feed one full-house arrival row to the straggler policy and act
+        on its transitions (runs under self._lock). The same events the
+        coordinated controller records, so hvddoctor's chronic_straggler
+        signature works identically against both planes."""
+        pol = self._straggler
+        events = pol.observe_round(row)
+        for r in events["excluded"]:
+            logger.warning(
+                "straggler policy: excluding rank %d after %d late rounds; "
+                "collectives proceed over the surviving subgroup",
+                r, pol.patience)
+            _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
+                             "excluded episode=%d" % pol.episodes.get(r, 0))
+        for r in events["readmitted"]:
+            logger.info("straggler policy: re-admitting rank %d", r)
+            _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
+                             "readmitted")
+        if events["excluded"] or events["readmitted"]:
+            instruments.excluded_rank().set(
+                max(pol.excluded) if pol.excluded else -1)
+
     @staticmethod
     def _sig(m: _Meta):
         # compression included: quantized and plain buckets compile
@@ -233,6 +288,15 @@ class PyController:
                 active = self._active_ranks - self._joined
             else:
                 active = set(range(self._world)) - self._joined
+            # straggler policy: negotiate over the surviving subgroup; the
+            # excluded rank's slot zero-fills in the executor (Join-op
+            # semantics) and the engine rescales the average by
+            # world / n_active (see Engine._perform_resp)
+            full_house = set(active)
+            excl: set = set()
+            if self._straggler is not None and self._straggler.excluded:
+                excl = set(self._straggler.excluded) & active
+                active = active - excl or active
 
             join_released: List[int] = []
             last_joined = -1
@@ -259,7 +323,26 @@ class PyController:
                 st = self._table.get(name)
                 if st is None:
                     continue
-                if active <= set(st.keys()):
+                have = set(st.keys())
+                if (self._straggler is not None
+                        and len(full_house) > 1 and full_house <= have):
+                    # a full arrival row (excluded ranks included — their
+                    # lateness IS the measurement) feeds the policy once
+                    self._round += 1
+                    self._observe_full_row(
+                        {r: st[r].enqueue_t for r in full_house})
+                    excl = set(self._straggler.excluded) & full_house
+                    active = full_house - excl or full_house
+                ready_now = active <= have
+                if (ready_now and excl and not full_house <= have
+                        and st[min(st)].type not in (RequestType.ALLREDUCE,
+                                                     RequestType.ADASUM)):
+                    # partial participation is a summable-gradient concept:
+                    # a gather/broadcast/alltoall slot cannot be zero-filled
+                    # without silently corrupting the result, so those ops
+                    # keep waiting for the full house
+                    ready_now = False
+                if ready_now:
                     ready.append(name)
                     if len(st) > 1:
                         # enqueue-time spread at readiness = how long the
@@ -269,6 +352,23 @@ class PyController:
                     # completed: re-arm the stall inspector so a second
                     # stall of the same tensor warns again
                     self._warned.discard(name)
+                elif (excl and have and have <= excl
+                      and all(self._skipped.get(name, {}).get(r, 0) > 0
+                              for r in have)):
+                    # trailing enqueue(s) from ranks skipped when this name
+                    # was negotiated without them: complete solo (the rank
+                    # self-reduces; docs/fault-tolerance.md caveats) instead
+                    # of stalling forever. Gated on CURRENT exclusion so a
+                    # re-admitted rank's early enqueue merges into the next
+                    # group round rather than self-reducing
+                    owed = self._skipped[name]
+                    for r in have:
+                        owed[r] -= 1
+                        if owed[r] <= 0:
+                            del owed[r]
+                    if not owed:
+                        del self._skipped[name]
+                    ready.append(name)
                 else:
                     waited = now - min(m.enqueue_t for m in st.values())
                     missing = sorted(active - set(st.keys()))
@@ -325,10 +425,19 @@ class PyController:
                     handle_pairs.append(pairs)
                     continue
                 e0 = st[min(st)]
-                singles.append((name, e0, pairs))
+                # ranks absent from this collective (straggler exclusion or
+                # a trailing solo completion): the executor zero-fills their
+                # slots, the engine rescales the average (messages.py)
+                miss = frozenset((active | excl) - set(st))
+                skipped = miss & excl
+                if skipped:
+                    owed = self._skipped.setdefault(name, {})
+                    for r in skipped:
+                        owed[r] = owed.get(r, 0) + 1
+                singles.append((name, e0, pairs, miss))
 
             used = [False] * len(singles)
-            for i, (name, e0, pairs) in enumerate(singles):
+            for i, (name, e0, pairs, miss) in enumerate(singles):
                 if used[i]:
                     continue
                 used[i] = True
@@ -346,6 +455,10 @@ class PyController:
                             continue
                         if (singles[j][1].fusable
                                 and self._sig(singles[j][1]) == self._sig(e0)
+                                # never fuse across contributor sets: a rank
+                                # with entries for only HALF a bucket would
+                                # pack a short (wrong-offset) buffer
+                                and singles[j][3] == miss
                                 and total + singles[j][1].nbytes
                                 <= self._threshold):
                             used[j] = True
@@ -354,6 +467,9 @@ class PyController:
                 resp = Response(ResponseType(int(e0.type)),
                                 [singles[k][0] for k in bucket],
                                 average=e0.average)
+                if miss:
+                    resp.excluded_ranks = sorted(miss)
+                    instruments.partial_collectives().inc()
                 resp.prescale = e0.prescale
                 resp.postscale = e0.postscale
                 resp.root_rank = e0.root_rank

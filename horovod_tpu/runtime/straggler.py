@@ -1,0 +1,213 @@
+"""Deadline-based straggler policy (docs/fault-tolerance.md).
+
+The observability stack already *measures* the straggler problem —
+``hvd_straggler_skew_seconds``, hvdprof per-rank skew, the anomaly-watch
+repeat-straggler signal — but nothing acts on it: one persistently slow
+rank sets the step time for the whole pod. :class:`StragglerPolicy` is the
+acting half, hosted by the rank-0 negotiation state machine (elastic
+``CoordState``) and by the in-process ``PyController``:
+
+* every completed barrier round feeds per-rank arrival times into
+  :meth:`observe_round`; a rank whose lateness exceeds
+  ``HOROVOD_STRAGGLER_DEADLINE`` (absolute seconds, or ``Nx`` = N times the
+  median lateness of its peers) for ``HOROVOD_STRAGGLER_PATIENCE``
+  consecutive rounds is marked **excluded**;
+* while excluded, barriers complete over the surviving subgroup (the
+  generalization of the Join op's proceed-without-a-rank semantics,
+  `controller.cc:202-256`) and the data plane averages over ``1/n_active``;
+  the late rank trails, fetching each round's response after the fact, and
+  its gradient contributions accumulate into an error-feedback residual
+  (elastic/executor.py) so no gradient mass is silently dropped;
+* an excluded rank that keeps pace again for ``patience`` consecutive
+  rounds is re-admitted (hysteresis: its violation counter restarts from
+  zero, so re-exclusion needs a full fresh patience run);
+* an excluded rank that falls more than ``HOROVOD_STRAGGLER_MAX_SKIP``
+  rounds behind the negotiation frontier is **escalated**: the caller
+  declares it lost (``rank_lost``) and, when an elastic driver is
+  attached, blacklists its host so a hot spare is promoted at the next
+  commit boundary (run/elastic_driver.py).
+
+The policy itself is a pure state machine — no locks, no metrics, no
+side effects. Callers drive it under their own negotiation lock and act
+on the returned transition events, which keeps all three controllers'
+exclusion semantics identical and the whole thing unit-testable without
+a cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Set
+
+#: relative mode's noise floor (seconds): with ``Nx`` the violation
+#: threshold is ``N * max(median peer lateness, floor)``, so tiny absolute
+#: spreads on an idle or 2-rank job (where the peer median is 0 by
+#: construction — the fastest rank's lateness is always 0) never exclude
+RELATIVE_FLOOR_S = 0.05
+
+DEFAULT_PATIENCE = 3
+DEFAULT_MAX_SKIP = 50
+
+
+def _parse_deadline(raw: str):
+    """``"3x"`` -> (None, 3.0) relative; ``"2.5"`` -> (2.5, None) absolute.
+    Raises ValueError on garbage so a typo fails loudly at init, not as a
+    policy that silently never fires."""
+    text = raw.strip().lower()
+    if text.endswith("x"):
+        mult = float(text[:-1])
+        if mult <= 0:
+            raise ValueError(
+                f"HOROVOD_STRAGGLER_DEADLINE={raw!r}: multiplier must be > 0")
+        return None, mult
+    abs_s = float(text)
+    if abs_s <= 0:
+        raise ValueError(
+            f"HOROVOD_STRAGGLER_DEADLINE={raw!r}: deadline must be > 0")
+    return abs_s, None
+
+
+class StragglerPolicy:
+    """Deadline/patience/hysteresis state machine over barrier arrivals.
+
+    Not thread-safe by design: the owning controller already serializes
+    every observation and decision under its negotiation lock.
+    """
+
+    def __init__(self, deadline_s: Optional[float],
+                 multiplier: Optional[float],
+                 patience: int = DEFAULT_PATIENCE,
+                 max_skip: int = DEFAULT_MAX_SKIP):
+        if (deadline_s is None) == (multiplier is None):
+            raise ValueError("exactly one of deadline_s/multiplier required")
+        self.deadline_s = deadline_s
+        self.multiplier = multiplier
+        self.patience = max(1, int(patience))
+        self.max_skip = max(1, int(max_skip))
+        self.excluded: Set[int] = set()
+        # per-rank exclusion episode count, kept across readmits — the
+        # chronic_straggler doctor signature's ">= N times" evidence
+        self.episodes: Dict[int, int] = {}
+        self._violations: Dict[int, int] = {}  # consecutive late rounds
+        self._ok_rounds: Dict[int, int] = {}   # consecutive on-time rounds
+        self._last_seq: Dict[int, int] = {}    # last barrier seq deposited
+
+    # ------------------------------------------------------------- factory
+    @classmethod
+    def from_env(cls) -> Optional["StragglerPolicy"]:
+        """The policy iff ``HOROVOD_STRAGGLER_DEADLINE`` is set; None keeps
+        every control-plane byte identical to a policy-less build (the wire
+        pin test's guarantee)."""
+        raw = os.environ.get("HOROVOD_STRAGGLER_DEADLINE", "").strip()
+        if not raw:
+            return None
+        deadline_s, multiplier = _parse_deadline(raw)
+        return cls(
+            deadline_s, multiplier,
+            patience=int(float(os.environ.get(
+                "HOROVOD_STRAGGLER_PATIENCE", DEFAULT_PATIENCE))),
+            max_skip=int(float(os.environ.get(
+                "HOROVOD_STRAGGLER_MAX_SKIP", DEFAULT_MAX_SKIP))))
+
+    # ------------------------------------------------------------ plumbing
+    def note_deposit(self, rank: int, seq: int) -> None:
+        """Record a rank's latest barrier deposit (its negotiation
+        frontier); :meth:`on_negotiate` escalates when an excluded rank's
+        frontier trails the round being negotiated by more than max_skip."""
+        if seq > self._last_seq.get(rank, -1):
+            self._last_seq[rank] = seq
+
+    def threshold_for(self, rank: int,
+                      lateness: Dict[int, float]) -> float:
+        """This round's violation threshold for ``rank``: the absolute
+        deadline, or multiplier x median of the OTHER ranks' lateness
+        (floored) — median-of-peers so the straggler's own lateness never
+        inflates the bar it is judged against."""
+        if self.deadline_s is not None:
+            return self.deadline_s
+        peers = sorted(v for r, v in lateness.items() if r != rank)
+        if peers:
+            mid = len(peers) // 2
+            med = (peers[mid] if len(peers) % 2
+                   else (peers[mid - 1] + peers[mid]) / 2.0)
+        else:
+            med = 0.0
+        return self.multiplier * max(med, RELATIVE_FLOOR_S)
+
+    # ------------------------------------------------------------ decisions
+    def observe_round(self, arrivals: Dict[int, float]) -> Dict[str, List[int]]:
+        """Feed one completed round's per-rank first-arrival times (every
+        member present, including currently-excluded ranks that trailed in
+        late). Returns the transition events:
+        ``{"excluded": [...], "readmitted": [...]}``."""
+        events: Dict[str, List[int]] = {"excluded": [], "readmitted": []}
+        if len(arrivals) < 2:
+            return events
+        t0 = min(arrivals.values())
+        lateness = {r: t - t0 for r, t in arrivals.items()}
+        for rank in sorted(lateness):
+            violated = lateness[rank] > self.threshold_for(rank, lateness)
+            if rank in self.excluded:
+                if violated:
+                    self._ok_rounds[rank] = 0
+                else:
+                    self._ok_rounds[rank] = self._ok_rounds.get(rank, 0) + 1
+                    if self._ok_rounds[rank] >= self.patience:
+                        self.excluded.discard(rank)
+                        # hysteresis: a readmitted rank starts clean — going
+                        # back out requires a full fresh patience run
+                        self._violations[rank] = 0
+                        self._ok_rounds.pop(rank, None)
+                        events["readmitted"].append(rank)
+            else:
+                if not violated:
+                    self._violations[rank] = 0
+                    continue
+                self._violations[rank] = self._violations.get(rank, 0) + 1
+                if (self._violations[rank] >= self.patience
+                        # never exclude down to an empty subgroup: the round
+                        # must keep at least one on-pace participant
+                        and len(self.excluded) < len(arrivals) - 1):
+                    self.excluded.add(rank)
+                    self.episodes[rank] = self.episodes.get(rank, 0) + 1
+                    self._ok_rounds[rank] = 0
+                    events["excluded"].append(rank)
+        return events
+
+    def on_negotiate(self, seq: int,
+                     members: Iterable[int]) -> List[int]:
+        """Called once per negotiated barrier round. Returns the excluded
+        ranks whose deposit frontier now trails ``seq`` by more than
+        ``max_skip`` rounds — the caller escalates those to ``rank_lost``
+        / hot-spare promotion. Rank 0 is never escalated: it hosts the
+        coordinator, so "promote its replacement" has nothing to promote
+        onto (parity with the collective-timeout loss path, which also
+        refuses to declare rank 0 dead)."""
+        mem = set(members)
+        self.excluded &= mem
+        escalate = []
+        for rank in sorted(self.excluded):
+            if rank == 0:
+                continue
+            if seq - self._last_seq.get(rank, seq) > self.max_skip:
+                escalate.append(rank)
+        for rank in escalate:
+            self.forget(rank)
+        return escalate
+
+    def forget(self, rank: int) -> None:
+        """Drop a rank's runtime state (lost or escalated). Episode counts
+        survive on purpose: chronic behavior is the history, not the
+        moment."""
+        self.excluded.discard(rank)
+        self._violations.pop(rank, None)
+        self._ok_rounds.pop(rank, None)
+        self._last_seq.pop(rank, None)
+
+    def reset(self) -> None:
+        """Membership epoch change: every barrier seq realigns and the old
+        member set's counters are meaningless. Episode history survives."""
+        self.excluded.clear()
+        self._violations.clear()
+        self._ok_rounds.clear()
+        self._last_seq.clear()

@@ -398,7 +398,8 @@ def encode_response_list(flags: int, last_joined: int,
                          tuned: Optional[Tuple] = None,
                          epoch: int = -1,
                          members: Optional[List[int]] = None,
-                         invalid_ids: Optional[List[int]] = None) -> bytes:
+                         invalid_ids: Optional[List[int]] = None,
+                         excluded: Optional[List[int]] = None) -> bytes:
     """``cache_assignments[i]`` parallels ``responses[i].tensor_names``:
     coordinator-assigned cache id per tensor (-1 = uncached).
     ``shutdown_reason`` distinguishes a normal end-of-job shutdown (empty)
@@ -409,7 +410,11 @@ def encode_response_list(flags: int, last_joined: int,
     ordinary ticks keeps old decoders byte-compatible. ``invalid_ids`` are
     cache ids submitted this tick that the coordinator no longer recognizes
     (LRU-evicted or stall-invalidated): holders must drop the id and
-    resubmit full metadata."""
+    resubmit full metadata. ``excluded`` lists ranks the straggler policy
+    has marked out of the barrier (runtime/straggler.py); the block is
+    written ONLY when non-empty, so with the policy disabled (or simply
+    nothing excluded) the frame stays byte-identical to the pre-straggler
+    wire — pinned by test_straggler's golden-hex check."""
     w = Writer()
     w.u8(flags)
     w.str(shutdown_reason)
@@ -461,6 +466,13 @@ def encode_response_list(flags: int, last_joined: int,
     w.u32(0 if invalid_ids is None else len(invalid_ids))
     for cid in (invalid_ids or ()):
         w.i32(cid)
+    # straggler exclusion: optional trailing block, written only when a rank
+    # is actually excluded (same absent-means-absent discipline as the tuned
+    # flag byte above; old decoders never see it)
+    if excluded:
+        w.u32(len(excluded))
+        for r in excluded:
+            w.i32(r)
     return w.getvalue()
 
 
@@ -513,8 +525,11 @@ def decode_response_list(buf: bytes):
     invalid_ids: List[int] = []
     if rd.remaining() >= 4:
         invalid_ids = [rd.i32() for _ in range(rd.u32())]
+    excluded: List[int] = []
+    if rd.remaining() >= 4:
+        excluded = [rd.i32() for _ in range(rd.u32())]
     return (flags, last_joined, responses, assignments, warnings,
-            shutdown_reason, tuned, epoch, members, invalid_ids)
+            shutdown_reason, tuned, epoch, members, invalid_ids, excluded)
 
 
 # --------------------------------------------------------------------------

@@ -54,7 +54,7 @@ class TestMembershipEpochs:
         # barrier the current member set can never complete
         out = st.exchange(0, 0, req([meta("g")], epoch=0))
         (flags, _, _, _, _, reason, _, epoch,
-         members, _) = wire.decode_response_list(out)
+         members, _, _) = wire.decode_response_list(out)
         assert flags & wire.RESP_RANKS_CHANGED
         assert epoch == 1
         assert members == [0]
@@ -103,7 +103,7 @@ class TestMembershipEpochs:
         assert st.members == {0, 1, 2}
         assert st.epoch == 1
         for rank in (0, 1, 2):
-            flags, _, _, _, _, _, _, epoch, members, _ = \
+            flags, _, _, _, _, _, _, epoch, members, _, _ = \
                 wire.decode_response_list(out[rank])
             assert flags & wire.RESP_RANKS_CHANGED
             assert epoch == 1 and members == [0, 1, 2]
@@ -124,7 +124,7 @@ class TestMembershipEpochs:
         st.rank_lost(1, "gone")
         out = st.exchange(
             0, 1, req([meta("b", rtype=BROADCAST, root_rank=1)], epoch=1))
-        _, _, resps, _, _, _, _, _, _, _ = wire.decode_response_list(out)
+        _, _, resps, _, _, _, _, _, _, _, _ = wire.decode_response_list(out)
         assert "Invalid root rank 1" in resps[0].error_message
 
 

@@ -194,7 +194,7 @@ def test_wire_request_response_roundtrip_randomized():
                                         tuned=tuned, epoch=epoch,
                                         members=members, invalid_ids=invalid)
         (f2, last2, r2, c2, w2, reason2, t2,
-         e2, m2, inv2) = wire.decode_response_list(buf)
+         e2, m2, inv2, _excl2) = wire.decode_response_list(buf)
         assert (f2, reason2, last2, w2, t2) == (3, reason, -1, warns, tuned)
         assert (e2, m2) == (epoch, members)
         assert inv2 == invalid
