@@ -18,6 +18,22 @@ Run:
 
 Prints one JSON line per world size; final line is the summary
 {"metric": "weak_scaling_efficiency", ...} with efficiency at the largest n.
+
+With ``--history PATH`` the summary appends to the schema-versioned JSONL
+perf store (benchmarks/history.py); ``--check-regression`` compares the
+run against the recorded trajectory BEFORE appending and exits 3 below
+the tolerance floor — the same gate allreduce_bench/lm_bench/coord_bench
+carry.
+
+``--three-way`` switches to the quantized-GSPMD head-to-head instead
+(docs/gspmd.md): the same linear-regression step on (a) the coordinator
+wire (eager engine, int8 + error feedback), (b) plain GSPMD
+(`spmd.make_train_step`, raw f32 collectives), and (c) the quantized
+GSPMD ring (`HOROVOD_GSPMD_WIRE` int8 and int4) — one JSON line per arm
+with step time, algorithmic bandwidth, and exact-vs-wire bytes, all read
+from the one footprint catalog (`ops/compression.py` +
+hvd_wire_bytes_total). Asserts the acceptance floors: int4 wire bytes
+<= 60% of plain GSPMD, int8 <= 1.05 bytes per moved element.
 """
 
 from __future__ import annotations
@@ -131,6 +147,137 @@ def run_one(n, batch_per_device, image_size, iters, warmup, model_name):
     return rates[0], rates[1]
 
 
+def run_three_way(elements, iters, warmup, batch_per_device=8):
+    """The quantized-GSPMD head-to-head (ROADMAP item 1, docs/gspmd.md).
+
+    One [elements]-parameter linear-regression step on every arm, so the
+    gradient traffic is exactly ``elements`` f32 values per step and the
+    byte columns are directly comparable. Step times are honest wall
+    clocks but the arms differ structurally (the coordinator arm computes
+    the full batch on the eager path; the GSPMD arms shard it), so the
+    byte ratios — not the CPU-contended step times — are the acceptance
+    numbers. Returns the list of per-arm result rows.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.basics import MESH_AXIS
+    from horovod_tpu.metrics import instruments
+    from horovod_tpu.ops import compression as comp
+
+    n = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), (MESH_AXIS,))
+    rng = np.random.RandomState(0)
+    batch = batch_per_device * n
+    # 1/sqrt(d) feature scale keeps y ~ N(0,1) so the loss column stays
+    # readable at any --elements
+    x = rng.randn(batch, elements).astype(np.float32) / np.sqrt(elements)
+    target = rng.randn(elements).astype(np.float32)
+    y = x @ target
+    params0 = {"w": jnp.zeros((elements,), jnp.float32)}
+
+    def loss_fn(p, b):
+        xb, yb = b
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    results = []
+
+    def report(arm, wire_label, step_s, wire_b, exact_b, loss):
+        row = {"arm": arm, "wire": wire_label,
+               "step_ms": round(1e3 * step_s, 3),
+               "wire_bytes_per_step": int(wire_b),
+               "exact_bytes_per_step": int(exact_b),
+               "wire_ratio": round(wire_b / exact_b, 4) if exact_b else 0.0,
+               "algbw_exact_gbps":
+                   round(exact_b / step_s / 1e9, 4) if step_s else 0.0,
+               "loss": round(float(loss), 4)}
+        print(json.dumps(row))
+        results.append(row)
+        return row
+
+    # arm 1: coordinator wire — eager engine path, int8 + error feedback;
+    # bytes from the coordinator catalog (wire_footprint, per rank,
+    # world-independent)
+    dist = hvd.DistributedOptimizer(optax.sgd(0.05),
+                                    compression=comp.Int8Compressor,
+                                    error_feedback=True)
+    p = {"w": jnp.zeros((elements,), jnp.float32)}
+    o = dist.init(p)
+    gfn = jax.jit(jax.value_and_grad(loss_fn))
+    xb, yb = jnp.asarray(x), jnp.asarray(y)
+
+    def coord_step(p, o):
+        loss, g = gfn(p, (xb, yb))
+        u, o = dist.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    loss = None
+    for _ in range(warmup):
+        p, o, loss = coord_step(p, o)
+    jax.block_until_ready(p["w"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, o, loss = coord_step(p, o)
+    jax.block_until_ready(p["w"])
+    report("coordinator", "int8", (time.perf_counter() - t0) / iters,
+           comp.wire_footprint(elements, "int8"),
+           comp.wire_footprint(elements, "none"), loss)
+
+    # arm 2: plain GSPMD — raw f32 ring inserted by the partitioner
+    data = spmd.shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+    plain_bytes = comp.gspmd_wire_footprint(elements, "none", n)
+
+    def run_gspmd(arm, compression):
+        tx = optax.sgd(0.05)
+        step = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False,
+                                    compression=compression)
+        p = spmd.replicate(params0, mesh)
+        if compression in (None, "off"):
+            o = spmd.replicate(tx.init(params0), mesh)
+            wire_label, counter = "fp32", None
+        else:
+            o = spmd.quantized_opt_state(tx, params0, mesh)
+            wire_label = spmd.gspmd_wire(compression)  # gate may downgrade
+            counter = instruments.wire_bytes().labels(
+                compression=f"gspmd-{wire_label}")
+        loss = None
+        for _ in range(warmup):
+            p, o, loss = step(p, o, data)
+        jax.block_until_ready(loss)
+        before = counter.value if counter else 0.0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, loss = step(p, o, data)
+        jax.block_until_ready(loss)
+        step_s = (time.perf_counter() - t0) / iters
+        if counter:  # truthful accounting: read back the instrument
+            wire_b = (counter.value - before) / iters
+        else:
+            wire_b = plain_bytes
+        return report(arm, wire_label, step_s, wire_b, plain_bytes, loss)
+
+    run_gspmd("gspmd", "off")
+    q8 = run_gspmd("gspmd-int8", "int8")
+    q4 = run_gspmd("gspmd-int4", "int4")
+
+    # acceptance floors (ISSUE 13): int4 <= 60% of the plain GSPMD wire;
+    # int8 <= 1.05 bytes per exact element moved (scale overhead included)
+    int8_per_elem = 4.0 * q8["wire_bytes_per_step"] / plain_bytes
+    summary = {"metric": "gspmd_wire_ratio",
+               "int4_vs_plain": round(
+                   q4["wire_bytes_per_step"] / plain_bytes, 4),
+               "int8_bytes_per_elem": round(int8_per_elem, 4),
+               "devices": n, "elements": elements}
+    print(json.dumps(summary))
+    assert q4["wire_bytes_per_step"] <= 0.6 * plain_bytes, summary
+    assert int8_per_elem <= 1.05, summary
+    return results
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="ResNet18",
@@ -141,6 +288,26 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--world-sizes", default=None,
                     help="comma-separated; default 1,2,4,... up to all devices")
+    ap.add_argument("--three-way", action="store_true",
+                    help="coordinator wire vs plain GSPMD vs quantized "
+                         "GSPMD head-to-head instead of the scaling ladder "
+                         "(docs/gspmd.md)")
+    ap.add_argument("--elements", type=int, default=262144,
+                    help="gradient elements for --three-way (default 256k)")
+    ap.add_argument("--history", metavar="PATH", default=None,
+                    help="append the weak-scaling summary to a "
+                         "schema-versioned JSONL perf history "
+                         "(benchmarks/history.py)")
+    ap.add_argument("--check-regression", action="store_true",
+                    help="with --history: compare this run against the "
+                         "recorded trajectory BEFORE appending; exit 3 "
+                         "when it falls below the tolerance floor")
+    ap.add_argument("--regression-window", type=int, default=None,
+                    metavar="N", help="trailing records the baseline "
+                                      "median uses (default 5)")
+    ap.add_argument("--regression-tolerance", type=float, default=None,
+                    metavar="F", help="fraction below baseline that fails "
+                                      "(default 0.15)")
     args = ap.parse_args(argv)
 
     # under hvdrun (HVD_COORDINATOR_ADDR set) this wires
@@ -153,6 +320,15 @@ def main(argv=None):
 
     import jax
     on_tpu = jax.default_backend() == "tpu"
+    if args.three_way:
+        if hvd.size() > 1:
+            raise SystemExit(
+                "--three-way is single-controller only: the coordinator arm "
+                "runs the eager engine in-process and the GSPMD arms span "
+                "all local devices — run it standalone, not under hvdrun")
+        return run_three_way(args.elements,
+                             args.iters or (20 if on_tpu else 5),
+                             args.warmup)
     if hvd.size() > 1:
         # multi-controller: every process must participate in every jitted
         # program, so a sub-world mesh (devices[:n] for n < all) is invalid
@@ -211,6 +387,38 @@ def main(argv=None):
                                  "backend": jax.default_backend(),
                                  "shared_core_virtual_devices":
                                      shared_cores}}))
+
+    if args.history:
+        from benchmarks.history import (append_record, check_regression,
+                                        load_history)
+
+        # compare against the trajectory BEFORE appending: today's run
+        # must not be allowed to vote in its own baseline
+        verdict = None
+        if args.check_regression:
+            verdict = check_regression(
+                load_history(args.history, metric="weak_scaling_efficiency"),
+                headline,
+                **{k: v for k, v in (
+                    ("window", args.regression_window),
+                    ("tolerance", args.regression_tolerance))
+                   if v is not None})
+            print("# regression check: %s" % json.dumps(verdict),
+                  file=sys.stderr)
+        append_record(args.history, {
+            "metric": "weak_scaling_efficiency",
+            "value": round(headline, 1), "unit": "%",
+            "model": args.model, "max_devices": n_max,
+            "batch_per_device": bpd, "backend": jax.default_backend(),
+            "shared_core_virtual_devices": shared_cores,
+        })
+        print(f"# perf history appended to {args.history}", file=sys.stderr)
+        if verdict and verdict["regression"]:
+            print(f"# REGRESSION: weak_scaling_efficiency = "
+                  f"{round(headline, 1)} fell below the floor "
+                  f"{verdict['floor']} (baseline {verdict['baseline']} "
+                  f"over {verdict['samples']} runs)", file=sys.stderr)
+            raise SystemExit(3)
     return rates
 
 

@@ -69,6 +69,15 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/allreduce_bench.py --compression int8,int4,adaptive \
         --sizes-mb 0.25 --iters 3
 
+stage "gspmd: quantized compiled-path ring, EF residual, cache-key pin"
+python -m pytest tests/test_gspmd.py -q
+# acceptance: three-way head-to-head (coordinator wire vs plain GSPMD vs
+# quantized GSPMD) — asserts int4 wire bytes <=60% of plain and int8
+# <=1.05 B per moved element (docs/gspmd.md)
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/scaling_bench.py --three-way --iters 3 \
+        --elements 65536
+
 stage "serving: continuous batching, paged KV cache, elastic pod serving"
 python -m pytest tests/test_serving.py -q -m "not integration"
 # in-process load bench (deterministic perf-gate mode); exit 4 on any

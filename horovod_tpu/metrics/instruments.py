@@ -73,20 +73,25 @@ def wire_bytes():
     return get_registry().counter(
         "hvd_wire_bytes_total",
         "Collective payload bytes this rank put on the wire, after "
-        "compression.", labels=("compression",))
+        "compression — both data planes: the coordinator wire (engine "
+        "path) and the compiled GSPMD ring "
+        "(compression=\"gspmd-int8\"/\"gspmd-int4\", spmd.py; "
+        "docs/gspmd.md).", labels=("compression",))
 
 
 def wire_bytes_exact():
     return get_registry().counter(
         "hvd_wire_bytes_exact_total",
         "Collective payload bytes the same traffic would have cost "
-        "uncompressed (ratio denominator).")
+        "uncompressed (ratio denominator; covers the coordinator wire "
+        "and the GSPMD ring).")
 
 
 def quantization_ratio():
     return get_registry().gauge(
         "hvd_quantization_ratio",
-        "Running wire-bytes / exact-bytes ratio (1.0 = no compression win).",
+        "Running wire-bytes / exact-bytes ratio (1.0 = no compression "
+        "win), over both the coordinator wire and the GSPMD ring.",
         agg="max")
 
 

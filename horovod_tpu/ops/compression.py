@@ -156,6 +156,34 @@ def wire_footprint(num_elements: int, mode: str,
     raise ValueError(f"unknown compression mode {mode!r}")
 
 
+def gspmd_wire_footprint(num_elements: int, mode: str, world: int,
+                         block: int | None = None) -> int:
+    """Bytes ONE rank puts on the wire for one ring allreduce on the
+    compiled path (`spmd.quantized_allreduce`: reduce-scatter +
+    all-gather, each phase ``world - 1`` hops of one chunk).
+
+    Quantized modes move packed rows — ``[block payload | 4 scale bytes]``
+    for int8, ``[block//2 | 4]`` for int4 — over a chunk rounded up to
+    whole blocks. ``none``/``fp32`` (``bf16``/``fp16``) count the plain
+    GSPMD ring moving raw 4-byte (2-byte) elements with no scale overhead:
+    the exact-wire denominator behind ``hvd_quantization_ratio`` and the
+    three-way `scaling_bench`. The ZeRO-1 variant (gradient reduce-scatter
+    + update all-gather) moves the same total. ``world == 1`` is wireless.
+    """
+    if world <= 1:
+        return 0
+    per_elem = {"none": 4, "fp32": 4, "fp16": 2, "bf16": 2}.get(mode)
+    if per_elem is not None:
+        return 2 * (world - 1) * -(-num_elements // world) * per_elem
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown GSPMD wire mode {mode!r}")
+    block = block or block_size()
+    per_rank = -(-num_elements // world)
+    rows = -(-per_rank // block)
+    row_bytes = (block if mode == "int8" else block // 2) + 4
+    return 2 * (world - 1) * rows * row_bytes
+
+
 class Compressor:
     """Interface: compress before enqueue, decompress after completion.
 

@@ -22,6 +22,7 @@ Two usage levels:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Optional
 
 import jax
@@ -138,6 +139,210 @@ def adasum(x, axis: str = MESH_AXIS):
     return flat[0].reshape(x.shape).astype(x.dtype)
 
 
+# ------------------------------------------- quantized ring (GSPMD wire)
+# The EQuARX move (PAPERS.md arXiv:2506.17615): quantized allreduce INSIDE
+# the compiled program. The same ppermute ring as `matmul_reduce_scatter`
+# above, but every hop ships the fused int8/int4 quantize+pack rows from
+# `ops/pallas_kernels.py` instead of raw f32 — the PR 10 wire footprints
+# (int4 = 50.8% of int8 bytes) finally applied to the GSPMD plane, which
+# until now moved raw bf16/f32 while all the bandwidth wins sat on the
+# coordinator path. See docs/gspmd.md.
+
+_GSPMD_WIRES = ("int8", "int4")
+
+
+def gspmd_wire(value: Optional[str] = None) -> str:
+    """Resolve the compiled-path wire mode (``HOROVOD_GSPMD_WIRE``).
+
+    Returns ``""`` (wire off — the exact GSPMD program), ``"int8"`` or
+    ``"int4"``. ``value`` overrides the env var (the
+    ``make_train_step(compression=...)`` argument). int4 must be admitted
+    by the PR 10 ``ConvergenceGate`` first — a refused gate downgrades to
+    int8 rather than risking the 4-bit grid on a model the deterministic
+    A/B harness couldn't converge (`ops/adaptive.py`).
+    """
+    v = os.environ.get("HOROVOD_GSPMD_WIRE", "") if value is None else value
+    v = (v or "").strip().lower()
+    if v in ("", "0", "off", "none"):
+        return ""
+    if v not in _GSPMD_WIRES:
+        raise ValueError(
+            f"HOROVOD_GSPMD_WIRE must be int8|int4|off, got {v!r}")
+    if v == "int4":
+        from .ops.adaptive import ConvergenceGate
+
+        if not ConvergenceGate.shared().allows("int4"):
+            return "int8"
+    return v
+
+
+def _wire_block(block: Optional[int]) -> int:
+    from .ops import compression as comp
+
+    return int(block or comp.block_size())
+
+
+def _pack_fns(wire: str):
+    from .ops import pallas_kernels as pk
+
+    if wire == "int4":
+        return pk.int4_quantize_pack, pk.int4_unpack
+    return pk.int8_quantize_pack, pk.int8_unpack
+
+
+def _ring_chunk(num_elements: int, world: int, block: int) -> int:
+    """Per-rank chunk length: ceil(n/world) rounded up to whole blocks, so
+    every hop's packed rows are [chunk//block, block+scale] with no ragged
+    tail inside the ring."""
+    per_rank = -(-num_elements // world)
+    return -(-per_rank // block) * block
+
+
+def _wire_eligible(num_elements: int, dtype, wire: str, block: int) -> bool:
+    """Static (trace-time) gate for the quantized path: float payload, at
+    least one quantization block (below that the scale overhead and ring
+    latency beat the savings — the HOROVOD_COMPRESSION_MIN_SIZE rationale),
+    and an even block for the int4 nibble split."""
+    return (wire in _GSPMD_WIRES
+            and jnp.issubdtype(dtype, jnp.floating)
+            and num_elements >= block
+            and not (wire == "int4" and block % 2))
+
+
+def quantized_reduce_scatter(x, axis: str = MESH_AXIS, wire: str = "int8",
+                             block: Optional[int] = None):
+    """Ring reduce-scatter with a quantized wire; call inside shard_map.
+
+    ``x`` is this rank's local contribution (any float shape; flattened and
+    zero-padded to ``world * chunk`` with ``chunk = _ring_chunk(...)``).
+    Returns the 1-D f32 chunk of the cross-rank sum this rank owns (global
+    chunk ``p`` of the padded flat sum). Rank p seeds its accumulator with
+    local chunk (p-1) mod m; each of the m-1 hops quantize+packs the
+    accumulator ([rows, block] -> [rows, block+4] int8 rows, or the int4
+    half-split nibble rows), rotates the packed bytes one rank forward via
+    ppermute, dequantizes, and adds the local chunk (p-k-1) mod m — so
+    after the last hop rank p holds chunk p summed over every rank, and
+    every hop moved packed bytes instead of raw f32. ``wire`` values
+    outside int8/int4 run the identical ring schedule with raw f32 hops
+    (the exact-wire reference).
+    """
+    m = jax.lax.psum(1, axis)
+    block = _wire_block(block)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    num = flat.shape[0]
+    if wire in _GSPMD_WIRES:
+        chunk = _ring_chunk(num, m, block)
+    else:
+        chunk = -(-num // m)
+    pad = m * chunk - num
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    if m == 1:
+        return flat
+    p = jax.lax.axis_index(axis)
+
+    def local_chunk(k):
+        idx = jnp.mod(p - k - 1, m)
+        return jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+    perm = [(j, (j + 1) % m) for j in range(m)]
+    acc = local_chunk(0)
+    if wire not in _GSPMD_WIRES:
+        for k in range(1, m):
+            acc = jax.lax.ppermute(acc, axis, perm) + local_chunk(k)
+        return acc
+    pack, unpack = _pack_fns(wire)
+    for k in range(1, m):
+        wired = jax.lax.ppermute(pack(acc.reshape(-1, block)), axis, perm)
+        q, scales = unpack(wired)
+        acc = (q.astype(jnp.float32) * scales).reshape(-1) + local_chunk(k)
+    return acc
+
+
+def quantized_all_gather(chunk, axis: str = MESH_AXIS, wire: str = "int8",
+                         block: Optional[int] = None):
+    """Ring all-gather of per-rank 1-D chunks with a quantized wire.
+
+    Each rank quantize+packs its own chunk ONCE and the packed bytes make
+    m-1 hops around the ring; every rank — including the owner —
+    reconstructs each chunk from the same packed rows, so the gathered
+    [m * chunk] result is bit-identical on every rank (the property the
+    replicated-params invariant rests on). ``wire`` outside int8/int4
+    falls back to the exact tiled all_gather.
+    """
+    m = jax.lax.psum(1, axis)
+    flat = jnp.ravel(chunk).astype(jnp.float32)
+    if m == 1:
+        return flat
+    if wire not in _GSPMD_WIRES:
+        return jax.lax.all_gather(flat, axis, tiled=True)
+    block = _wire_block(block)
+    num = flat.shape[0]
+    pad = (-num) % block
+    padded = jnp.pad(flat, (0, pad)) if pad else flat
+    pack, unpack = _pack_fns(wire)
+    p = jax.lax.axis_index(axis)
+    perm = [(j, (j + 1) % m) for j in range(m)]
+    cur = pack(padded.reshape(-1, block))
+    out = jnp.zeros((m * num,), jnp.float32)
+    for k in range(m):
+        q, scales = unpack(cur)
+        val = (q.astype(jnp.float32) * scales).reshape(-1)[:num]
+        idx = jnp.mod(p - k, m)
+        out = jax.lax.dynamic_update_slice_in_dim(out, val, idx * num, 0)
+        if k + 1 < m:
+            cur = jax.lax.ppermute(cur, axis, perm)
+    return out
+
+
+def quantized_allreduce(x, op: int = Average, axis: str = MESH_AXIS,
+                        wire: Optional[str] = None,
+                        block: Optional[int] = None):
+    """Allreduce whose wire rides the quantized ring; call inside shard_map.
+
+    Composition of :func:`quantized_reduce_scatter` and
+    :func:`quantized_all_gather`: every hop of both phases moves int8/int4
+    packed rows, so the whole reduction costs the PR 10 wire footprints
+    inside the compiled program. The result is bit-identical on every rank
+    (averaging divides the identical gathered sum). Falls back to the
+    exact :func:`allreduce` when the wire is off, the payload is not
+    floating-point, or the flat size is under one quantization block
+    (non-lane-aligned / tiny tensors — see ``_wire_eligible``).
+
+    ``wire=None`` resolves ``HOROVOD_GSPMD_WIRE`` at trace time
+    (:func:`gspmd_wire`, including the int4 convergence-gate admission).
+    """
+    wire = gspmd_wire(wire)
+    if op == Adasum:
+        raise NotImplementedError(
+            "the quantized GSPMD wire does not support Adasum; use "
+            "spmd.adasum (exact) instead")
+    block = _wire_block(block)
+    if not _wire_eligible(x.size, x.dtype, wire, block):
+        return allreduce(x, op, axis)
+    m = jax.lax.psum(1, axis)
+    chunk = quantized_reduce_scatter(x, axis, wire, block)
+    flat = quantized_all_gather(chunk, axis, wire, block)[:x.size]
+    if op == Average:
+        flat = flat / m
+    return flat.reshape(x.shape).astype(x.dtype)
+
+
+def _wire_roundtrip(flat, wire: str, block: int):
+    """The value one quantized hop delivers for a local contribution — the
+    EF-SGD numerator, same absmax/qmax block math as
+    ``ops/compression.py quantize_blocks`` (pure: no metric side effects,
+    safe inside the traced step)."""
+    from .ops import compression as comp
+
+    num = flat.shape[0]
+    pad = (-num) % block
+    padded = jnp.pad(flat, (0, pad)) if pad else flat
+    q, scales = comp.quantize_blocks(padded, block,
+                                     bits=4 if wire == "int4" else 8)
+    return comp.dequantize_blocks(q, scales, jnp.float32, block)[:num]
+
+
 # ------------------------------------------------------------ whole-step API
 def replica_mesh() -> Mesh:
     return basics.mesh()
@@ -167,7 +372,8 @@ def replicate(tree, mesh: Optional[Mesh] = None):
 
 def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
                     donate: bool = True, zero1: bool = False,
-                    example_opt_state=None) -> Callable:
+                    example_opt_state=None,
+                    compression: Optional[str] = None) -> Callable:
     """Build the jitted data-parallel train step (the bench hot loop).
 
     ``loss_fn(params, batch) -> scalar loss`` computed on the *local* shard;
@@ -181,8 +387,21 @@ def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
     ``tx.init(params)`` pytree) so the per-leaf shardings can be derived,
     and place the live state with :func:`optim.zero.shard_opt_state` before
     the first call.
+
+    ``compression`` selects the quantized GSPMD wire (``"int8"``/``"int4"``;
+    ``None`` resolves ``HOROVOD_GSPMD_WIRE``, ``"off"`` forces the exact
+    wire). When a wire engages, the step runs as an explicit shard_map
+    program whose gradient reduction rides the quantized ppermute ring with
+    an error-feedback residual carried as an extra optimizer-state leaf —
+    build the state with :func:`quantized_opt_state`, and see docs/gspmd.md.
+    With the wire off, this function compiles the exact same program as
+    before the knob existed (the cache-key pin tested in tests/test_gspmd.py).
     """
     import optax
+
+    wire = gspmd_wire(compression)
+    if wire:
+        return _make_quantized_step(loss_fn, tx, mesh, donate, zero1, wire)
 
     mesh = mesh or basics.mesh()
     repl = NamedSharding(mesh, P())
@@ -208,3 +427,178 @@ def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
         donate_argnums=donate_argnums,
         out_shardings=(repl, opt_sh, repl),
     )
+
+
+# ------------------------------------------- quantized whole-step builder
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with the vma/replication checker off (across jax API
+    renames) so the fused quantize+pack kernels stay eligible inside the
+    ring (`pallas_kernels.vma_active`)."""
+    import inspect
+
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    params = inspect.signature(jax.shard_map).parameters
+    for flag in ("check_vma", "check_rep"):
+        if flag in params:
+            kw[flag] = False
+            break
+    return jax.shard_map(f, **kw)
+
+
+def quantized_opt_state(tx, params, mesh: Optional[Mesh] = None,
+                        zero1: bool = False, block: Optional[int] = None):
+    """Initial ``(inner_state, ef_residual)`` for a quantized train step.
+
+    The error-feedback residual — ``corrected - quantize_roundtrip(
+    corrected)``, the same EF-SGD math the coordinator wire uses
+    (`ops/compression.py`) — is a per-rank quantity, so it rides as ONE
+    extra optimizer-state leaf of global shape ``[world, total_params]``
+    sharded 1/N over the mesh axis: inside the shard_map step each rank
+    sees exactly its own row. The update is deterministic (no RNG, fixed
+    reduction order), so re-running a step reproduces the residual
+    bit-for-bit and the replicated params stay bit-identical across ranks.
+
+    ``zero1=True`` builds the flat-space ZeRO-1 state instead
+    (`optim/zero.flat_zero1_state`): the optimizer runs on each rank's
+    ring chunk of the flattened parameter vector — valid for elementwise
+    transforms (sgd/momentum/adam/adamw), where flat-space update equals
+    tree-space update.
+    """
+    mesh = mesh or basics.mesh()
+    n = mesh.shape[MESH_AXIS]
+    total = sum(int(np.prod(np.shape(l) or (1,)))
+                for l in jax.tree_util.tree_leaves(params))
+    ef = jax.device_put(jnp.zeros((n, total), jnp.float32),
+                        NamedSharding(mesh, P(MESH_AXIS)))
+    if zero1:
+        from .optim.zero import flat_zero1_state
+
+        inner = flat_zero1_state(tx, total, mesh, _wire_block(block))
+    else:
+        inner = replicate(tx.init(params), mesh)
+    return inner, ef
+
+
+#: Running (wire, exact) byte accumulators behind hvd_quantization_ratio
+#: for the compiled path — the engine keeps its own pair for the
+#: coordinator wire (runtime/engine.py).
+_gspmd_bytes = {"wire": 0.0, "exact": 0.0}
+
+
+def _record_gspmd_wire(total: int, wire: str, world: int, block: int):
+    """Truthful byte accounting for one quantized-ring round (eagerly, per
+    step call — counters cannot tick inside the compiled program). Bytes
+    come from the same catalog the three-way bench reads
+    (`ops/compression.gspmd_wire_footprint`)."""
+    from .metrics import instruments
+    from .ops import compression as comp
+
+    wire_b = comp.gspmd_wire_footprint(total, wire, world, block)
+    exact_b = comp.gspmd_wire_footprint(total, "none", world, block)
+    instruments.wire_bytes().labels(compression=f"gspmd-{wire}").inc(wire_b)
+    instruments.wire_bytes_exact().inc(exact_b)
+    _gspmd_bytes["wire"] += wire_b
+    _gspmd_bytes["exact"] += exact_b
+    if _gspmd_bytes["exact"]:
+        instruments.quantization_ratio().set(
+            _gspmd_bytes["wire"] / _gspmd_bytes["exact"])
+
+
+def _make_quantized_step(loss_fn: Callable, tx, mesh: Optional[Mesh],
+                         donate: bool, zero1: bool, wire: str,
+                         block: Optional[int] = None) -> Callable:
+    """The explicit-collective variant of make_train_step: gradients ride
+    the quantized ppermute ring instead of GSPMD's inserted psum.
+
+    Dataflow (docs/gspmd.md): local grads -> flatten to one f32 vector ->
+    add this rank's EF residual -> quantized ring. ``zero1=False`` runs a
+    full quantized allreduce and the optimizer on the whole (replicated)
+    tree; ``zero1=True`` reduce-scatters the corrected gradients so the
+    elementwise optimizer math runs on this rank's 1/N chunk only, then
+    all-gathers the param delta over the same quantized ring — the ZeRO-1
+    schedule with every collective on the packed wire.
+    """
+    import optax
+
+    mesh = mesh or basics.mesh()
+    n = mesh.shape[MESH_AXIS]
+    block = _wire_block(block)
+
+    def _flatten_f32(leaves):
+        parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _split_like(flat, leaves):
+        out, off = [], 0
+        for l in leaves:
+            out.append(flat[off:off + l.size].reshape(l.shape)
+                       .astype(l.dtype))
+            off += l.size
+        return out
+
+    def local_step(params, inner, ef, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        flat = _flatten_f32(g_leaves)
+        total = flat.shape[0]
+        corrected = flat + ef[0]
+        use_ring = zero1 or _wire_eligible(total, corrected.dtype, wire,
+                                           block)
+        if use_ring:
+            new_ef = (corrected
+                      - _wire_roundtrip(corrected, wire, block))[None]
+        else:
+            new_ef = jnp.zeros_like(ef)
+        if zero1:
+            g_chunk = quantized_reduce_scatter(
+                corrected, MESH_AXIS, wire, block) / n
+            chunk = g_chunk.shape[0]
+            p_flat = _flatten_f32(jax.tree_util.tree_leaves(params))
+            pad = n * chunk - total
+            if pad:
+                p_flat = jnp.pad(p_flat, (0, pad))
+            p = jax.lax.axis_index(MESH_AXIS)
+            p_chunk = jax.lax.dynamic_slice_in_dim(p_flat, p * chunk, chunk)
+            upd_chunk, inner = tx.update(g_chunk, inner, p_chunk)
+            upd_flat = quantized_all_gather(
+                upd_chunk, MESH_AXIS, wire, block)[:total]
+            updates = jax.tree_util.tree_unflatten(
+                treedef, _split_like(upd_flat, g_leaves))
+            params = optax.apply_updates(params, updates)
+        else:
+            reduced = quantized_allreduce(
+                corrected, Average, MESH_AXIS, wire, block)
+            grads = jax.tree_util.tree_unflatten(
+                treedef, _split_like(reduced, g_leaves))
+            updates, inner = tx.update(grads, inner, params)
+            params = optax.apply_updates(params, updates)
+        loss = jax.lax.pmean(loss, MESH_AXIS)
+        return params, inner, new_ef, loss
+
+    def step(params, opt_state, batch):
+        inner, ef = opt_state
+        if zero1:
+            inner_specs = jax.tree_util.tree_map(
+                lambda l: P(MESH_AXIS) if (jnp.ndim(l) == 1 and l.shape[0]
+                                           and l.shape[0] % n == 0) else P(),
+                inner)
+        else:
+            inner_specs = jax.tree_util.tree_map(lambda l: P(), inner)
+        fn = _shard_map(
+            local_step, mesh,
+            in_specs=(P(), inner_specs, P(MESH_AXIS), P(MESH_AXIS)),
+            out_specs=(P(), inner_specs, P(MESH_AXIS), P()))
+        params, inner, ef, loss = fn(params, inner, ef, batch)
+        return params, (inner, ef), loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    @functools.wraps(jitted)
+    def instrumented(params, opt_state, batch):
+        total = int(opt_state[1].shape[1])  # read before donation
+        out = jitted(params, opt_state, batch)
+        _record_gspmd_wire(total, wire, n, block)
+        return out
+
+    instrumented.jitted = jitted  # .lower()/.compile() escape hatch
+    return instrumented
