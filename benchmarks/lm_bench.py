@@ -19,6 +19,19 @@ With ``--history PATH`` the final record (tokens/s + MFU) appends to the
 same schema-versioned JSONL store bench.py uses (benchmarks/history.py);
 ``--check-regression`` compares against the trajectory BEFORE appending
 and exits 3 below the tolerance floor.
+
+``--moe`` switches to the Switch-MoE dispatch benchmark
+(parallel/expert.py): one MoE block trained over a ``dp × ep`` mesh in
+four configs — exact one-hot dispatch, capacity dispatch (bf16/f32
+wire), and capacity over the quantized int8/int4 all_to_all — each
+reporting tokens/s, MFU (6 · active-params FLOP model: router + the one
+routed expert per token), final loss, drop rate, and expert-load
+imbalance, plus the catalog dispatch-byte ratios vs a bf16 exchange.
+The history/regression gate then keys on ``moe_lm_tokens_per_sec``
+(the capacity+int8 config — the shipped quantized default). Knobs:
+``LM_MOE_EXPERTS`` (8), ``LM_MOE_D``, ``LM_MOE_TOKENS`` (global tokens
+per step), ``LM_MOE_CF`` (1.25), ``LM_MOE_EP`` (expert-parallel mesh
+extent; default gcd(devices, experts)), ``LM_MOE_WARMUP``/``LM_MOE_ITERS``.
 """
 
 import argparse
@@ -49,6 +62,10 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(
         description="Transformer-LM training benchmark (config via LM_* "
                     "env knobs; see module docstring)")
+    p.add_argument("--moe", action="store_true",
+                   help="benchmark Switch-MoE capacity dispatch (exact vs "
+                        "capacity vs capacity+int8/int4 wire) instead of "
+                        "the dense LM")
     p.add_argument("--history", metavar="PATH", default=None,
                    help="append this run's tokens/s + MFU to a "
                         "schema-versioned JSONL perf history "
@@ -66,10 +83,199 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _gcd(a, b):
+    while b:
+        a, b = b, a % b
+    return a
+
+
+def run_moe(args):
+    """Switch-MoE dispatch benchmark: exact vs capacity vs quantized wire.
+
+    One weight-tied MoE block (embed -> top-1 routed expert MLP ->
+    tied-head logits) trained on synthetic tokens over a ``dp x ep``
+    mesh, timed per dispatch config. The capacity configs run the
+    explicit all_to_all exchange (quantized when a wire is named); the
+    exact config is the dense one-hot reference with GSPMD-inserted
+    communication."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import compression as comp
+    from horovod_tpu.parallel import expert as epar
+
+    hvd.init()
+    on_tpu = jax.default_backend() == "tpu"
+    world = jax.device_count()
+
+    n_experts = int(os.environ.get("LM_MOE_EXPERTS", "8"))
+    ep = int(os.environ.get("LM_MOE_EP", "0")) or _gcd(world, n_experts)
+    if world % ep or n_experts % ep:
+        sys.exit(f"LM_MOE_EP={ep} must divide both the device count "
+                 f"({world}) and LM_MOE_EXPERTS ({n_experts})")
+    dp = world // ep
+    d_model = int(os.environ.get("LM_MOE_D", "1024" if on_tpu else "64"))
+    hidden_mult = int(os.environ.get("LM_MOE_HIDDEN_MULT",
+                                     "4" if on_tpu else "2"))
+    vocab = int(os.environ.get("LM_VOCAB", "32768" if on_tpu else "256"))
+    n_tokens = int(os.environ.get("LM_MOE_TOKENS",
+                                  "65536" if on_tpu else "2048"))
+    n_tokens = max(world, n_tokens // world * world)
+    cf = float(os.environ.get("LM_MOE_CF", "1.25"))
+    warmup = int(os.environ.get("LM_MOE_WARMUP", "3" if on_tpu else "1"))
+    iters = int(os.environ.get("LM_MOE_ITERS", "20" if on_tpu else "4"))
+
+    mesh = epar.make_dp_ep_mesh(dp, ep)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    host_params = dict(epar.init_moe_params(
+        key, d_model, n_experts, hidden_mult=hidden_mult))
+    host_params["emb"] = 0.02 * jax.random.normal(
+        jax.random.PRNGKey(1), (vocab, d_model), jnp.float32)
+    toks = jnp.asarray(rng.randint(0, vocab, (n_tokens + 1,)))
+    tokens, targets = toks[:-1], toks[1:]
+
+    def _head_loss(p, h, y, tgt, aux):
+        logits = (h + y) @ p["emb"].T      # weight-tied readout
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+        return ce + 0.01 * aux
+
+    def dense_loss(p, batch):
+        tok, tgt = batch
+        h = p["emb"][tok]
+        y, aux = epar.dense_moe_apply(p, h)
+        return _head_loss(p, h, y, tgt, aux)
+
+    def cap_loss(p, batch, moe):
+        tok, tgt = batch
+        h = p["emb"][tok]
+        y, aux = moe(p, h)
+        return _head_loss(p, h, y, tgt, aux)
+
+    tx = optax.adam(1e-2)
+    # per-token active params: router + the ONE routed expert's MLP; the
+    # embedding lookup and tied head are excluded like the dense bench
+    hidden = hidden_mult * d_model
+    n_active = d_model * n_experts + 2 * d_model * hidden
+
+    configs = [("exact", None), ("capacity", "off"),
+               ("capacity-int8", "int8"), ("capacity-int4", "int4")]
+    results = {}
+    for name, wire in configs:
+        # fresh leaves per config: the donated step consumes the sharded
+        # buffers, and device_put may alias the host tree's
+        params = epar.shard_params_ep(jax.tree_util.tree_map(
+            jnp.array, host_params), mesh)
+        if wire is None:
+            step = epar.make_ep_train_step(dense_loss, tx, mesh)
+            opt = epar.shard_params_ep(tx.init(params), mesh)
+            batch = (jax.device_put(tokens, NamedSharding(mesh, P("dp"))),
+                     jax.device_put(targets, NamedSharding(mesh, P("dp"))))
+        else:
+            step = epar.make_ep_train_step(
+                cap_loss, tx, mesh, dispatch="capacity",
+                capacity_factor=cf, wire=wire)
+            opt = epar.moe_opt_state(tx, params, mesh, n_tokens, cf)
+            sh = NamedSharding(mesh, P(("dp", "ep")))
+            batch = (jax.device_put(tokens, sh),
+                     jax.device_put(targets, sh))
+
+        stats = None
+        for _ in range(warmup):
+            out = step(params, opt, batch)
+            params, opt = out[0], out[1]
+            jax.block_until_ready(out[2])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(params, opt, batch)
+            params, opt = out[0], out[1]
+        loss = out[2]
+        if wire is not None:
+            stats = out[3]
+        jax.block_until_ready(loss)
+        total = time.perf_counter() - t0
+
+        tok_per_s = n_tokens * iters / total
+        mfu = 6.0 * n_active * tok_per_s / (world * PEAK_TFLOPS * 1e12)
+        entry = {
+            "tokens_per_sec": round(tok_per_s, 1),
+            "mfu_pct": round(100 * mfu, 2) if on_tpu else None,
+            "loss": round(float(loss), 4),
+        }
+        if stats is not None:
+            load = np.asarray(stats["load"])
+            entry["drop_rate"] = round(float(stats["dropped"]) / n_tokens, 4)
+            entry["imbalance"] = round(float(load.max() / load.mean()), 3)
+        results[name] = entry
+        print(f"# {name}: {tok_per_s:,.0f} tok/s loss={entry['loss']} "
+              + (f"drop={entry['drop_rate']} imb={entry['imbalance']}"
+                 if stats is not None else ""), file=sys.stderr)
+
+    # dispatch-byte catalog for this shape (per step, both directions)
+    cap = epar.expert_capacity(n_tokens // world, n_experts, cf)
+    per_peer = n_experts * cap * d_model // ep
+    bytes_bf16 = comp.moe_wire_footprint(per_peer, "bf16", ep)
+    wire_bytes = {m: comp.moe_wire_footprint(per_peer, m, ep)
+                  for m in ("bf16", "int8", "int4")}
+    ratios = {m: round(v / bytes_bf16, 3) if bytes_bf16 else 0.0
+              for m, v in wire_bytes.items()}
+    print(f"# dispatch bytes vs bf16: {json.dumps(ratios)}", file=sys.stderr)
+
+    result = {
+        "metric": "moe_lm_tokens_per_sec",
+        # the shipped quantized default is the headline number the
+        # regression gate tracks
+        "value": results["capacity-int8"]["tokens_per_sec"],
+        "unit": "tok/s",
+        "configs": results,
+        "wire_byte_ratio_vs_bf16": ratios,
+        "experts": n_experts, "ep": ep, "capacity_factor": cf,
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if args.history:
+        from benchmarks.history import (append_record, check_regression,
+                                        load_history)
+
+        if args.check_regression:
+            verdict = check_regression(
+                load_history(args.history, metric=result["metric"]),
+                result["value"],
+                **{k: v for k, v in (
+                    ("window", args.regression_window),
+                    ("tolerance", args.regression_tolerance))
+                   if v is not None})
+            print("# regression check: %s" % json.dumps(verdict),
+                  file=sys.stderr)
+            if verdict["regression"]:
+                print(f"# REGRESSION: {result['metric']} = "
+                      f"{result['value']} fell below the floor "
+                      f"{verdict['floor']} (baseline {verdict['baseline']} "
+                      f"over {verdict['samples']} runs)", file=sys.stderr)
+                rc = 3
+        append_record(args.history, {
+            "metric": result["metric"], "value": result["value"],
+            "unit": result["unit"],
+            "backend": jax.default_backend(), "devices": world,
+            "experts": n_experts, "ep": ep,
+            "tokens_per_step": n_tokens,
+        })
+        print(f"# perf history appended to {args.history}", file=sys.stderr)
+    return rc
+
+
 def main(argv=None):
     # callers (tests) invoke main() bare: no argv means no flags, never
     # pytest's sys.argv
     args = parse_args([] if argv is None else argv)
+    if args.moe:
+        return run_moe(args)
     import jax
     import jax.numpy as jnp
     import optax

@@ -78,6 +78,15 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/scaling_bench.py --three-way --iters 3 \
         --elements 65536
 
+stage "moe: capacity-factor Switch dispatch over the quantized all_to_all"
+python -m pytest tests/test_moe.py tests/test_expert_parallel.py -q
+# acceptance: four-config head-to-head (exact one-hot vs capacity vs
+# capacity+int8/int4) — capacity must out-run exact at E=8 and the int4
+# dispatch catalog must stay <=60% of a bf16 exchange (docs/moe.md)
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    LM_MOE_TOKENS=2048 LM_MOE_ITERS=4 \
+    python benchmarks/lm_bench.py --moe
+
 stage "serving: continuous batching, paged KV cache, elastic pod serving"
 python -m pytest tests/test_serving.py -q -m "not integration"
 # in-process load bench (deterministic perf-gate mode); exit 4 on any

@@ -41,6 +41,11 @@ SIGNALS = (
     # training-only jobs keep clean baselines.
     ("serving_p99_seconds", 1e-3),
     ("serving_queue_depth", 1.0),
+    # MoE capacity dispatch (parallel/expert.py gauges): sustained expert-
+    # load imbalance is the router going degenerate — same live-signal
+    # treatment as straggler skew. Only sampled when the MoE family
+    # exists in the snapshot, so non-MoE jobs keep clean baselines.
+    ("moe_load_imbalance", 1.0),
 )
 
 _watch = None
@@ -127,6 +132,9 @@ class AnomalyWatch:
         if "hvd_serving_queue_depth" in snapshot:
             out["serving_queue_depth"] = _series_total(
                 snapshot, "hvd_serving_queue_depth")
+        if "hvd_moe_load_imbalance" in snapshot:
+            out["moe_load_imbalance"] = _series_total(
+                snapshot, "hvd_moe_load_imbalance")
         return out
 
     def _serving_p99(self, snapshot):

@@ -95,6 +95,38 @@ def quantization_ratio():
         agg="max")
 
 
+def expert_load():
+    return get_registry().gauge(
+        "hvd_expert_load",
+        "Tokens routed to each expert in the latest capacity-dispatch MoE "
+        "step (parallel/expert.py; global count, identical on every "
+        "rank).", labels=("expert",), agg="max")
+
+
+def moe_load_imbalance():
+    return get_registry().gauge(
+        "hvd_moe_load_imbalance",
+        "max/mean expert load of the latest MoE step (1.0 = perfectly "
+        "balanced router; sustained high values mean dropped tokens and "
+        "idle experts — the anomaly watch tracks this like straggler "
+        "skew).", agg="max")
+
+
+def moe_dropped_tokens():
+    return get_registry().counter(
+        "hvd_moe_dropped_tokens_total",
+        "Tokens dropped by capacity-factor MoE dispatch (routed past "
+        "their expert's buffer; they contribute zero to the MoE output "
+        "— docs/moe.md).")
+
+
+def moe_capacity_factor():
+    return get_registry().gauge(
+        "hvd_moe_capacity_factor",
+        "Capacity factor of the running MoE train step (buffer slots = "
+        "ceil(CF * tokens / experts)).", agg="max")
+
+
 def bitwidth_decisions():
     return get_registry().counter(
         "hvd_bitwidth_decisions_total",

@@ -105,6 +105,18 @@ def reset() -> None:
     ConvergenceGate.shared().forget()
 
 
+def admit_wire(wire: str) -> str:
+    """Gate admission for an integer wire grid, shared by every compiled-path
+    knob (``HOROVOD_GSPMD_WIRE``, ``HOROVOD_MOE_WIRE``): int4 must pass the
+    :class:`ConvergenceGate` A/B harness; a refusal downgrades to int8
+    rather than risking the 4-bit grid on a model the deterministic proxy
+    couldn't converge. int8 (and anything else) passes through unchanged —
+    it shipped with its own convergence tests."""
+    if wire == "int4" and not ConvergenceGate.shared().allows("int4"):
+        return "int8"
+    return wire
+
+
 # ---------------------------------------------------------------- numerics
 def _block_roundtrip(x: np.ndarray, bits: int, block: int = 256) -> np.ndarray:
     """Numpy mirror of ``compression.quantize_roundtrip`` (same formula:

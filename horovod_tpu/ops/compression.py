@@ -184,6 +184,36 @@ def gspmd_wire_footprint(num_elements: int, mode: str, world: int,
     return 2 * (world - 1) * rows * row_bytes
 
 
+def moe_wire_footprint(per_peer_elements: int, mode: str, world: int,
+                       block: int | None = None) -> int:
+    """Bytes ONE device puts on the wire for one capacity-dispatch MoE
+    round (`parallel/expert.py`): the dispatch all_to_all plus the
+    combine all_to_all over the ``ep`` axis, each moving ``world - 1``
+    remote per-peer payloads of ``per_peer_elements`` f32 elements
+    (``E_loc * capacity * d``; the slab a device keeps for its own
+    experts never touches the wire).
+
+    Quantized modes move packed rows — ``[block | 4 scale bytes]`` for
+    int8, ``[block//2 | 4]`` for int4 — with each peer's payload padded
+    to whole blocks independently (`spmd.quantized_all_to_all`).
+    ``none``/``fp32`` (``bf16``/``fp16``) count the exact exchange moving
+    raw 4-byte (2-byte) elements: ``bf16`` is the denominator behind the
+    "dispatch bytes ≤60% of the bf16 exchange" CI bar. ``world == 1``
+    is wireless.
+    """
+    if world <= 1:
+        return 0
+    per_elem = {"none": 4, "fp32": 4, "fp16": 2, "bf16": 2}.get(mode)
+    if per_elem is not None:
+        return 2 * (world - 1) * per_peer_elements * per_elem
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown MoE wire mode {mode!r}")
+    block = block or block_size()
+    rows = -(-per_peer_elements // block)
+    row_bytes = (block if mode == "int8" else block // 2) + 4
+    return 2 * (world - 1) * rows * row_bytes
+
+
 class Compressor:
     """Interface: compress before enqueue, decompress after completion.
 

@@ -168,12 +168,9 @@ def gspmd_wire(value: Optional[str] = None) -> str:
     if v not in _GSPMD_WIRES:
         raise ValueError(
             f"HOROVOD_GSPMD_WIRE must be int8|int4|off, got {v!r}")
-    if v == "int4":
-        from .ops.adaptive import ConvergenceGate
+    from .ops.adaptive import admit_wire
 
-        if not ConvergenceGate.shared().allows("int4"):
-            return "int8"
-    return v
+    return admit_wire(v)
 
 
 def _wire_block(block: Optional[int]) -> int:
@@ -341,6 +338,113 @@ def _wire_roundtrip(flat, wire: str, block: int):
     q, scales = comp.quantize_blocks(padded, block,
                                      bits=4 if wire == "int4" else 8)
     return comp.dequantize_blocks(q, scales, jnp.float32, block)[:num]
+
+
+# --------------------------------------------------- quantized all_to_all
+def _a2a_roundtrip(flat, wire: str, block: int):
+    """EF numerator for one quantized all_to_all: the value the packed wire
+    delivers for this rank's ``[m, per]`` payload, with the same per-peer
+    padded block layout as the forward pack (each peer's segment pads to
+    whole blocks independently, so no block ever mixes two peers' data).
+    Pure ``comp.quantize_blocks`` math — safe inside the traced step."""
+    from .ops import compression as comp
+
+    m, per = flat.shape
+    pad = (-per) % block
+    padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+    q, scales = comp.quantize_blocks(padded.reshape(-1), block,
+                                     bits=4 if wire == "int4" else 8)
+    out = comp.dequantize_blocks(q, scales, jnp.float32, block)
+    return out.reshape(m, per + pad)[:, :per]
+
+
+def _a2a_wired(x, axis: str, wire: str, block: int):
+    """One quantized all_to_all exchange (forward value only): pad each
+    destination peer's payload to whole blocks, quantize+pack through the
+    fused kernels, move the packed int8 rows, unpack+dequantize on
+    arrival. The packed rows keep their [rows, row_bytes] shape through
+    the exchange because each peer's row count is identical."""
+    m = jax.lax.psum(1, axis)
+    per = x.size // m
+    flat = x.reshape(m, per).astype(jnp.float32)
+    pad = (-per) % block
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    pack, unpack = _pack_fns(wire)
+    packed = pack(flat.reshape(-1, block))
+    wired = jax.lax.all_to_all(packed, axis, 0, 0, tiled=True)
+    q, scales = unpack(wired)
+    vals = (q.astype(jnp.float32) * scales).reshape(m, per + pad)[:, :per]
+    return vals.reshape(x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _st_all_to_all(x, axis, wire, block):
+    return _a2a_wired(x, axis, wire, block)
+
+
+def _st_fwd(x, axis, wire, block):
+    return _a2a_wired(x, axis, wire, block), None
+
+
+def _st_bwd(axis, wire, block, _res, g):
+    # Straight-through: the quantizer is gradient-dead (jnp.round), so the
+    # cotangent rides the exact wire. A dim-0 tiled all_to_all is its own
+    # transpose, so this IS the true adjoint of the exchange itself — only
+    # the quantization nonlinearity is bypassed.
+    return (jax.lax.all_to_all(g, axis, 0, 0, tiled=True),)
+
+
+_st_all_to_all.defvjp(_st_fwd, _st_bwd)
+
+
+def quantized_all_to_all(x, axis: str = MESH_AXIS, wire: str = "int8",
+                         block: Optional[int] = None, ef=None):
+    """all_to_all over ``axis`` whose payload rides the packed wire; call
+    inside shard_map (the MoE token exchange — docs/moe.md).
+
+    ``x`` is the local ``[L, ...]`` operand with dim 0 indexing destination
+    peers in ``L / world`` row groups (``jax.lax.all_to_all`` split/concat
+    dim 0, tiled). Each peer's payload pads independently to whole
+    quantization blocks and quantize+packs through the fused kernels into
+    ``[payload | 4 f32-scale bytes]`` rows; only the packed int8 bytes
+    cross the wire, and receivers dequantize. Eligibility mirrors the ring
+    (:func:`_wire_eligible` on the per-peer element count): non-float
+    payloads, payloads under one block, or an odd block under int4 ride
+    the exact all_to_all instead.
+
+    Gradients are straight-through: the backward pass ships the cotangent
+    over an *exact* all_to_all, which is the true adjoint of the exchange
+    (a dim-0 all_to_all is its own transpose); only the gradient-dead
+    quantizer is bypassed.
+
+    ``ef`` (f32, same shape as ``x``) engages EF-SGD error feedback: the
+    residual from the previous exchange in this direction is added before
+    quantization, and the new residual ``corrected - wire(corrected)``
+    comes back to be banked — one leaf per exchange direction, like the
+    PR 13 optimizer-state leaf. With ``ef`` given the return is
+    ``(y, new_ef)``; otherwise just ``y``.
+    """
+    m = jax.lax.psum(1, axis)
+    if x.shape[0] % m:
+        raise ValueError(
+            f"all_to_all dim 0 ({x.shape[0]}) not divisible by axis size "
+            f"{m}")
+    block = _wire_block(block)
+    per = x.size // m
+    if m == 1 or not _wire_eligible(per, x.dtype, wire, block):
+        y = jax.lax.all_to_all(x, axis, 0, 0, tiled=True)
+        return (y, jnp.zeros(x.shape, jnp.float32)) if ef is not None else y
+    corrected = x.astype(jnp.float32)
+    if ef is not None:
+        corrected = corrected + jax.lax.stop_gradient(
+            ef.astype(jnp.float32))
+    y = _st_all_to_all(corrected, axis, wire, block).astype(x.dtype)
+    if ef is None:
+        return y
+    flat = jax.lax.stop_gradient(corrected).reshape(m, per)
+    new_ef = (flat - _a2a_roundtrip(flat, wire, block)).reshape(x.shape)
+    return y, new_ef
 
 
 # ------------------------------------------------------------ whole-step API

@@ -47,6 +47,33 @@ def test_lm_bench_smoke(capsys, monkeypatch):
     assert rec["unit"] == "tok/s"
 
 
+def test_lm_bench_moe_smoke(capsys, monkeypatch):
+    """--moe runs all four dispatch configs and emits the JSON contract:
+    capacity out-runs the dense one-hot reference (the O(E·N·d) einsums
+    vs O(C·d) buffers — a large structural gap, safe to assert even on
+    noisy CPU timers) and the int4 catalog bytes stay under the 60%
+    CI bar vs a bf16 exchange."""
+    monkeypatch.setenv("LM_MOE_TOKENS", "1024")
+    monkeypatch.setenv("LM_MOE_ITERS", "2")
+    monkeypatch.setenv("LM_MOE_WARMUP", "1")
+    import lm_bench
+
+    assert lm_bench.main(["--moe"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["metric"] == "moe_lm_tokens_per_sec"
+    assert rec["value"] > 0
+    cfgs = rec["configs"]
+    assert set(cfgs) == {"exact", "capacity", "capacity-int8",
+                         "capacity-int4"}
+    assert (cfgs["capacity"]["tokens_per_sec"]
+            > cfgs["exact"]["tokens_per_sec"])
+    for name in ("capacity", "capacity-int8", "capacity-int4"):
+        assert 0 <= cfgs[name]["drop_rate"] < 1
+        assert cfgs[name]["imbalance"] >= 1
+    assert rec["wire_byte_ratio_vs_bf16"]["int4"] <= 0.6
+
+
 def test_allreduce_bench_spmd_and_eager(capsys):
     import allreduce_bench
 

@@ -1,0 +1,463 @@
+"""Capacity-factor Switch MoE over the quantized all_to_all (docs/moe.md).
+
+What must hold:
+* the dispatch math — capacity, position-in-expert, token drop — is the
+  classic Switch recipe, and with ample capacity and the wire off it is
+  numerically IDENTICAL to the exact dense one-hot dispatch;
+* the quantized exchange is accurate (straight-through gradients ride the
+  exact wire), EF residuals bank per direction, and the ConvergenceGate
+  A/B harness certifies loss parity of quantized capacity dispatch vs the
+  exact one-hot reference (≤5%, the PR 10 bar);
+* HOROVOD_MOE_WIRE unset leaves the exact path's StableHLO byte-identical
+  (the golden-pin style of test_gspmd.py) and byte/load/drop accounting
+  matches the `moe_wire_footprint` catalog.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import spmd
+from horovod_tpu.metrics import instruments
+from horovod_tpu.ops import adaptive, compression as comp
+from horovod_tpu.ops.adaptive import ConvergenceGate
+from horovod_tpu.parallel import expert as epar
+
+
+# ------------------------------------------------------------ shared setup
+E, D, HM = 8, 16, 2
+N = 256  # global tokens per step
+
+
+def _mesh():
+    return epar.make_dp_ep_mesh(dp=2, ep=4)
+
+
+def _problem(seed=0):
+    """A learnable regression: tokens through the MoE should reconstruct a
+    fixed linear map of themselves (plus the balance aux loss)."""
+    rng = np.random.RandomState(seed)
+    params = epar.init_moe_params(jax.random.PRNGKey(seed), D, E,
+                                  hidden_mult=HM)
+    xb = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    w_true = jnp.asarray(0.1 * rng.randn(D, D).astype(np.float32))
+    yb = xb @ w_true
+    return params, xb, yb
+
+
+def _cap_loss_fn(p, batch, moe):
+    xb, yb = batch
+    y, aux = moe(p, xb)
+    return jnp.mean((y - yb) ** 2) + 0.01 * aux
+
+
+def _shard_batch(mesh, *arrays):
+    sh = NamedSharding(mesh, P(("dp", "ep")))
+    return tuple(jax.device_put(a, sh) for a in arrays)
+
+
+def _run_capacity(wire, steps=30, capacity_factor=2.0, block=64, seed=0,
+                  instrumented=False):
+    mesh = _mesh()
+    params, xb, yb = _problem(seed)
+    tx = optax.adam(1e-2)
+    p = epar.shard_params_ep(params, mesh)
+    st = epar.moe_opt_state(tx, params, mesh, N, capacity_factor)
+    step = epar.make_ep_train_step(
+        _cap_loss_fn, tx, mesh, dispatch="capacity",
+        capacity_factor=capacity_factor, wire=wire or "off", block=block)
+    if not instrumented:
+        step = step.jitted
+    batch = _shard_batch(mesh, xb, yb)
+    losses, stats = [], None
+    for _ in range(steps):
+        p, st, loss, stats = step(p, st, batch)
+        losses.append(float(loss))
+    return losses, stats, st
+
+
+# ---------------------------------------------------------------- the knob
+def test_moe_wire_knob(monkeypatch):
+    for raw, want in [("", ""), ("off", ""), ("0", ""), ("none", ""),
+                      ("int8", "int8"), ("INT8", "int8")]:
+        monkeypatch.setenv("HOROVOD_MOE_WIRE", raw)
+        assert epar.moe_wire() == want
+    monkeypatch.delenv("HOROVOD_MOE_WIRE")
+    assert epar.moe_wire() == ""
+    assert epar.moe_wire("int8") == "int8"
+    with pytest.raises(ValueError, match="HOROVOD_MOE_WIRE"):
+        epar.moe_wire("fp8")
+
+
+def test_moe_wire_int4_gate_admission(monkeypatch):
+    # both knobs share ops/adaptive.admit_wire: a refused gate downgrades
+    # int4 to int8 instead of risking the 4-bit grid
+    monkeypatch.setattr(ConvergenceGate, "_shared", None)
+    monkeypatch.setattr(ConvergenceGate, "allows", lambda self, m: False)
+    assert epar.moe_wire("int4") == "int8"
+    assert adaptive.admit_wire("int4") == "int8"
+    monkeypatch.setattr(ConvergenceGate, "allows", lambda self, m: True)
+    assert epar.moe_wire("int4") == "int4"
+    assert adaptive.admit_wire("int8") == "int8"
+
+
+# ------------------------------------------------------------ dispatch math
+def test_expert_capacity():
+    assert epar.expert_capacity(256, 8, 1.0) == 32
+    assert epar.expert_capacity(256, 8, 1.25) == 40
+    assert epar.expert_capacity(10, 4, 1.0) == 3      # ceil
+    assert epar.expert_capacity(1, 64, 0.01) == 1     # floor of 1
+    with pytest.raises(ValueError, match="positive"):
+        epar.expert_capacity(0, 8, 1.0)
+    with pytest.raises(ValueError, match="capacity_factor"):
+        epar.expert_capacity(8, 8, -1.0)
+
+
+def test_dispatch_mask_positions_and_drops():
+    # tokens 0,1,2 -> expert 0; token 3 -> expert 1; capacity 2 drops
+    # token 2 (third into expert 0)
+    onehot = jnp.asarray([[1, 0], [1, 0], [1, 0], [0, 1]], jnp.float32)
+    dmask, keep = epar.dispatch_mask(onehot, capacity=2)
+    assert dmask.shape == (4, 2, 2)
+    np.testing.assert_array_equal(np.asarray(keep), [True, True, False, True])
+    np.testing.assert_array_equal(np.asarray(dmask[0, 0]), [1, 0])  # slot 0
+    np.testing.assert_array_equal(np.asarray(dmask[1, 0]), [0, 1])  # slot 1
+    assert float(dmask[2].sum()) == 0.0                 # dropped: zero row
+    np.testing.assert_array_equal(np.asarray(dmask[3, 1]), [1, 0])
+    # every kept token occupies exactly one (expert, slot) cell
+    assert float(dmask.sum()) == 3.0
+
+
+# ------------------------------------------------- quantized all_to_all
+def _a2a_sharded(fn, mesh):
+    return jax.jit(spmd._shard_map(
+        fn, mesh, in_specs=P(("dp", "ep")), out_specs=P(("dp", "ep"))))
+
+
+def test_quantized_all_to_all_accuracy():
+    mesh = _mesh()
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 300))
+    exact = _a2a_sharded(
+        lambda z: jax.lax.all_to_all(z, "ep", 0, 0, tiled=True), mesh)(x)
+    for wire, tol in [("int8", 0.02), ("int4", 0.2)]:
+        got = _a2a_sharded(
+            lambda z, w=wire: spmd.quantized_all_to_all(z, "ep", w, 256),
+            mesh)(x)
+        rel = float(jnp.abs(got - exact).max() / jnp.abs(exact).max())
+        assert rel < tol, (wire, rel)
+
+
+def test_quantized_all_to_all_fallbacks():
+    mesh = _mesh()
+    # integer payload and sub-block payloads ride the exact wire untouched
+    xi = jnp.arange(32 * 64, dtype=jnp.int32).reshape(32, 64)
+    got = _a2a_sharded(
+        lambda z: spmd.quantized_all_to_all(z, "ep", "int8", 256), mesh)(xi)
+    want = _a2a_sharded(
+        lambda z: jax.lax.all_to_all(z, "ep", 0, 0, tiled=True), mesh)(xi)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    xs = jax.random.normal(jax.random.PRNGKey(2), (32, 8))  # per-peer 32 < 256
+    gs = _a2a_sharded(
+        lambda z: spmd.quantized_all_to_all(z, "ep", "int8", 256), mesh)(xs)
+    ws = _a2a_sharded(
+        lambda z: jax.lax.all_to_all(z, "ep", 0, 0, tiled=True), mesh)(xs)
+    np.testing.assert_array_equal(np.asarray(gs), np.asarray(ws))
+
+
+def test_quantized_all_to_all_straight_through_grad():
+    # the backward pass is an exact all_to_all of the cotangent, so with a
+    # linear readout the quantized exchange's gradient equals the exact
+    # exchange's (up to shard_map's replicated-output cotangent
+    # bookkeeping — ulp-level, nothing quantized)
+    mesh = _mesh()
+    x = jax.random.normal(jax.random.PRNGKey(3), (32, 300))
+    w = jax.random.normal(jax.random.PRNGKey(4), (32, 300))
+
+    def make_loss(fn):
+        sm = spmd._shard_map(
+            lambda z, ww: jnp.sum(fn(z) * ww), mesh,
+            in_specs=(P(("dp", "ep")), P(("dp", "ep"))),
+            out_specs=P())
+        return jax.jit(jax.grad(lambda z: sm(z, w)))
+
+    g_q = make_loss(
+        lambda z: spmd.quantized_all_to_all(z, "ep", "int8", 256))(x)
+    g_e = make_loss(
+        lambda z: jax.lax.all_to_all(z, "ep", 0, 0, tiled=True))(x)
+    np.testing.assert_allclose(np.asarray(g_q), np.asarray(g_e),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_quantized_all_to_all_ef_residual():
+    # y + new_ef-to-be-corrected must reconstruct: new_ef = x - wire(x),
+    # and feeding it back makes the NEXT exchange deliver x + prev_ef
+    # rounded — the EF-SGD contract
+    mesh = _mesh()
+    x = jax.random.normal(jax.random.PRNGKey(5), (32, 256))
+    ef0 = jnp.zeros_like(x)
+
+    def run(z, ef):
+        return spmd.quantized_all_to_all(z, "ep", "int8", 64, ef=ef)
+
+    sm = jax.jit(spmd._shard_map(
+        run, mesh, in_specs=(P(("dp", "ep")), P(("dp", "ep"))),
+        out_specs=(P(("dp", "ep")), P(("dp", "ep")))))
+    y1, ef1 = sm(x, ef0)
+    assert float(jnp.abs(ef1).max()) > 0
+    # residual really is the local quantization error: corrected == x here
+    rt = _a2a_sharded(lambda z: spmd.quantized_all_to_all(
+        z, "ep", "int8", 64), mesh)
+    # second pass with the banked residual changes what the wire delivers
+    y2, ef2 = sm(x, ef1)
+    assert float(jnp.abs(y2 - y1).max()) > 0
+    # EF keeps the error bounded, not compounding
+    assert float(jnp.abs(ef2).max()) < 10 * float(jnp.abs(ef1).max())
+
+
+# ------------------------------------------------------- capacity dispatch
+def test_capacity_matches_dense_with_ample_capacity():
+    # ample CF (no drops) + wire off: capacity dispatch IS the exact
+    # one-hot computation, just routed through explicit all_to_alls
+    mesh = _mesh()
+    params, xb, _ = _problem()
+    p = epar.shard_params_ep(params, mesh)
+
+    def run(pp, xx):
+        moe = epar.SwitchDispatch("dp", "ep", 8.0, "", None, None)
+        return moe(pp, xx)
+
+    sm = jax.jit(spmd._shard_map(
+        run, mesh,
+        in_specs=(epar.ep_specs(params), P(("dp", "ep"))),
+        out_specs=(P(("dp", "ep")), P())))
+    y_cap, aux_cap = sm(p, xb)
+    y_dense, aux_dense = epar.dense_moe_apply(params, xb)
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_dense),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(float(aux_cap), float(aux_dense), rtol=1e-6)
+
+
+def test_capacity_drops_past_capacity_and_counts():
+    losses, stats, _ = _run_capacity("", steps=1, capacity_factor=0.25)
+    load = np.asarray(stats["load"])
+    assert load.sum() == N                       # every token routed
+    assert float(stats["dropped"]) > 0           # tight CF must drop
+    # drop accounting: kept tokens are bounded by world * E * capacity
+    world, cap = 8, float(stats["capacity"])
+    assert N - float(stats["dropped"]) <= world * E * cap
+
+
+def test_capacity_step_converges_and_banks_ef():
+    losses, _, (_, ef) = _run_capacity("int8", steps=30)
+    assert losses[-1] < 0.5 * losses[0]
+    assert float(jnp.abs(ef).max()) > 0          # both directions banked
+    assert float(jnp.abs(ef[:, 0]).max()) > 0
+    assert float(jnp.abs(ef[:, 1]).max()) > 0
+
+
+def test_capacity_step_wire_off_keeps_ef_zero():
+    losses, _, (_, ef) = _run_capacity("", steps=5)
+    assert losses[-1] < losses[0]
+    assert float(jnp.abs(ef).max()) == 0.0
+
+
+def test_moe_opt_state_shapes_and_errors():
+    mesh = _mesh()
+    params, _, _ = _problem()
+    tx = optax.sgd(0.1)
+    inner, ef = epar.moe_opt_state(tx, params, mesh, N, 1.25)
+    cap = epar.expert_capacity(N // 8, E, 1.25)
+    assert ef.shape == (8, 2, E, cap, D)
+    with pytest.raises(ValueError, match="not divisible"):
+        epar.moe_opt_state(tx, params, mesh, N + 1, 1.25)
+
+
+def test_capacity_step_requires_moe_call():
+    mesh = _mesh()
+    params, xb, yb = _problem()
+    tx = optax.sgd(0.1)
+    p = epar.shard_params_ep(params, mesh)
+    st = epar.moe_opt_state(tx, params, mesh, N, 1.25)
+    step = epar.make_ep_train_step(
+        lambda pp, b, moe: jnp.float32(0.0), tx, mesh, dispatch="capacity")
+    with pytest.raises(ValueError, match="call moe"):
+        step(p, st, _shard_batch(mesh, xb, yb))
+    with pytest.raises(ValueError, match="dispatch must be"):
+        epar.make_ep_train_step(_cap_loss_fn, tx, mesh, dispatch="topk")
+
+
+# --------------------------------------------- A/B parity (PR 10 bar: 5%)
+def test_gate_parity_quantized_capacity_vs_exact_onehot():
+    """The ConvergenceGate bar applied to MoE dispatch: the quantized
+    capacity path must land within 5% of the exact one-hot reference's
+    final loss on the same learnable problem (ample CF isolates the wire
+    as the only difference)."""
+    steps = 30
+    # exact arm: dense one-hot dispatch, plain jit, same data/optimizer
+    params, xb, yb = _problem()
+    tx = optax.adam(1e-2)
+
+    def dense_loss(p, batch):
+        xx, yy = batch
+        y, aux = epar.dense_moe_apply(p, xx)
+        return jnp.mean((y - yy) ** 2) + 0.01 * aux
+
+    @jax.jit
+    def dense_step(p, o, batch):
+        loss, g = jax.value_and_grad(dense_loss)(p, batch)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o, loss
+
+    p, o = params, tx.init(params)
+    for _ in range(steps):
+        p, o, exact_loss = dense_step(p, o, (xb, yb))
+
+    # the shipped quantized default: int8 capacity dispatch holds the
+    # PR 10 bar with margin (measured ~1.02-1.03x)
+    wire = epar.moe_wire("int8")
+    assert wire == "int8"
+    losses, _, _ = _run_capacity(wire, steps=steps)
+    assert losses[-1] <= float(exact_loss) * 1.05, (
+        losses[-1], float(exact_loss))
+
+    # int4 rides only if the gate admits it; activations carry the 4-bit
+    # grid's noise into the forward pass directly (unlike gradient
+    # quantization, EF cannot cancel it within a step), so its honest
+    # bound at this horizon is looser — docs/moe.md spells this out
+    wire4 = epar.moe_wire("int4")
+    losses4, _, _ = _run_capacity(wire4, steps=steps)
+    assert losses4[-1] < 0.5 * losses4[0]        # converges
+    bar = 1.05 if wire4 == "int8" else 1.25
+    assert losses4[-1] <= float(exact_loss) * bar, (
+        wire4, losses4[-1], float(exact_loss))
+
+
+# --------------------------------------------------------- cache-key pin
+def _golden_exact_ep_step(loss_fn, tx, mesh):
+    """Verbatim copy of tensor.make_sharded_train_step's body — the
+    program make_ep_train_step MUST compile with the knobs unset. If the
+    exact path drifts, update both on purpose (same rationale as
+    test_gspmd.py's pin: an accidental change invalidates jit caches)."""
+    import optax as _optax
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = _optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, in_shardings=(
+        None, None, NamedSharding(mesh, P("dp"))))
+
+
+def test_moe_wire_unset_leaves_exact_path_identical(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MOE_WIRE", raising=False)
+    mesh = _mesh()
+    params, xb, yb = _problem()
+    tx = optax.sgd(0.05)
+
+    def loss_fn(p, batch):
+        xx, yy = batch
+        y, aux = epar.dense_moe_apply(p, xx)
+        return jnp.mean((y - yy) ** 2) + 0.01 * aux
+
+    p = epar.shard_params_ep(params, mesh)
+    o = tx.init(p)
+    batch = (xb, yb)
+    golden = _golden_exact_ep_step(loss_fn, tx, mesh
+                                   ).lower(p, o, batch).as_text()
+    unset = epar.make_ep_train_step(loss_fn, tx, mesh
+                                    ).lower(p, o, batch).as_text()
+    assert unset == golden
+    # the knob only governs the capacity path: even set, exact dispatch
+    # compiles the identical bytes
+    monkeypatch.setenv("HOROVOD_MOE_WIRE", "int8")
+    still = epar.make_ep_train_step(loss_fn, tx, mesh
+                                    ).lower(p, o, batch).as_text()
+    assert still == golden
+
+
+# ------------------------------------------------ byte catalog + metrics
+def test_moe_wire_footprint_catalog():
+    per, world, block = 8 * 40 * 64, 4, 256  # E_loc·C·d
+    bf16 = comp.moe_wire_footprint(per, "bf16", world, block)
+    assert bf16 == 2 * 3 * per * 2
+    assert comp.moe_wire_footprint(per, "none", world, block) == 2 * 3 * per * 4
+    rows = -(-per // block)
+    assert comp.moe_wire_footprint(per, "int8", world, block) == \
+        2 * 3 * rows * (block + 4)
+    assert comp.moe_wire_footprint(per, "int4", world, block) == \
+        2 * 3 * rows * (block // 2 + 4)
+    # the CI bar: both integer wires land under 60% of the bf16 exchange
+    assert comp.moe_wire_footprint(per, "int8", world, block) <= 0.6 * bf16
+    assert comp.moe_wire_footprint(per, "int4", world, block) <= 0.6 * bf16
+    assert comp.moe_wire_footprint(per, "int4", 1, block) == 0  # wireless
+    with pytest.raises(ValueError, match="MoE wire mode"):
+        comp.moe_wire_footprint(per, "fp8", world, block)
+
+
+def test_moe_instruments_match_catalog():
+    block = 64
+    cap = epar.expert_capacity(N // 8, E, 2.0)
+    per = (E // 4) * cap * D
+    wire_c = instruments.wire_bytes().labels(compression="moe-int8")
+    drop_c = instruments.moe_dropped_tokens()
+    w0, d0 = wire_c.value, drop_c.value
+    # one step: the counters advance by exactly THAT step's catalog bytes
+    # and drop count (drops move as the router trains, so multi-step
+    # deltas would compare against the wrong step's stats)
+    losses, stats, _ = _run_capacity("int8", steps=1, block=block,
+                                     instrumented=True)
+    assert wire_c.value - w0 == pytest.approx(
+        comp.moe_wire_footprint(per, "int8", 4, block))
+    assert drop_c.value - d0 == pytest.approx(float(stats["dropped"]))
+    load = np.asarray(stats["load"])
+    got = [instruments.expert_load().labels(expert=str(i)).value
+           for i in range(E)]
+    np.testing.assert_allclose(got, load)
+    assert instruments.moe_load_imbalance().value == pytest.approx(
+        load.max() / load.mean())
+    assert instruments.moe_capacity_factor().value == 2.0
+
+
+def test_anomaly_watch_flags_sustained_imbalance():
+    from horovod_tpu.blackbox.watch import AnomalyWatch
+
+    def snap(imb):
+        return {"hvd_moe_load_imbalance": {
+            "kind": "gauge", "help": "",
+            "series": [{"labels": {}, "value": float(imb)}]}}
+
+    w = AnomalyWatch(interval=1.0, window=8, factor=3.0, min_samples=2)
+    fired = []
+    for _ in range(6):
+        fired += w.observe_snapshot(snap(1.2))   # healthy-ish router
+    assert fired == []
+    fired = w.observe_snapshot(snap(6.0))        # router went degenerate
+    assert [s["id"] for s in fired] == ["anomaly:moe_load_imbalance"]
+    assert "moe_load_imbalance" in w.state()["active"]
+
+
+# ----------------------------------------------------- shard_params_ep fix
+def test_shard_params_ep_unified_error_path():
+    # the error message stringifies tree-path entries through the same
+    # helper as the spec lookup: bare key names, no ['w_in'] repr noise
+    params = {"nested": {"w_in": jnp.zeros((3, 4, 8))}}
+    mesh = _mesh()
+    with pytest.raises(ValueError,
+                       match=r"^nested/w_in: expert dim 3 not divisible "
+                             r"by ep=4$"):
+        epar.shard_params_ep(params, mesh)
+
+
+def test_ep_specs_covers_opt_state():
+    params, _, _ = _problem()
+    tx = optax.adam(1e-3)
+    state = tx.init(params)
+    specs = jax.tree_util.tree_leaves(
+        epar.ep_specs(state), is_leaf=lambda x: isinstance(x, P))
+    # adam's mu/nu mirror the param tree: their expert leaves shard too
+    assert sum(1 for s in specs if s == P("ep")) == 4  # w_in/w_out × mu/nu
