@@ -7,19 +7,33 @@ grows. ``flat`` mode models the pre-hierarchy control plane: one
 ``hier`` mode models per-host sub-coordinators: one ``exchange_batch()``
 call (= ONE frame) per host per round, each carrying that host's ranks.
 
+``tier`` mode models the N-tier tree (HOROVOD_HIERARCHY_TIERS >= 2): one
+``exchange_tier()`` call per TOP-TIER subtree per round, each carrying the
+steady-state single GROUP (seq, payload, rank runs) its whole subtree
+coalesces into — rank 0's work is O(groups), independent of rank count.
+
 The interesting output is the scaling curve — flat does O(ranks) frame
 work and O(ranks) thread wakeups under the coordinator lock per round,
-hierarchical does O(hosts). The ISSUE acceptance bar is >= 5x rounds/s
-for hier over flat at 1024 simulated ranks (64 ranks/host).
+hierarchical does O(hosts), tiered does O(top-tier subtrees). The PR-9
+acceptance bar is >= 5x rounds/s for hier over flat at 1024 simulated
+ranks (64 ranks/host); the PR-15 bar is tier-mode p99 round latency at
+100k ranks <= 5x the 1024-rank point (``--p99-gate``), where the flat
+wire degrades linearly.
 
 Usage::
 
     python benchmarks/coord_bench.py --ranks 64,256,1024 --mode both
+    python benchmarks/coord_bench.py --mode tier \
+        --ranks 1024,10240,102400 --p99-gate 5.0
     python benchmarks/coord_bench.py --history perf.jsonl --check-regression
 
-With ``--history`` the headline metric (hier rounds/s at the largest rank
-count) is appended to the JSONL perf history; ``--check-regression`` exits
-3 when it falls below the recorded trajectory (benchmarks/history.py).
+With ``--history`` the headline metric (hier/tier rounds/s at the largest
+rank count) is appended to the JSONL perf history, plus one
+``coord_round_p99_ms`` row per (mode, ranks) sweep point gated with
+``direction="lower"``; ``--check-regression`` exits 3 when either the
+headline falls below — or any sweep point's p99 rises above — the
+recorded trajectory (benchmarks/history.py). Flat mode is capped at
+``--flat-cap`` simulated ranks (one OS thread per rank).
 """
 
 from __future__ import annotations
@@ -48,13 +62,32 @@ def _payload():
         0, [], [wire.ReqMeta("bench", 0, "float32", (1024,))], epoch=-1)
 
 
-def bench_mode(mode, ranks, ranks_per_host, rounds, warmup):
+def bench_mode(mode, ranks, ranks_per_host, rounds, warmup,
+               tiers=2, fanout=32):
     """One (mode, ranks) cell: persistent worker threads drive ``rounds``
     negotiation rounds through a fresh CoordState; returns rounds/s, p99
     round latency, and the frames-per-round the coordinator observed."""
     if mode == "hier":
         hosts = max(1, ranks // ranks_per_host)
         units = hosts
+    elif mode == "tier":
+        # one worker per TOP-TIER subtree: the tree below it (hosts
+        # coalescing local ranks, mid tiers merging run lists) happens on
+        # other machines in reality, so here its steady-state output — one
+        # group covering the subtree's whole rank span — is precomputed
+        # and only rank 0's per-round work is measured
+        hosts = -(-ranks // ranks_per_host)
+        if tiers <= 0:
+            # auto depth (the docs/control-plane.md deployment rule): add
+            # a tier whenever rank 0 would otherwise face more than
+            # ``fanout`` direct children — this is what keeps its
+            # per-round work bounded as ranks grow two orders
+            tiers = 2
+            while -(-hosts // fanout ** (tiers - 1)) > fanout:
+                tiers += 1
+        span = fanout ** (tiers - 1)          # hosts per top-tier subtree
+        units = -(-hosts // span)
+        unit_ranks = span * ranks_per_host
     else:
         units = ranks
     st = _make_state(ranks)
@@ -89,7 +122,24 @@ def bench_mode(mode, ranks, ranks_per_host, rounds, warmup):
             start.abort()
             done.abort()
 
-    target = host_worker if mode == "hier" else flat_worker
+    def tier_worker(u):
+        lo = u * unit_ranks
+        hi = min(lo + unit_ranks, ranks)
+        subtree = "t%d.%d" % (tiers, u)
+        runs = [(lo, hi - lo)]
+        try:
+            for seq in range(total):
+                start.wait()
+                st.exchange_tier(tiers, subtree,
+                                 [(seq, payload, runs)])
+                done.wait()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+            start.abort()
+            done.abort()
+
+    target = {"hier": host_worker, "tier": tier_worker}.get(mode,
+                                                            flat_worker)
     threads = [threading.Thread(target=target, args=(u,), daemon=True)
                for u in range(units)]
     for t in threads:
@@ -119,6 +169,7 @@ def bench_mode(mode, ranks, ranks_per_host, rounds, warmup):
     return {
         "mode": mode,
         "ranks": ranks,
+        "tiers": tiers if mode == "tier" else 1,
         "units": units,
         "rounds": rounds,
         "rounds_per_sec": round(rounds / wall, 2) if wall else 0.0,
@@ -135,8 +186,22 @@ def main(argv=None):
                     help="batch size per simulated host in hier mode")
     ap.add_argument("--rounds", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--mode", choices=["flat", "hier", "both"],
+    ap.add_argument("--mode", choices=["flat", "hier", "tier", "both"],
                     default="both")
+    ap.add_argument("--tiers", type=int, default=0,
+                    help="aggregation-tree depth modeled in tier mode "
+                         "(0 = auto: deepen until rank 0 has at most "
+                         "--fanout direct children)")
+    ap.add_argument("--fanout", type=int, default=8,
+                    help="children per aggregator above the host tier")
+    ap.add_argument("--flat-cap", type=int, default=4096,
+                    help="skip flat cells above this rank count (flat "
+                         "mode spawns one OS thread per rank)")
+    ap.add_argument("--p99-gate", type=float, default=None,
+                    help="exit 3 when p99 round latency at the LARGEST "
+                         "rank count exceeds this multiple of the "
+                         "smallest point's p99 (the 100k-rank scaling "
+                         "acceptance gate)")
     ap.add_argument("--history", default=None,
                     help="JSONL perf-history file (benchmarks/history.py)")
     ap.add_argument("--check-regression", action="store_true",
@@ -151,16 +216,25 @@ def main(argv=None):
     results = []
     for ranks in rank_counts:
         for mode in modes:
+            if mode == "flat" and ranks > args.flat_cap:
+                print(json.dumps({
+                    "mode": "flat", "ranks": ranks, "skipped":
+                    "above --flat-cap %d (one thread per rank)"
+                    % args.flat_cap}))
+                continue
             r = bench_mode(mode, ranks, args.ranks_per_host,
-                           args.rounds, args.warmup)
+                           args.rounds, args.warmup,
+                           tiers=args.tiers, fanout=args.fanout)
             results.append(r)
             print(json.dumps(r))
         if args.mode == "both":
-            flat = next(r for r in results
-                        if r["ranks"] == ranks and r["mode"] == "flat")
-            hier = next(r for r in results
-                        if r["ranks"] == ranks and r["mode"] == "hier")
-            if flat["rounds_per_sec"]:
+            flat = next((r for r in results
+                         if r["ranks"] == ranks and r["mode"] == "flat"),
+                        None)
+            hier = next((r for r in results
+                         if r["ranks"] == ranks and r["mode"] == "hier"),
+                        None)
+            if flat and hier and flat["rounds_per_sec"]:
                 print(json.dumps({
                     "metric": "coord_hier_speedup",
                     "ranks": ranks,
@@ -168,11 +242,12 @@ def main(argv=None):
                                    / flat["rounds_per_sec"], 2)}))
 
     biggest = max(rank_counts)
+    best_mode = "tier" if args.mode == "tier" else "hier"
     headline = next((r for r in results
-                     if r["ranks"] == biggest and r["mode"] == "hier"),
+                     if r["ranks"] == biggest and r["mode"] == best_mode),
                     results[-1])
     result = {
-        "metric": "coord_hier_rounds_per_sec",
+        "metric": "coord_%s_rounds_per_sec" % best_mode,
         "value": headline["rounds_per_sec"],
         "unit": "rounds/s",
         "ranks": headline["ranks"],
@@ -180,6 +255,33 @@ def main(argv=None):
     print(json.dumps(result))
 
     rc = 0
+    # the 100k scaling gate (ISSUE 15 acceptance): p99 round latency at
+    # the largest sweep point must stay within --p99-gate times the
+    # smallest point's — flat degrades ~linearly, the tree must not
+    if args.p99_gate and len(rank_counts) >= 2:
+        per_ranks = {r["ranks"]: r for r in results
+                     if r["mode"] == best_mode}
+        if len(per_ranks) >= 2:
+            small = per_ranks[min(per_ranks)]
+            big = per_ranks[max(per_ranks)]
+            scale = (big["p99_round_ms"] / small["p99_round_ms"]
+                     if small["p99_round_ms"] else 0.0)
+            verdict = {
+                "metric": "coord_p99_scaling",
+                "mode": best_mode,
+                "ranks_small": small["ranks"], "ranks_big": big["ranks"],
+                "p99_small_ms": small["p99_round_ms"],
+                "p99_big_ms": big["p99_round_ms"],
+                "scale": round(scale, 2), "gate": args.p99_gate,
+                "pass": scale <= args.p99_gate,
+            }
+            print(json.dumps(verdict))
+            if not verdict["pass"]:
+                print("# P99 GATE FAILED: %dx ranks cost %.2fx p99 "
+                      "(gate %.1fx)" % (big["ranks"] // small["ranks"],
+                                        scale, args.p99_gate),
+                      file=sys.stderr)
+                rc = 3
     if args.history:
         from benchmarks.history import (append_record, check_regression,
                                         load_history)
@@ -202,6 +304,39 @@ def main(argv=None):
                       f"{verdict['floor']} (baseline {verdict['baseline']} "
                       f"over {verdict['samples']} runs)", file=sys.stderr)
                 rc = 3
+        # one p99 row per sweep point, gated direction="lower": a latency
+        # regression at ANY scale (not just the headline's throughput)
+        # fails CI. Trajectories are per (mode, ranks) — history rows for
+        # other sweep points must not vote in this point's baseline.
+        p99_history = load_history(args.history,
+                                   metric="coord_round_p99_ms")
+        for r in results:
+            if args.check_regression:
+                verdict = check_regression(
+                    [h for h in p99_history
+                     if h.get("ranks") == r["ranks"]
+                     and h.get("mode") == r["mode"]],
+                    r["p99_round_ms"], direction="lower",
+                    **{k: v for k, v in (
+                        ("window", args.regression_window),
+                        ("tolerance", args.regression_tolerance))
+                       if v is not None})
+                if verdict["regression"]:
+                    print("# REGRESSION: coord_round_p99_ms[%s,%d] = %s "
+                          "rose above the gate %s (baseline %s over %d "
+                          "runs)" % (r["mode"], r["ranks"],
+                                     r["p99_round_ms"], verdict["floor"],
+                                     verdict["baseline"],
+                                     verdict["samples"]), file=sys.stderr)
+                    rc = 3
+            append_record(args.history, {
+                "metric": "coord_round_p99_ms",
+                "value": r["p99_round_ms"], "unit": "ms",
+                "direction": "lower", "mode": r["mode"],
+                "ranks": r["ranks"],
+                "ranks_per_host": args.ranks_per_host,
+                "rounds": args.rounds,
+            })
         append_record(args.history, {
             "metric": result["metric"], "value": result["value"],
             "unit": result["unit"], "ranks": result["ranks"],

@@ -1071,6 +1071,123 @@ def check_serving_kill() -> None:
           "still serving the hvd_serving_* catalog")
 
 
+def check_tier_rehome() -> None:
+    """N-tier control-plane smoke (docs/control-plane.md): a 2-tier tree
+    on simulated hosts — 4 fake ranks behind two host-tier
+    sub-coordinators behind one mid-tier aggregator — loses the mid-tier
+    aggregator under load. Its TierStandby must promote a stateless
+    replacement under ``addr.{gen}.t2.0.f1``, the orphaned host-tier
+    children must re-home there and re-ship their in-flight ledgers, and
+    every rank's per-round response digest must stay identical across the
+    failover (replay shards make the re-ship idempotent)."""
+    import hashlib
+    import socket as _socket
+    import threading
+    import time
+
+    from horovod_tpu.run import rendezvous
+    from horovod_tpu.runtime import wire
+    from horovod_tpu.runtime.coordinator import (MSG_HELLO, MSG_LIST,
+                                                 MSG_RESP, CoordState,
+                                                 CoordinatorServer,
+                                                 _publish_key)
+    from horovod_tpu.runtime.hierarchy import SubCoordinator, TierStandby
+
+    gen, world, rounds, kill_at = 555, 4, 6, 2
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    saved = {k: os.environ.get(k) for k in ("HVD_KV_ADDR", "HVD_SECRET")}
+    os.environ["HVD_KV_ADDR"] = f"127.0.0.1:{kv.port}"
+    os.environ["HVD_SECRET"] = secret
+    state = CoordState(world, 0, cache_capacity=1024,
+                       stall_warning_s=60.0, stall_shutdown_s=0.0)
+    server = CoordinatorServer(state, secret)
+    mid = standby = None
+    hosts = []
+    digests = [[None] * rounds for _ in range(world)]
+    errors = []
+    try:
+        mid = SubCoordinator("127.0.0.1", server.port, secret,
+                             leader_rank=0, tier=2, index=0, tiers=2)
+        _publish_key(f"addr.{gen}.t2.0", f"127.0.0.1:{mid.port}", secret)
+        standby = TierStandby(
+            gen, 2, 0, secret,
+            make_aggregator=lambda: SubCoordinator(
+                "127.0.0.1", server.port, secret, leader_rank=0,
+                tier=2, index=0, tiers=2),
+            probe_interval=0.1, misses=2).start()
+        for g in (0, 1):
+            hosts.append(SubCoordinator(
+                "127.0.0.1", mid.port, secret, leader_rank=2 * g,
+                tier=1, index=g, tiers=2,
+                up_fail_base=f"addr.{gen}.t2.0"))
+        barrier = threading.Barrier(world)
+
+        def worker(rank):
+            try:
+                sock = _socket.create_connection(
+                    ("127.0.0.1", hosts[rank // 2].port), timeout=10)
+                sock.settimeout(0.5)
+                wire.send_frame(sock, secret, MSG_HELLO, 0, rank)
+                stop = threading.Event()
+                for i in range(rounds):
+                    barrier.wait(timeout=60)
+                    if rank == 0 and i == kill_at:
+                        mid.stop()  # kill the mid-tier aggregator mid-run
+                    m = wire.ReqMeta(f"g{i}", 0, "float32", (4,))
+                    wire.send_frame(sock, secret, MSG_LIST, i, rank,
+                                    wire.encode_request_list(
+                                        0, [], [m], epoch=-1))
+                    deadline = time.monotonic() + 60
+                    while True:
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"rank {rank} round {i} timed out")
+                        try:
+                            mt, seq, _, data = wire.recv_frame(
+                                sock, secret, stop)
+                        except _socket.timeout:
+                            continue
+                        if mt == MSG_RESP and seq == i:
+                            break
+                    digests[rank][i] = hashlib.sha256(data).hexdigest()
+                sock.close()
+            except Exception as exc:  # surfaced below, thread-safe enough
+                errors.append((rank, exc))
+
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert all(not t.is_alive() for t in ts), "tier smoke deadlocked"
+        assert not errors, f"worker failures: {errors}"
+        assert standby.promoted, (
+            "tier standby never promoted a replacement aggregator")
+        for i in range(rounds):
+            row = {digests[r][i] for r in range(world)}
+            assert len(row) == 1, (
+                f"round {i} diverged across ranks: {row}")
+    finally:
+        for h in hosts:
+            h.stop()
+        if standby is not None:
+            standby.stop()
+        if mid is not None:
+            mid.stop()
+        server.stop()
+        kv.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    print(f"ok: tier smoke — killed the mid-tier aggregator at round "
+          f"{kill_at}, children re-homed to the promoted standby, all "
+          f"{rounds} rounds bit-identical across {world} ranks")
+
+
 def main():
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
@@ -1083,6 +1200,7 @@ def main():
     check_bucket_overlap()
     check_blackbox_doctor()
     check_coordinator_failover()
+    check_tier_rehome()
     check_straggler_adaptive()
     check_adaptive_wire()
     check_gspmd_quantized()
@@ -1091,8 +1209,9 @@ def main():
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
           "+ bucket overlap + blackbox doctor + coordinator failover "
-          "+ straggler adaptive + adaptive wire + quantized GSPMD wire "
-          "+ quantized MoE dispatch + serving worker-kill valid")
+          "+ tier aggregator re-home + straggler adaptive + adaptive wire "
+          "+ quantized GSPMD wire + quantized MoE dispatch "
+          "+ serving worker-kill valid")
 
 
 if __name__ == "__main__":

@@ -44,13 +44,21 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 stage "controlplane: hierarchical negotiation, coordinator failover, storms"
 python -m pytest tests/test_coord.py -q -m "not integration"
 # the control-plane integrations run on plain CPU (elastic Popen harness):
-# SIGKILL the rank-0 coordinator mid-step, and a real hierarchical job
+# SIGKILL the rank-0 coordinator mid-step, a real hierarchical job, and
+# SIGKILL rank 0 with hierarchy AND standby enabled together
 python -m pytest -q \
     "tests/test_coord.py::test_coordinator_sigkill_failover_bit_identical" \
-    "tests/test_coord.py::test_hierarchical_mode_end_to_end"
+    "tests/test_coord.py::test_hierarchical_mode_end_to_end" \
+    "tests/test_coord.py::test_hierarchical_standby_sigkill"
 # the hierarchical path must beat flat negotiation at scale (rounds/s is
 # printed; the >=5x acceptance curve lives in docs/control-plane.md)
 python benchmarks/coord_bench.py --ranks 256 --rounds 15 --mode both
+# N-tier sweep: 1k/10k/100k fake ranks through the aggregation tree; p99
+# round latency at 100k must stay within 5x the 1k point, and every sweep
+# point appends a direction="lower" row to the perf history
+python benchmarks/coord_bench.py --mode tier --ranks 1024,10240,102400 \
+    --rounds 15 --warmup 3 --p99-gate 5.0 \
+    --history /tmp/hvd_ci_coord_hist.jsonl --check-regression
 
 stage "tracing: clock, spans, merge, hvdprof critical-path report"
 python -m pytest tests/test_tracing.py -q

@@ -34,6 +34,8 @@ _SEV_ORDER = {SEV_CRITICAL: 0, SEV_WARNING: 1, SEV_INFO: 2}
 STRAGGLER_SKEW_S = 1.0
 #: reconnects by one rank that constitute a storm
 RECONNECT_STORM_COUNT = 3
+#: sub-coordinator upstream reconnects at ONE tier that constitute a flap
+TIER_FLAP_COUNT = 3
 #: ok->miss heartbeat transitions that constitute a flap
 HEARTBEAT_FLAP_TRANSITIONS = 2
 #: bitwidth decision changes for ONE bucket that constitute thrash
@@ -214,6 +216,36 @@ def detect_reconnect_storm(bundle) -> List[dict]:
     return sigs
 
 
+def detect_tier_aggregator_flap(bundle) -> List[dict]:
+    """Repeated sub-coordinator upstream reconnects concentrated at one
+    aggregation tier (events named ``tier_N``): the tier's parent slot is
+    unstable — a flapping mid-tier aggregator, a half-dead standby, or a
+    network partition along that tier's links — distinct from one rank's
+    reconnect storm (docs/control-plane.md)."""
+    per_tier: Dict[int, int] = {}
+    for _, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_RECONNECT:
+            continue
+        name = str(ev.get("name") or "")
+        if not name.startswith("tier_"):
+            continue
+        try:
+            tier = int(name[5:])
+        except ValueError:
+            continue
+        per_tier[tier] = per_tier.get(tier, 0) + 1
+    sigs = []
+    for tier, n in sorted(per_tier.items()):
+        if n >= TIER_FLAP_COUNT:
+            sigs.append(make_signature(
+                "tier_aggregator_flap", SEV_WARNING,
+                "tier aggregator flap: sub-coordinators at tier %d "
+                "reconnected upstream %d times — the tier-%d parent slot "
+                "is unstable" % (tier, n, tier + 1),
+                tier=tier, reconnects=n))
+    return sigs
+
+
 def detect_heartbeat_flap(bundle) -> List[dict]:
     """A rank repeatedly missing heartbeats and recovering — a flapping
     network or an overloaded host, not a clean death."""
@@ -388,6 +420,7 @@ DETECTORS = (
     detect_chronic_straggler,
     detect_latency_regression,
     detect_reconnect_storm,
+    detect_tier_aggregator_flap,
     detect_heartbeat_flap,
     detect_bitwidth_thrash,
 )

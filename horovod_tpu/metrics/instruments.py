@@ -296,9 +296,20 @@ def coord_batch_ranks():
     return get_registry().histogram(
         "hvd_coord_batch_ranks",
         "Ranks carried per batched negotiation frame received by the "
-        "coordinator (hierarchical control plane, "
-        "HOROVOD_HIERARCHICAL_COORD; docs/control-plane.md).",
-        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        "coordinator, labeled by the sending tier ('host' for legacy "
+        "MSG_BATCH host frames, the tier number for grouped MSG_TBATCH "
+        "frames; HOROVOD_HIERARCHICAL_COORD, HOROVOD_HIERARCHY_TIERS; "
+        "docs/control-plane.md).", labels=("tier",),
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096,
+                 16384, 65536, 262144))
+
+
+def coord_tier_depth():
+    return get_registry().gauge(
+        "hvd_coord_tier_depth",
+        "Configured aggregation-tree depth of the hierarchical control "
+        "plane (1 = the single host tier; HOROVOD_HIERARCHY_TIERS; "
+        "docs/control-plane.md).", agg="max")
 
 
 def coord_failovers():
@@ -320,9 +331,10 @@ def epoch_coalesced_joins():
 def standby_journal_lag():
     return get_registry().gauge(
         "hvd_standby_journal_lag",
-        "Journal records queued at rank 0 but not yet shipped to the "
-        "warm-standby coordinator (0 = the standby is current; "
-        "docs/control-plane.md).", agg="max")
+        "Journal records queued at rank 0 but not yet shipped to a warm "
+        "standby, labeled by the standby's tier ('root' for the global "
+        "rank-0 standby, the tier number for subtree-scoped streams; "
+        "docs/control-plane.md).", labels=("tier",), agg="max")
 
 
 # --------------------------------------------------------------- serving

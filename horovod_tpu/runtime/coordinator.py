@@ -120,6 +120,15 @@ MSG_BATCH_HB = 16
 MSG_REPL_HELLO = 17
 MSG_SNAPSHOT = 18
 MSG_JOURNAL = 19
+# N-tier hierarchical control plane (HOROVOD_HIERARCHY_TIERS >= 2,
+# docs/control-plane.md): tier aggregators ship GROUPED batches — one
+# (seq, payload, runs) entry per distinct payload, runs naming the ranks
+# that submitted those exact bytes — so rank 0's per-round work is bounded
+# by its direct children, not by total ranks. 20-22 are the serving frames
+# (wire.MSG_SERVE_*).
+MSG_TBATCH = 23
+MSG_TBATCH_RESP = 24
+MSG_THB = 25
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -134,7 +143,8 @@ _FUSABLE = (int(RequestType.ALLREDUCE), int(RequestType.ADASUM),
 class _Pending:
     """Coordinator-side state for one named tensor still being negotiated."""
 
-    __slots__ = ("metas", "first_t", "order_idx", "arrivals")
+    __slots__ = ("metas", "first_t", "order_idx", "arrivals", "gcount",
+                 "grouped")
 
     def __init__(self, order_idx: int):
         self.metas: Dict[int, ReqMeta] = {}
@@ -143,6 +153,12 @@ class _Pending:
         # first-arrival time per rank: the spread when the tensor becomes
         # ready is the straggler skew (hvd_straggler_skew_seconds)
         self.arrivals: Dict[int, float] = {}
+        # grouped tier deposits: gcount ranks vouched via run-length groups
+        # (their metas are identical to a stored representative), grouped
+        # marks which meta keys are representatives rather than per-rank
+        # deposits — the readiness check counts instead of enumerating
+        self.gcount = 0
+        self.grouped: set = set()
 
 
 class CoordState:
@@ -249,8 +265,32 @@ class CoordState:
         # of how many ranks it carries) — the O(hosts)-not-O(ranks) claim is
         # asserted against this counter
         self.frames_in = 0
+        # ---- N-tier grouped deposits (MSG_TBATCH, docs/control-plane.md):
+        # per-seq count of ranks vouched by run-length groups (the barrier
+        # test becomes a count compare, not a per-rank set walk), per-seq
+        # evicted cache ids reported inside groups, per-seq grouped rank
+        # sets (materialized ONLY when a straggler policy needs per-rank
+        # exclusion checks), and the per-subtree response shard: one cached
+        # reply per (subtree, seq) instead of one per rank, so rank-0 replay
+        # state is bounded by its direct children
+        self.gcounts: Dict[int, int] = {}
+        self.ginvalid: Dict[int, set] = {}
+        self.glists: Dict[int, set] = {}
+        self.shards: Dict[str, Dict[int, bytes]] = {}
+        self._tier_inflight: Dict[Tuple[str, int], int] = {}
+        # coverage already deposited per in-flight (subtree, seq): a
+        # mid-tier partial flush can legitimately split one seq across
+        # frames, so "replay" means no NOVEL ranks, not just a seen key
+        self._tier_runs: Dict[Tuple[str, int], List[Tuple[int, int]]] = {}
+        # subtree registry: "t{tier}.{index}" -> (tier, runs) from the
+        # latest MSG_TBATCH/MSG_THB, plus last-seen time — liveness above
+        # the host tier is vouched per subtree, not per rank
+        self.subtrees: Dict[str, Tuple[int, List[Tuple[int, int]]]] = {}
+        self.subtree_seen: Dict[str, float] = {}
         # ---- standby replication: monotonic journal seq + attached shipper
-        # queues (one per standby; items are (msg_type, payload) tuples)
+        # queues (one per standby; items are (subtree_filter, queue) and
+        # queue items are (msg_type, payload) tuples — a sink with a
+        # subtree filter receives only that subtree's churn + global records)
         self.jseq = 0
         self._journal_sinks: List = []
         # optional hook run at the top of every negotiation — the
@@ -323,7 +363,8 @@ class CoordState:
         waits: List[Tuple[int, int, str, object, bytes]] = []
         with self.cv:
             self.frames_in += 1
-            instruments.coord_batch_ranks().observe(len(entries))
+            instruments.coord_batch_ranks().labels(tier="host").observe(
+                len(entries))
             self._flush_lost_locked()
             for rank, seq, payload in entries:
                 if self.bye:
@@ -370,6 +411,249 @@ class CoordState:
                 self.last_resp[rank] = (seq, data)
                 replies.append((rank, seq, data))
         return replies, deferred
+
+    def exchange_tier(self, tier: int, subtree: str, groups):
+        """One GROUPED frame from a tier aggregator (docs/control-plane.md):
+        ``groups`` is [(seq, payload, runs)] where every rank in ``runs``
+        submitted exactly ``payload``. Work here is O(groups), not
+        O(ranks): the payload decodes once per group, the barrier advances
+        by a count, the negotiation table stores one representative meta
+        per group, and the replay cache keeps ONE response per
+        (subtree, seq) instead of one per rank. Returns (reply_groups,
+        deferred) where reply_groups is [(seq, response_bytes, runs)] and
+        deferred is [(rank, seq, payload)] for prospective joiners."""
+        replies: List[Tuple[int, bytes, list]] = []
+        deferred: List[Tuple[int, int, bytes]] = []
+        waits: List[Tuple[int, list, int, str, object]] = []
+        with self.cv:
+            self.frames_in += 1
+            instruments.coord_batch_ranks().labels(tier=str(tier)).observe(
+                sum(wire.runs_count(g[2]) for g in groups))
+            self._flush_lost_locked()
+            shard = self.shards.setdefault(subtree, {})
+            if groups:
+                # register the subtree's coverage (groups of one seq are
+                # disjoint; across seqs they repeat, so take the widest seq)
+                per_seq: Dict[int, list] = {}
+                for gseq, _, gruns in groups:
+                    per_seq[gseq] = wire.merge_runs(
+                        per_seq.get(gseq, []), gruns)
+                self.subtrees[subtree] = (
+                    tier, max(per_seq.values(), key=wire.runs_count))
+                self.subtree_seen[subtree] = time.monotonic()
+            fresh: set = set()
+            for seq, payload, runs in groups:
+                n = wire.runs_count(runs)
+                if self.bye:
+                    replies.append((seq, self._shutdown_bytes(), runs))
+                    continue
+                if self.elastic:
+                    # per-rank gatekeeping only exists in elastic mode; the
+                    # static fast path never materializes rank lists
+                    ranks = []
+                    for r in wire.runs_to_ranks(runs):
+                        if r in self.members:
+                            ranks.append(r)
+                        else:
+                            deferred.append((r, seq, payload))
+                    if not ranks:
+                        continue
+                    runs = wire.ranks_to_runs(ranks)
+                    n = len(ranks)
+                cached = shard.get(seq)
+                if cached is not None:
+                    # re-shipped batch after an aggregator reconnect:
+                    # answered from the subtree shard, O(1) per group
+                    replies.append((seq, cached, runs))
+                    continue
+                key = (subtree, seq)
+                dep_runs, dep_n = runs, n
+                if key in self._tier_inflight and key not in fresh:
+                    novel = wire.runs_subtract(
+                        runs, self._tier_runs.get(key, []))
+                    if not novel:
+                        # a re-shipped group racing its original handler
+                        # thread: wait for the shard entry it will write
+                        waits.append((seq, runs, n, "replay", None))
+                        continue
+                    # a mid-tier partial flush split this seq's coverage
+                    # across frames: deposit only the novel ranks, but the
+                    # reply still covers everything this frame vouched for
+                    dep_runs, dep_n = novel, wire.runs_count(novel)
+                decoded = wire.decode_request_list(payload)
+                flags, cids, reqs, score, epoch = decoded
+                if self.elastic:
+                    if epoch != self.epoch:
+                        replies.append((seq, self._ranks_changed_bytes(),
+                                        runs))
+                        continue
+                    if flags & wire.REQ_COMMIT:
+                        self.committed.update(ranks)
+                        self._maybe_admit_locked()
+                        if self.epoch != epoch:
+                            replies.append(
+                                (seq, self._ranks_changed_bytes(), runs))
+                            continue
+                if score is not None and self.tuner is not None:
+                    self.round_bytes += score[0] * dep_n
+                    self.round_seconds = max(self.round_seconds, score[1])
+                rep = dep_runs[0][0]
+                for cid in cids:
+                    cmetas = self.cache_meta.get(cid)
+                    m = None if cmetas is None else (
+                        cmetas.get(rep) or cmetas.get(-1))
+                    if m is not None:
+                        self.cache_hits += dep_n
+                        instruments.response_cache_hits().inc(dep_n)
+                        if m.name in self.cache_ids:
+                            self.cache_ids.move_to_end(m.name)
+                        self._add_group_locked(m, rep, dep_n, dep_runs)
+                    else:
+                        self.ginvalid.setdefault(seq, set()).add(cid)
+                        self.cache_misses += dep_n
+                        instruments.response_cache_misses().inc(dep_n)
+                for m in reqs:
+                    self.cache_misses += dep_n
+                    instruments.response_cache_misses().inc(dep_n)
+                    self._add_group_locked(m, rep, dep_n, dep_runs)
+                self.gcounts[seq] = self.gcounts.get(seq, 0) + dep_n
+                if self.straggler is not None:
+                    self.glists.setdefault(seq, set()).update(
+                        wire.runs_to_ranks(dep_runs))
+                self._tier_inflight[key] = self._tier_inflight.get(key,
+                                                                   0) + 1
+                self._tier_runs[key] = wire.merge_runs(
+                    self._tier_runs.get(key, []), dep_runs)
+                fresh.add(key)
+                self._maybe_negotiate_locked(seq)
+                waits.append((seq, runs, dep_n, "wait", self.epoch))
+            for seq, runs, n, kind, entry_epoch in waits:
+                key = (subtree, seq)
+                try:
+                    if kind == "replay":
+                        data = self._await_tier_replay_locked(shard, key,
+                                                              seq)
+                    else:
+                        data = self._await_tier_locked(seq, n, entry_epoch)
+                        shard[seq] = data
+                        if len(shard) > 4:
+                            shard.pop(min(shard))
+                finally:
+                    if kind == "wait":
+                        cnt = self._tier_inflight.get(key, 0) - 1
+                        if cnt > 0:
+                            self._tier_inflight[key] = cnt
+                        else:
+                            self._tier_inflight.pop(key, None)
+                            self._tier_runs.pop(key, None)
+                    self.cv.notify_all()
+                replies.append((seq, data, runs))
+        return replies, deferred
+
+    def _await_tier_replay_locked(self, shard, key, seq) -> bytes:
+        """A re-shipped group racing its original handler thread (still
+        blocked in the barrier): wait for the shard entry it will write."""
+        while True:
+            if self.bye:
+                return self._shutdown_bytes()
+            cached = shard.get(seq)
+            if cached is not None:
+                return cached
+            if key not in self._tier_inflight:
+                # original vanished resultless — only reachable through a
+                # membership reset clearing the shard; answer accordingly
+                return (self._ranks_changed_bytes() if self.elastic
+                        else self._shutdown_bytes())
+            self.cv.wait(timeout=0.5)
+
+    def _await_tier_locked(self, seq: int, n: int,
+                           entry_epoch: int) -> bytes:
+        """Barrier wait for a grouped deposit covering ``n`` ranks: all of
+        them fetch in one count bump."""
+        while seq not in self.resps:
+            if self.bye:
+                return self._shutdown_bytes()
+            if self.elastic and self.epoch != entry_epoch:
+                return self._ranks_changed_bytes()
+            self.cv.wait(timeout=0.5)
+            self._flush_lost_locked()
+        data = self.resps[seq]
+        self.fetched[seq] = self.fetched.get(seq, 0) + n
+        if self.fetched[seq] >= self.expected.get(seq, self.world):
+            self._drop_barrier_locked(seq)
+        return data
+
+    def _add_group_locked(self, m, rep: int, n: int, runs) -> None:
+        """Grouped deposit into the negotiation table: one representative
+        meta plus a count, instead of n per-rank dict writes. Ragged
+        collectives (ALLGATHER/ALLTOALL) still need per-rank metas for
+        their size blocks, so those expand — identical payloads mean
+        identical metas, so expansion is a pure fan-out."""
+        p = self.table.get(m.name)
+        if p is None:
+            p = _Pending(self.order_ctr)
+            self.order_ctr += 1
+            self.table[m.name] = p
+        p.metas[rep] = m
+        # the shared -1 slot flows into cache_meta on assignment, so later
+        # grouped cache hits resolve even when the group's lowest rank (the
+        # representative) shifts across rounds
+        p.metas[-1] = m
+        p.grouped.add(rep)
+        p.grouped.add(-1)
+        p.arrivals.setdefault(rep, time.monotonic())
+        p.gcount += n
+        if int(m.rtype) in (int(RequestType.ALLGATHER),
+                            int(RequestType.ALLTOALL)):
+            for r in wire.runs_to_ranks(runs):
+                p.metas[r] = m
+                p.grouped.add(r)
+
+    def mark_subtree_alive(self, subtree: str, tier: int, runs) -> None:
+        """MSG_THB bookkeeping: the subtree's aggregator vouches for every
+        rank in ``runs``. Tier-vouched ranks are NOT tracked in the
+        per-rank ``last_seen`` ledger (that would be O(ranks) per beat);
+        a vouched rank inside the reconnect grace window is released."""
+        with self.cv:
+            self.subtrees[subtree] = (tier, runs)
+            self.subtree_seen[subtree] = time.monotonic()
+            if self.disconnected:
+                for r in list(self.disconnected):
+                    if wire.runs_contain(runs, r):
+                        self.disconnected.pop(r, None)
+                        self._hb_miss_counts.pop(r, None)
+
+    def subtree_disconnected(self, subtree: str, reason: str) -> None:
+        """The subtree's upstream connection died: open the ordinary
+        reconnect grace window for every rank it vouched for (one log line,
+        not O(ranks) of them — the aggregator usually re-homes to a tier
+        standby and the next vouch clears all of this)."""
+        with self.cv:
+            info = self.subtrees.get(subtree)
+            if info is None or self.bye:
+                return
+            tier, runs = info
+            now = time.monotonic()
+            opened = 0
+            for r in wire.runs_to_ranks(runs):
+                if r in self.members and r not in self.disconnected:
+                    self.disconnected[r] = (
+                        now, "tier subtree %s lost: %s" % (subtree, reason))
+                    opened += 1
+        if opened:
+            logger.warning(
+                "coordinator: tier-%d subtree %s connection lost (%s); "
+                "reconnect grace window open for %d ranks", tier, subtree,
+                reason, opened)
+
+    def _covering_subtree_locked(self, ranks) -> Tuple[str, int]:
+        """The registered subtree containing ALL of ``ranks`` (top-tier
+        subtrees are disjoint), or ("", 0) for cross-subtree/global churn —
+        the journal shard tag for this membership change."""
+        for name, (tier, runs) in self.subtrees.items():
+            if all(wire.runs_contain(runs, r) for r in ranks):
+                return name, tier
+        return "", 0
 
     def _await_replay_locked(self, rank: int, seq: int) -> Optional[bytes]:
         """Wait out a replay racing the original serve thread. Returns the
@@ -472,22 +756,28 @@ class CoordState:
         # a coalescing loss reset is pending: completing the barrier now
         # would negotiate against a member set about to shrink — hold until
         # the reset flushes (bounded by admission_batch_s)
-        if seq not in self.lists or self._pending_lost:
+        if (seq not in self.lists and seq not in self.gcounts) \
+                or self._pending_lost:
             return
-        row = self.lists[seq]
+        row = self.lists.get(seq, {})
         if self.straggler is not None and self.straggler.excluded:
             # partial barrier: complete once every NON-excluded member has
             # deposited; the excluded rank trails and fetches late
-            ready = all(m in row for m in self.members
+            gset = self.glists.get(seq, ())
+            ready = all(m in row or m in gset for m in self.members
                         if m not in self.straggler.excluded)
         else:
-            ready = len(row) == len(self.members)
+            # grouped tier deposits are counted, not enumerated: the
+            # barrier is complete when flat deposits + vouched group ranks
+            # cover the member set (flat mode keeps the exact old compare)
+            ready = (len(row) + self.gcounts.get(seq, 0)
+                     == len(self.members))
         if ready:
             # expected counts ALL members: the excluded rank still fetches
             # this seq's response (after the fact), so the cached response
             # must survive until it does
             self.expected[seq] = len(self.members)
-            self.resps[seq] = self._negotiate(self.lists.pop(seq), seq)
+            self.resps[seq] = self._negotiate(self.lists.pop(seq, {}), seq)
             self.cv.notify_all()
 
     def _await_join_locked(self, rank: int) -> bytes:
@@ -518,14 +808,19 @@ class CoordState:
         data = self.resps[seq]
         self.fetched[seq] = self.fetched.get(seq, 0) + 1
         if self.fetched[seq] >= self.expected.get(seq, self.world):
-            del self.resps[seq]
-            del self.fetched[seq]
-            self.expected.pop(seq, None)
-            # a trailing excluded rank's late deposit can recreate the
-            # barrier entry AFTER partial negotiation popped it; everyone
-            # (including that rank) has now fetched, so drop the remnant
-            self.lists.pop(seq, None)
+            self._drop_barrier_locked(seq)
         return data
+
+    def _drop_barrier_locked(self, seq: int) -> None:
+        """Everyone expected has fetched: release every remnant of the seq
+        barrier (a trailing excluded rank's late deposit can recreate the
+        ``lists`` entry AFTER partial negotiation popped it)."""
+        self.resps.pop(seq, None)
+        self.fetched.pop(seq, None)
+        self.expected.pop(seq, None)
+        self.lists.pop(seq, None)
+        self.gcounts.pop(seq, None)
+        self.glists.pop(seq, None)
 
     # ---- elastic membership (all under self.cv unless noted)
     def rank_lost(self, rank: int, reason: str) -> None:
@@ -563,7 +858,7 @@ class CoordState:
                 return
             self._reset_locked(
                 f"worker lost: rank {rank} dropped its control-plane "
-                f"connection ({reason})")
+                f"connection ({reason})", ranks=(rank,))
 
     def _flush_lost_locked(self, force: bool = False) -> None:
         """Apply a coalesced loss reset once the batching window closes
@@ -579,13 +874,13 @@ class CoordState:
         if len(ranks) == 1:
             self._reset_locked(
                 f"worker lost: rank {ranks[0]} dropped its control-plane "
-                f"connection ({lost[0][1]})")
+                f"connection ({lost[0][1]})", ranks=ranks)
         else:
             reasons = "; ".join(f"rank {r}: {why}" for r, why in lost)
             self._reset_locked(
                 f"workers lost: ranks {ranks} dropped their control-plane "
                 f"connections in one {self.admission_batch_s * 1000:g}ms "
-                f"window ({reasons})")
+                f"window ({reasons})", ranks=ranks)
 
     # ---- liveness (docs/fault-tolerance.md)
     def mark_alive(self, rank: int) -> None:
@@ -725,15 +1020,18 @@ class CoordState:
                 readmit_report(r)
             self._reset_locked(
                 f"worker joined: rank(s) {admitted} admitted at commit "
-                "boundary")
+                "boundary", ranks=admitted)
 
-    def _reset_locked(self, reason: str) -> None:
+    def _reset_locked(self, reason: str, ranks=()) -> None:
         """Bump the membership epoch and drop every piece of state tied to
         the old rank set: pending barriers, negotiated-but-unfetched
         responses, the negotiation table, the response cache (ids were
         assigned against the old member set) and in-flight data
         aggregations. Blocked waiters observe the epoch change and return
-        RESP_RANKS_CHANGED / DATA_RANKS_CHANGED to their controllers."""
+        RESP_RANKS_CHANGED / DATA_RANKS_CHANGED to their controllers.
+        ``ranks`` (the ranks whose churn caused this reset) shards the
+        journal record: a change contained in one registered subtree
+        replicates to that subtree's standby, not to every tier."""
         self.epoch += 1
         instruments.elastic_epoch().set(self.epoch)
         self.reset_reason = reason
@@ -751,6 +1049,15 @@ class CoordState:
         self.fetched.clear()
         self.expected.clear()
         self.data.clear()
+        # tier-grouped barrier state is epoch-scoped too: blocked
+        # exchange_tier handlers observe the epoch bump, and replay waiters
+        # see their inflight key vanish
+        self.gcounts.clear()
+        self.ginvalid.clear()
+        self.glists.clear()
+        self.shards.clear()
+        self._tier_inflight.clear()
+        self._tier_runs.clear()
         # replay caches die with the epoch (seqs realign to epoch *
         # EPOCH_SEQ_BASE, so no stale entry could match anyway)
         self.last_resp.clear()
@@ -771,30 +1078,40 @@ class CoordState:
         # (membership is the ONLY durable state — see MSG_REPL_HELLO)
         self.jseq += 1
         if self._journal_sinks:
+            subtree, _ = (self._covering_subtree_locked(ranks)
+                          if ranks else ("", 0))
             rec = wire.encode_coord_journal(self.jseq, self.epoch,
-                                            sorted(self.members), reason)
-            for q in self._journal_sinks:
+                                            sorted(self.members), reason,
+                                            subtree)
+            for q, sfilter in self._journal_sinks:
+                # a subtree-scoped sink only carries its own churn; the
+                # root sink (filter "") carries everything, and global
+                # churn (tag "") fans out to every sink
+                if sfilter and subtree and sfilter != subtree:
+                    continue
                 q.put((MSG_JOURNAL, rec))
-            instruments.standby_journal_lag().set(
-                max(q.qsize() for q in self._journal_sinks))
+            instruments.standby_journal_lag().labels(tier="root").set(
+                max(q.qsize() for q, _ in self._journal_sinks))
         self._publish_members_locked()
         self.cv.notify_all()
 
-    def attach_journal(self, q) -> None:
+    def attach_journal(self, q, subtree: str = "") -> None:
         """Attach a standby's shipper queue: enqueue one snapshot of the
         current membership state, then a journal record per epoch change
-        until :meth:`detach_journal` (docs/control-plane.md)."""
+        until :meth:`detach_journal` (docs/control-plane.md). A non-empty
+        ``subtree`` scopes the stream: only records tagged with that
+        subtree (or global, untagged churn) are shipped."""
         with self.cv:
             snap = wire.encode_coord_snapshot(
                 self.jseq, self.epoch, self.world, self.elastic,
                 sorted(self.members), self.next_cache_id)
             q.put((MSG_SNAPSHOT, snap))
-            self._journal_sinks.append(q)
+            self._journal_sinks.append((q, subtree))
 
     def detach_journal(self, q) -> None:
         with self.cv:
-            if q in self._journal_sinks:
-                self._journal_sinks.remove(q)
+            self._journal_sinks = [(sq, sf) for sq, sf in
+                                   self._journal_sinks if sq is not q]
 
     def _publish_members_locked(self) -> None:
         """Best-effort membership advertisement through the launcher KV store
@@ -1057,7 +1374,10 @@ class CoordState:
             # is exactly the failure being modeled
             self.on_negotiate()
         tuned = self._tune()
-        invalid: set = set()
+        # grouped tier deposits recorded their evicted cache ids under the
+        # seq as they arrived (exchange_tier holds no per-rank rows to
+        # re-walk here)
+        invalid: set = self.ginvalid.pop(seq, set())
         for rank, (rflags, cached, reqs) in per_rank.items():
             if rflags & wire.REQ_JOIN:
                 if rank not in self.joined:
@@ -1107,7 +1427,14 @@ class CoordState:
             excl = set(self.straggler.excluded)
 
         now = time.monotonic()
-        active = set(self.members) - self.joined - excl
+        # the common static round has no joiners and no exclusions: alias
+        # the member set rather than copying it — at 100k ranks the copy
+        # alone was milliseconds per round, dominating grouped (O(groups))
+        # negotiation. Nothing below mutates ``active`` in place.
+        if self.joined or excl:
+            active = set(self.members) - self.joined - excl
+        else:
+            active = self.members
         epoch = self.epoch if self.elastic else -1
         emembers = sorted(self.members) if self.elastic else None
         wexcl = sorted(excl) if excl else None
@@ -1133,7 +1460,20 @@ class CoordState:
         for name, p in sorted(self.table.items(),
                               key=lambda kv: kv[1].order_idx):
             have = set(p.metas)
-            if active <= have:
+            if p.gcount:
+                # grouped deposits are counted, not enumerated: the tensor
+                # is ready when grouped coverage plus flat per-rank
+                # deposits span the active set (group membership is
+                # all-or-nothing per payload, so the count is exact; under
+                # straggler exclusion this conservatively counts an
+                # excluded-but-deposited rank, which only ever completes a
+                # tensor the active set already agreed on)
+                flat_have = {r for r in have if r not in p.grouped}
+                tensor_ready = (p.gcount + len(flat_have & active)
+                                >= len(active))
+            else:
+                tensor_ready = active <= have
+            if tensor_ready:
                 ready.append(name)
                 if len(p.arrivals) > 1:
                     max_skew = max(max_skew, max(p.arrivals.values())
@@ -1619,6 +1959,10 @@ class CoordinatorServer:
         # them are disconnected together if the connection dies, and any
         # that vanish from the batched heartbeat died locally at the host
         batch_ranks: set = set()
+        # tier subtrees whose frames ride this connection (one per
+        # mid-tier aggregator child): connection loss opens the reconnect
+        # grace window for every rank they vouch for
+        tier_subtrees: Dict[str, list] = {}
         # batch responses are written by per-batch handler threads, so
         # writes to a sub-coordinator connection need serializing
         send_lock = threading.Lock()
@@ -1626,7 +1970,9 @@ class CoordinatorServer:
             mt, _, rank, payload = wire.recv_frame(conn, self.secret,
                                                    self._stop)
             if mt == MSG_REPL_HELLO:
-                self._serve_repl(conn, rank)
+                self._serve_repl(conn, rank,
+                                 payload.decode("utf-8", "replace")
+                                 if payload else "")
                 return
             if mt not in (MSG_HELLO, MSG_RESUME):
                 raise ConnectionError(f"expected HELLO/RESUME, got {mt}")
@@ -1717,6 +2063,34 @@ class CoordinatorServer:
                         args=(conn, seq, entries, send_lock),
                         name="hvd_coord_batch", daemon=True).start()
                     continue
+                if mt == MSG_TBATCH:
+                    # one tier aggregator's grouped round: same handler
+                    # thread rule as MSG_BATCH (barriers must not block the
+                    # serve loop), but the state work is O(groups)
+                    tier, index, groups = wire.decode_tier_batch(payload)
+                    subtree = "t%d.%d" % (tier, index)
+                    tier_subtrees.setdefault(subtree, [])
+                    threading.Thread(
+                        target=self._handle_tier_batch,
+                        args=(conn, seq, tier, subtree, groups, send_lock),
+                        name="hvd_coord_tbatch", daemon=True).start()
+                    continue
+                if mt == MSG_THB:
+                    tier, index, runs = wire.decode_tier_heartbeat(payload)
+                    subtree = "t%d.%d" % (tier, index)
+                    prev = tier_subtrees.get(subtree, [])
+                    for r in wire.runs_to_ranks(
+                            wire.runs_subtract(prev, runs)):
+                        if r == rank:
+                            continue
+                        # the aggregator stopped vouching for this rank:
+                        # its leaf connection died somewhere down the tree
+                        self.state.rank_disconnected(
+                            r, "dropped from tier batch heartbeat "
+                               f"(subtree {subtree})")
+                    tier_subtrees[subtree] = runs
+                    self.state.mark_subtree_alive(subtree, tier, runs)
+                    continue
                 if mt == MSG_BATCH_HB:
                     alive = wire.decode_batched_heartbeat(payload)
                     self.state.marks_alive(alive)
@@ -1749,6 +2123,8 @@ class CoordinatorServer:
             for r in sorted(batch_ranks - {rank}):
                 self.state.rank_disconnected(
                     r, f"host batch connection lost ({exc})")
+            for subtree in tier_subtrees:
+                self.state.subtree_disconnected(subtree, str(exc))
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -1777,6 +2153,27 @@ class CoordinatorServer:
         except (ConnectionError, OSError, ShutdownError):
             pass  # the serve thread owns connection-loss reporting
 
+    def _handle_tier_batch(self, conn, frame_seq: int, tier: int,
+                           subtree: str, groups, send_lock) -> None:
+        try:
+            replies, deferred = self.state.exchange_tier(tier, subtree,
+                                                         groups)
+            if replies:
+                with send_lock:
+                    wire.send_frame(conn, self.secret, MSG_TBATCH_RESP,
+                                    frame_seq, 0,
+                                    wire.encode_tier_batch_resp(replies))
+            for rank, seq, payload in deferred:
+                # prospective joiners drop out of the grouped path: their
+                # admission wait spans member commit rounds, so each ships
+                # later as a single-entry MSG_BATCH_RESP frame
+                threading.Thread(
+                    target=self._handle_deferred,
+                    args=(conn, rank, seq, payload, send_lock),
+                    name="hvd_coord_join", daemon=True).start()
+        except (ConnectionError, OSError, ShutdownError):
+            pass  # the serve thread owns connection-loss reporting
+
     def _handle_deferred(self, conn, rank: int, seq: int, payload: bytes,
                          send_lock) -> None:
         try:
@@ -1788,18 +2185,24 @@ class CoordinatorServer:
         except (ConnectionError, OSError, ShutdownError):
             pass
 
-    def _serve_repl(self, conn, standby_rank: int) -> None:
+    def _serve_repl(self, conn, standby_rank: int,
+                    subtree: str = "") -> None:
         """Replication shipper (MSG_REPL_HELLO): stream one snapshot plus a
         journal record per epoch change to a warm standby. A clean end
         sends BYE so the standby knows not to promote; an abrupt death
         (SIGKILL, die@coordinator) just drops the stream — which is the
-        standby's promotion trigger (docs/control-plane.md)."""
+        standby's promotion trigger (docs/control-plane.md). A REPL_HELLO
+        payload naming a subtree (``t{tier}.{index}``) scopes the stream to
+        that subtree's churn — the per-tier standby path."""
         import queue as _queue
 
         q: "_queue.Queue" = _queue.Queue()
-        self.state.attach_journal(q)
+        self.state.attach_journal(q, subtree)
+        lag_tier = (subtree.split(".", 1)[0].lstrip("t") if subtree
+                    else "root")
         logger.info("coordinator: standby rank %s attached to the "
-                    "replication stream", standby_rank)
+                    "replication stream%s", standby_rank,
+                    " (subtree %s)" % subtree if subtree else "")
         try:
             while not self._stop.is_set():
                 try:
@@ -1809,7 +2212,8 @@ class CoordinatorServer:
                         break
                     continue
                 wire.send_frame(conn, self.secret, mt, 0, 0, payload)
-                instruments.standby_journal_lag().set(q.qsize())
+                instruments.standby_journal_lag().labels(
+                    tier=lag_tier).set(q.qsize())
             wire.send_frame(conn, self.secret, MSG_BYE, 0, 0)
         except (ConnectionError, OSError):
             pass
@@ -1998,6 +2402,10 @@ class CoordController:
         self._fo = 0  # how many failovers this worker has followed
         self._subcoord = None       # per-host sub-coordinator (host leaders)
         self._standby_coord = None  # warm-standby replica (rank 1)
+        # N-tier mode (HOROVOD_HIERARCHY_TIERS >= 2): mid-tier aggregators
+        # and tier standbys this host leader owns (docs/control-plane.md)
+        self._tier_aggs: List = []
+        self._tier_standbys: List = []
         # hierarchical mode: bulk DATA/CLOCK frames bypass the
         # sub-coordinator on a lazily-dialed direct connection to rank 0
         self._direct_sock: Optional[socket.socket] = None
@@ -2442,17 +2850,86 @@ class CoordController:
     # ------------------------------------- survivable control plane helpers
     def _start_subcoord(self, gen: int, up_host: str, up_port: int,
                         advertise: str) -> None:
-        """Bring up this host's sub-coordinator and publish its address
-        under addr.{gen}.h{group} so local ranks can find it."""
-        from .hierarchy import SubCoordinator
+        """Bring up every aggregator this host leader owns in the N-tier
+        tree and publish their addresses (docs/control-plane.md). With
+        HOROVOD_HIERARCHY_TIERS=1 (the default) that is exactly the old
+        single host tier: one sub-coordinator under addr.{gen}.h{group}
+        speaking legacy MSG_BATCH straight to rank 0. With deeper trees the
+        leader of host group g owns the tier-t aggregator with index
+        g // fanout^(t-1) whenever that divides evenly, brought up top tier
+        first so each lower tier can resolve its parent's published
+        address; the host tier then dials addr.{gen}.t2.{g // fanout}.
+        The leader of the FIRST child under each mid-tier parent (child
+        index ≡ 1 mod fanout) also runs that parent's warm TierStandby."""
+        from .hierarchy import (SubCoordinator, TierStandby,
+                                parse_tier_config)
 
-        group = os.environ.get("HVD_CROSS_RANK", "0")
+        group = int(os.environ.get("HVD_CROSS_RANK", "0") or "0")
+        tiers, fanout = parse_tier_config()
         bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
+        instruments.coord_tier_depth().set(tiers)
+        for t in range(tiers, 1, -1):
+            span = fanout ** (t - 1)  # host groups per tier-t subtree
+            if group % span != 0:
+                continue
+            agg = self._make_tier_agg(gen, t, group // span, up_host,
+                                      up_port, tiers, fanout, bind)()
+            _publish_key(f"addr.{gen}.t{t}.{group // span}",
+                         f"{advertise}:{agg.port}", self._secret)
+            self._tier_aggs.append(agg)
+        for t in range(2, tiers + 1):
+            cspan = fanout ** (t - 2)  # host groups per tier-(t-1) child
+            if group % cspan != 0:
+                continue
+            child = group // cspan  # our child index under tier t
+            if child % fanout != 1:
+                continue
+            sb = TierStandby(
+                gen, t, child // fanout, self._secret,
+                make_aggregator=self._make_tier_agg(
+                    gen, t, child // fanout, up_host, up_port, tiers,
+                    fanout, bind),
+                advertise=advertise)
+            sb.start()
+            self._tier_standbys.append(sb)
+        if tiers >= 2:
+            ukey = f"addr.{gen}.t2.{group // fanout}"
+            uaddr, _ = _resolve_key(ukey, 120.0)
+            uhost, uport = uaddr.rsplit(":", 1)
+            uport = int(uport)
+            ufail = ukey
+        else:
+            uhost, uport = up_host, up_port
+            ufail = f"addr.{gen}" if self._standby_enabled else None
         self._subcoord = SubCoordinator(
-            up_host, up_port, self._secret, leader_rank=self._rank,
-            host=bind)
+            uhost, uport, self._secret, leader_rank=self._rank, host=bind,
+            tier=1, index=group, tiers=tiers, up_fail_base=ufail)
         _publish_key(f"addr.{gen}.h{group}",
                      f"{advertise}:{self._subcoord.port}", self._secret)
+
+    def _make_tier_agg(self, gen: int, t: int, index: int, up_host: str,
+                       up_port: int, tiers: int, fanout: int, bind: str):
+        """Factory closure for the tier-t aggregator with ``index``; also
+        what the tier's warm standby calls at promotion time to build the
+        replacement (which re-resolves its parent, so promotion composes
+        with upstream failovers)."""
+        from .hierarchy import SubCoordinator
+
+        def make():
+            if t == tiers:
+                uhost, uport = up_host, up_port
+                ufail = f"addr.{gen}" if self._standby_enabled else None
+            else:
+                ukey = f"addr.{gen}.t{t + 1}.{index // fanout}"
+                uaddr, _ = _resolve_key(ukey, 30.0)
+                uhost, p = uaddr.rsplit(":", 1)
+                uport, ufail = int(p), ukey
+            return SubCoordinator(
+                uhost, uport, self._secret, leader_rank=self._rank,
+                host=bind, tier=t, index=index, tiers=tiers,
+                up_fail_base=ufail)
+
+        return make
 
     def _make_standby_state(self) -> "CoordState":
         c = self._state_ctor
@@ -2471,8 +2948,12 @@ class CoordController:
             return  # nothing promoted (yet); keep redialing the old address
         self._fo += 1
         host, port = addr.rsplit(":", 1)
-        self._addr = addr
-        self._host, self._port, self._secret = host, int(port), secret
+        if not self._hier:
+            # hierarchical workers stay pinned to their LOCAL
+            # sub-coordinator (which follows the failover itself); only the
+            # direct rank-0 path below re-aims
+            self._addr = addr
+            self._host, self._port, self._secret = host, int(port), secret
         self._host0, self._port0, self._secret0 = host, int(port), secret
         with self._direct_lock:
             if self._direct_sock is not None:
@@ -2492,10 +2973,22 @@ class CoordController:
         """Hierarchical mode: DATA/CLOCK exchanges carry bulk payloads and
         per-rank state, so they bypass the sub-coordinator on a lazily
         dialed direct connection to rank 0 instead of funneling through
-        one host process. One redial on connection loss; the coordinator's
-        replay caches make the re-send idempotent."""
+        one host process. One redial on connection loss (more when a warm
+        standby may be promoting, with failover-key probing from the
+        second retry); the coordinator's replay caches make the re-send
+        idempotent."""
         last: Optional[Exception] = None
-        for attempt in range(2):
+        attempts = (self._reconnect_attempts if self._standby_enabled
+                    else 2)
+        for attempt in range(attempts):
+            if attempt and self._standby_enabled:
+                if self._stop.wait(_backoff_schedule(
+                        self._rank, attempt, self._reconnect_backoff,
+                        self._reconnect_backoff_max,
+                        self._reconnect_jitter)):
+                    raise ShutdownError("control plane shut down")
+                if attempt >= 2:
+                    self._probe_failover()
             try:
                 with self._direct_lock:
                     sock = self._direct_sock
@@ -2772,6 +3265,10 @@ class CoordController:
                 self._direct_sock = None
         if self._subcoord is not None:
             self._subcoord.stop()
+        for sb in self._tier_standbys:
+            sb.stop()
+        for agg in self._tier_aggs:
+            agg.stop()
         if self._server is not None:
             # set_bye already ran (via _send_bye), so any rank still blocked
             # in an exchange has been released with a shutdown response;

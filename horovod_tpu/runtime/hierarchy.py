@@ -1,25 +1,43 @@
-"""Hierarchical negotiation: the per-host sub-coordinator tier.
+"""Hierarchical negotiation: the N-tier sub-coordinator tree.
 
 Flat mode puts every rank on its own TCP connection to rank 0, which makes
 rank 0's negotiation work O(world) frames per round — fine at 32 ranks,
 a storm at 1024. With ``HOROVOD_HIERARCHICAL_COORD`` set, each host's
 local-rank-0 process runs a :class:`SubCoordinator`: local ranks speak the
 UNCHANGED downstream protocol (HELLO/LIST/RESP/HEARTBEAT/BYE) to it over
-loopback, and the sub-coordinator ships ONE ``MSG_BATCH`` frame per round
-upstream to rank 0, carrying every local rank's request list as a
-``(rank, seq, payload)`` entry. Rank 0 answers with ``MSG_BATCH_RESP``
-frames whose entries self-identify the same way, so responses need no 1:1
-frame pairing — deferred joiner admissions ship later as single-entry
-frames. Rank 0's per-round work drops to O(hosts).
+loopback, and the sub-coordinator ships ONE batched frame per round
+upstream. With one tier (the default) that frame is ``MSG_BATCH`` carrying
+per-rank ``(rank, seq, payload)`` entries and rank 0's work is O(hosts).
 
-The batching core (:class:`HostAggregator`) is deliberately socketless so
-tests and benchmarks can drive thousands of fake ranks through it
-in-process; :class:`SubCoordinator` is the thin TCP shell around it.
+``HOROVOD_HIERARCHY_TIERS`` >= 2 stacks more aggregation tiers between the
+hosts and rank 0 (host -> slice -> pod, fanout per tier from
+``HOROVOD_HIERARCHY_FANOUT``). Above the host tier, per-rank entries stop
+scaling, so tier frames (``MSG_TBATCH``) carry GROUPS — one
+``(seq, payload, runs)`` per distinct payload, where ``runs`` run-length
+encodes every rank that submitted those bytes. In steady state a whole
+subtree collapses to one group, each tier merges its children's groups in
+O(children), and rank 0 sees O(fanout) frames AND O(fanout) work per round
+regardless of world size.
 
-Liveness is vouched per host: the sub-coordinator sends ``MSG_BATCH_HB``
-listing its currently-connected local ranks; a rank missing from the list
-(its local connection died) enters the coordinator's ordinary reconnect
-grace window, exactly as a flat-mode connection loss would.
+The batching cores are deliberately socketless so tests and benchmarks can
+drive thousands of fake ranks through them in-process:
+:class:`HostAggregator` (blocking per-rank submit, the host tier) and
+:class:`GroupAggregator` (async group relay, the mid tiers);
+:class:`SubCoordinator` is the thin TCP shell around either.
+
+Liveness is vouched per subtree: the host tier sends ``MSG_BATCH_HB``
+(one tier) or ``MSG_THB`` (N tiers) listing its connected ranks; mid
+tiers merge their children's vouches into one run list. A rank missing
+from the vouch enters the coordinator's ordinary reconnect grace window.
+
+Failover is per tier: mid-tier aggregators are STATELESS relays (every
+durable artifact lives below them, in each host's in-flight ledger, or
+above them, in rank 0's replay shards and the replicated membership
+journal), so a :class:`TierStandby` just watches its primary's TCP
+liveness and on sustained death starts a replacement, publishing
+``addr.{gen}.t{tier}.{index}.f{n}``. Children probe that key — and the
+root standby's ``addr.{gen}.f{n}`` — from their upstream-reconnect path
+and re-ship their ledgers; replay dedupe upstream makes that idempotent.
 
 See docs/control-plane.md.
 """
@@ -27,22 +45,71 @@ See docs/control-plane.md.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import threading
 import time
-from typing import Callable, Dict, List, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from .. import blackbox as _blackbox
+from ..exceptions import ShutdownError
+from ..metrics import instruments
 from ..utils.env import env_float as _env_float
 from . import wire
 from .coordinator import (MSG_BATCH, MSG_BATCH_HB, MSG_BATCH_RESP,
                           MSG_BLACKBOX, MSG_BYE, MSG_HEARTBEAT, MSG_HELLO,
                           MSG_LIST, MSG_METRICS, MSG_RESP, MSG_RESUME,
-                          MSG_TRACE, _backoff_schedule)
-from ..exceptions import ShutdownError
+                          MSG_TBATCH, MSG_TBATCH_RESP, MSG_THB, MSG_TRACE,
+                          _backoff_schedule, _publish_key, _resolve_key)
 
 logger = logging.getLogger("horovod_tpu")
 
-Entry = Tuple[int, int, bytes]  # (rank, seq, payload)
+Entry = Tuple[int, int, bytes]        # (rank, seq, payload)
+Group = Tuple[int, bytes, wire.Runs]  # (seq, payload, runs)
+
+
+def parse_tier_config() -> Tuple[int, int]:
+    """(tiers, fanout) from HOROVOD_HIERARCHY_TIERS/HOROVOD_HIERARCHY_FANOUT.
+
+    tiers=1 (default) is the PR-9 single host tier with the legacy
+    MSG_BATCH wire; fanout only matters from 2 tiers up (children per
+    aggregator at every tier above the hosts, default 8)."""
+    tiers = max(1, int(os.environ.get("HOROVOD_HIERARCHY_TIERS", "1")
+                       or "1"))
+    fanout = int(os.environ.get("HOROVOD_HIERARCHY_FANOUT", "8") or "8")
+    return tiers, max(2, fanout)
+
+
+def coalesce_entries(entries: List[Entry]) -> List[Group]:
+    """Collapse per-rank entries into payload-identical groups (first-seen
+    order); the host tier's O(local ranks) -> O(distinct payloads) step."""
+    buckets: Dict[Tuple[int, bytes], List[int]] = {}
+    order: List[Tuple[int, bytes]] = []
+    for rank, seq, payload in entries:
+        key = (seq, payload)
+        if key not in buckets:
+            buckets[key] = []
+            order.append(key)
+        buckets[key].append(rank)
+    return [(seq, payload, wire.ranks_to_runs(buckets[(seq, payload)]))
+            for seq, payload in order]
+
+
+def merge_group_batches(batches: List[List[Group]]) -> List[Group]:
+    """Union children's group batches: identical (seq, payload) groups
+    merge their run lists. The whole per-round cost of a mid tier."""
+    merged: Dict[Tuple[int, bytes], wire.Runs] = {}
+    order: List[Tuple[int, bytes]] = []
+    for groups in batches:
+        for seq, payload, runs in groups:
+            key = (seq, payload)
+            if key not in merged:
+                merged[key] = runs
+                order.append(key)
+            else:
+                merged[key] = wire.merge_runs(merged[key], runs)
+    return [(seq, payload, merged[(seq, payload)])
+            for seq, payload in order]
 
 
 class AggregatorClosed(ConnectionError):
@@ -156,24 +223,179 @@ class HostAggregator:
             self._cv.notify_all()
 
 
+class GroupAggregator:
+    """The HostAggregator machinery one tier up: children are whole
+    aggregators, deposits are groups, and replies route back by run
+    intersection instead of unblocking per-rank submitters. Deposits never
+    block — a mid-tier relay must keep reading its children's heartbeats
+    while a round is in flight — so flushing is driven by deposits plus
+    the owning SubCoordinator's linger ticker.
+
+    The in-flight ledger lives here as (child, seq, payload, runs) rows:
+    an upstream reconnect re-ships their merged union, and a response
+    group subtracts the runs it covered so an elastic partial answer
+    (member runs now, deferred joiner singles later) leaves exactly the
+    unanswered remainder eligible for re-ship."""
+
+    def __init__(self, flush_fn: Callable[[List[Group]], None],
+                 linger_s: float = 0.005):
+        self._flush_fn = flush_fn
+        self._linger_s = linger_s
+        self._cv = threading.Condition()
+        # child key -> reply_fn(groups, entries); keys are child leader ranks
+        self._children: Dict[int, Callable] = {}
+        self._pending: Dict[int, List[Group]] = {}
+        self._inflight: List[Tuple[int, int, bytes, wire.Runs]] = []
+        self._first_t = 0.0
+        self._closed = False
+        self.flushes = 0
+
+    def register(self, child: int, reply_fn: Callable) -> None:
+        with self._cv:
+            self._children[child] = reply_fn
+            self._cv.notify_all()
+
+    def unregister(self, child: int) -> None:
+        with self._cv:
+            self._children.pop(child, None)
+            self._pending.pop(child, None)
+            # in-flight rows stay: the child re-homes (to us or to our
+            # standby) and re-ships; replay dedupe upstream absorbs both
+            self._cv.notify_all()
+
+    def deposit(self, child: int, groups: List[Group]) -> None:
+        with self._cv:
+            if self._closed:
+                raise AggregatorClosed("tier aggregator shut down")
+            self._pending.setdefault(child, []).extend(groups)
+            if self._first_t == 0.0:
+                self._first_t = time.monotonic()
+        self.maybe_flush()
+
+    def maybe_flush(self) -> None:
+        with self._cv:
+            if not self._pending or self._closed:
+                return
+            awaiting = {row[0] for row in self._inflight}
+            waiting_for = set(self._children) - awaiting
+            full = bool(waiting_for) and set(self._pending) >= waiting_for
+            lingered = (self._first_t > 0.0 and
+                        time.monotonic() - self._first_t >= self._linger_s)
+            if not (full or lingered):
+                return
+            batches = [self._pending[c] for c in sorted(self._pending)]
+            for child in sorted(self._pending):
+                for seq, payload, runs in self._pending[child]:
+                    self._inflight.append((child, seq, payload, runs))
+            self._pending.clear()
+            self._first_t = 0.0
+            self.flushes += 1
+            merged = merge_group_batches(batches)
+        self._flush_fn(merged)  # network I/O outside the lock
+
+    def deliver_groups(self, rgroups: List[Group]) -> None:
+        """Route upstream response groups downstream by run intersection."""
+        out: Dict[int, List[Group]] = {}
+        with self._cv:
+            for seq, data, runs in rgroups:
+                kept = []
+                for row in self._inflight:
+                    child, eseq, payload, eruns = row
+                    if eseq != seq:
+                        kept.append(row)
+                        continue
+                    inter = wire.runs_intersect(eruns, runs)
+                    if not inter:
+                        kept.append(row)
+                        continue
+                    out.setdefault(child, []).append((seq, data, inter))
+                    left = wire.runs_subtract(eruns, inter)
+                    if left:
+                        kept.append((child, eseq, payload, left))
+                self._inflight = kept
+            fns = {c: self._children.get(c) for c in out}
+            self._cv.notify_all()
+        for child, groups in out.items():
+            fn = fns.get(child)
+            if fn is not None:
+                fn(groups, [])
+
+    def deliver_entry(self, rank: int, seq: int, data: bytes) -> None:
+        """Route one deferred per-rank entry (elastic joiner admission)."""
+        target = None
+        with self._cv:
+            kept = []
+            for row in self._inflight:
+                child, eseq, payload, eruns = row
+                if (target is None and eseq == seq
+                        and wire.runs_contain(eruns, rank)):
+                    target = child
+                    left = wire.runs_subtract(eruns, [(rank, 1)])
+                    if left:
+                        kept.append((child, eseq, payload, left))
+                else:
+                    kept.append(row)
+            self._inflight = kept
+            fn = self._children.get(target) if target is not None else None
+            self._cv.notify_all()
+        if fn is not None:
+            fn([], [(rank, seq, data)])
+
+    def inflight_merged(self) -> List[Group]:
+        """Unanswered groups across all children — the reconnect re-ship."""
+        with self._cv:
+            rows = [(seq, payload, runs)
+                    for _, seq, payload, runs in self._inflight]
+        return merge_group_batches([rows])
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
 class SubCoordinator:
-    """Per-host relay: downstream server speaking the flat worker protocol
-    to local ranks, one upstream connection to rank 0 speaking batches."""
+    """Per-node relay: downstream server speaking the flat worker protocol
+    (tier 1) or the group protocol (tiers >= 2) to its children, one
+    upstream connection speaking batches.
+
+    ``tier``/``index`` name this aggregator's slot in the tree; ``tiers``
+    is the total depth — upstream frames are the legacy per-rank
+    ``MSG_BATCH`` when tiers == 1 (byte-identical to the single-tier
+    implementation) and grouped ``MSG_TBATCH`` otherwise. ``up_fail_base``
+    (e.g. ``addr.{gen}`` for a rank-0 parent, ``addr.{gen}.t2.0`` for a
+    tier parent) enables failover-key probing on upstream loss."""
 
     def __init__(self, up_host: str, up_port: int, secret: str,
-                 leader_rank: int, host: str = "127.0.0.1"):
+                 leader_rank: int, host: str = "127.0.0.1",
+                 tier: int = 1, index: int = 0, tiers: int = 1,
+                 up_fail_base: Optional[str] = None):
         self._up_addr = (up_host, up_port)
         self._secret = secret
         self._leader = leader_rank
+        self._tier = tier
+        self._index = index
+        self._tiers = tiers
+        self._tierwire = tiers >= 2
+        self._up_fail_base = up_fail_base
+        self._up_fo = 0
         self._stop = threading.Event()
         self._jitter = _env_float("HOROVOD_RECONNECT_JITTER", 0.0)
         self._hb_interval = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
         linger = _env_float("HOROVOD_HIERARCHY_LINGER_MS", 5.0) / 1000.0
-        self.agg = HostAggregator(self._ship, linger_s=linger)
         # entries shipped upstream and not yet answered: the reconnect path
         # re-sends them all (idempotent via the coordinator replay caches)
         self._inflight: Dict[Tuple[int, int], bytes] = {}
         self._inflight_lock = threading.Lock()
+        self._vouch: Dict[int, wire.Runs] = {}   # child -> vouched runs
+        self._child_conns: Dict[int, Tuple[socket.socket,
+                                           threading.Lock]] = {}
+        if tier >= 2:
+            self.agg = None
+            self.gagg = GroupAggregator(self._gship, linger_s=linger)
+        else:
+            self.agg = HostAggregator(self._ship, linger_s=linger)
+            self.gagg = None
         self._bseq = 0
         self._up_send_lock = threading.Lock()
         self._up = self._dial_upstream(MSG_HELLO)
@@ -188,6 +410,11 @@ class SubCoordinator:
                          daemon=True).start()
         if self._hb_interval > 0:
             threading.Thread(target=self._hb_loop, name="hvd_sub_hb",
+                             daemon=True).start()
+        if self.gagg is not None:
+            # mid tiers have no blocked submitters polling the linger
+            # clock, so a ticker drives partial-batch flushes
+            threading.Thread(target=self._tick_loop, name="hvd_sub_tick",
                              daemon=True).start()
 
     # --------------------------------------------------------------- upstream
@@ -207,7 +434,7 @@ class SubCoordinator:
 
     def _ship(self, entries: List[Entry]) -> None:
         """HostAggregator flush hook: record the entries as in flight, then
-        send one MSG_BATCH. Send errors are swallowed — the upstream recv
+        send one batch frame. Send errors are swallowed — the upstream recv
         loop owns reconnect, and reconnect re-ships the inflight ledger."""
         with self._inflight_lock:
             for r, s, p in entries:
@@ -215,10 +442,27 @@ class SubCoordinator:
         self._send_batch(entries)
 
     def _send_batch(self, entries: List[Entry]) -> None:
+        if self._tierwire:
+            self._send_groups(coalesce_entries(entries))
+            return
         payload = wire.encode_batched_entries(entries)
         try:
             with self._up_send_lock:
                 wire.send_frame(self._up, self._secret, MSG_BATCH,
+                                self._next_bseq(), self._leader, payload)
+        except (ConnectionError, OSError):
+            pass
+
+    def _gship(self, groups: List[Group]) -> None:
+        """GroupAggregator flush hook (mid tiers): the group ledger lives
+        inside the aggregator, so this only frames and sends."""
+        self._send_groups(groups)
+
+    def _send_groups(self, groups: List[Group]) -> None:
+        payload = wire.encode_tier_batch(self._tier, self._index, groups)
+        try:
+            with self._up_send_lock:
+                wire.send_frame(self._up, self._secret, MSG_TBATCH,
                                 self._next_bseq(), self._leader, payload)
         except (ConnectionError, OSError):
             pass
@@ -243,21 +487,74 @@ class SubCoordinator:
                     return
                 if not self._reconnect_upstream(exc):
                     logger.warning(
-                        "sub-coordinator (leader rank %d): rank 0 stayed "
-                        "unreachable; releasing local ranks", self._leader)
-                    self.agg.close()
+                        "sub-coordinator (tier %d, leader rank %d): "
+                        "upstream stayed unreachable; releasing children",
+                        self._tier, self._leader)
+                    self._close_down()
                     return
                 continue
             if mt == MSG_BATCH_RESP:
                 for rank, seq, data in wire.decode_batched_entries(payload):
+                    if self.gagg is not None:
+                        self.gagg.deliver_entry(rank, seq, data)
+                        continue
                     with self._inflight_lock:
                         self._inflight.pop((rank, seq), None)
                     self.agg.deliver(rank, seq, data)
+            elif mt == MSG_TBATCH_RESP:
+                rgroups = wire.decode_tier_batch_resp(payload)
+                if self.gagg is not None:
+                    self.gagg.deliver_groups(rgroups)
+                else:
+                    for seq, data, runs in rgroups:
+                        for rank in wire.runs_to_ranks(runs):
+                            with self._inflight_lock:
+                                self._inflight.pop((rank, seq), None)
+                            self.agg.deliver(rank, seq, data)
             elif mt == MSG_BYE:
-                self.agg.close()
+                self._close_down()
                 return
             # anything else on the upstream socket is ignored: the batch
             # protocol owns this connection
+
+    def _close_down(self) -> None:
+        """Release local submitters and cascade shutdown to tier children."""
+        if self.agg is not None:
+            self.agg.close()
+        if self.gagg is not None:
+            self.gagg.close()
+        for child, (conn, lock) in list(self._child_conns.items()):
+            try:
+                with lock:
+                    wire.send_frame(conn, self._secret, MSG_BYE, 0, 0, b"")
+            except (ConnectionError, OSError):
+                pass
+
+    def _probe_up_failover(self) -> None:
+        """Satellite of the per-tier failover design: on upstream loss, ask
+        the KV store whether a standby took over the parent slot
+        (``{up_fail_base}.f{n}``) and re-home there."""
+        if not self._up_fail_base:
+            return
+        key = "%s.f%d" % (self._up_fail_base, self._up_fo + 1)
+        try:
+            addr, secret = _resolve_key(key, timeout=0.3)
+        except Exception:
+            return
+        self._up_fo += 1
+        host, _, port = addr.rpartition(":")
+        self._up_addr = (host, int(port))
+        if secret:
+            self._secret = secret
+        _blackbox.record(
+            _blackbox.K_FAILOVER, "tier_%d" % self._tier,
+            "sub-coordinator tier %d index %d re-homing upstream to %s "
+            "(failover %d)" % (self._tier, self._index, addr, self._up_fo),
+            rank=self._leader)
+        logger.warning(
+            "sub-coordinator (tier %d index %d, leader rank %d): upstream "
+            "failover %d -> %s", self._tier, self._index, self._leader,
+            self._up_fo, addr)
 
     def _reconnect_upstream(self, why: Exception) -> bool:
         for attempt in range(1, 9):
@@ -265,6 +562,10 @@ class SubCoordinator:
                                       self._jitter)
             if self._stop.wait(delay):
                 return False
+            if attempt >= 2:
+                # same cadence as the flat worker: give the original
+                # address one clean retry before chasing failover keys
+                self._probe_up_failover()
             try:
                 sock = self._dial_upstream(MSG_RESUME)
             except (ConnectionError, OSError):
@@ -275,30 +576,76 @@ class SubCoordinator:
                 old.close()
             except OSError:
                 pass
-            with self._inflight_lock:
-                entries = [(r, s, p)
-                           for (r, s), p in sorted(self._inflight.items())]
-            if entries:
-                self._send_batch(entries)
+            if self.gagg is not None:
+                groups = self.gagg.inflight_merged()
+                nship = wire.runs_count(
+                    [r for g in groups for r in g[2]])
+                if groups:
+                    self._send_groups(groups)
+            else:
+                with self._inflight_lock:
+                    entries = [(r, s, p) for (r, s), p
+                               in sorted(self._inflight.items())]
+                nship = len(entries)
+                if entries:
+                    self._send_batch(entries)
+            _blackbox.record(
+                _blackbox.K_RECONNECT, "tier_%d" % self._tier,
+                "sub-coordinator tier %d index %d reconnected upstream "
+                "after %s (attempt %d)" % (self._tier, self._index, why,
+                                           attempt),
+                rank=self._leader)
             logger.warning(
-                "sub-coordinator (leader rank %d): reconnected upstream "
-                "after %s (attempt %d, re-shipped %d inflight entries)",
-                self._leader, why, attempt, len(entries))
+                "sub-coordinator (tier %d index %d, leader rank %d): "
+                "reconnected upstream after %s (attempt %d, re-shipped %d "
+                "inflight)", self._tier, self._index, self._leader, why,
+                attempt, nship)
             return True
         return False
 
+    def _vouched_runs(self) -> wire.Runs:
+        """This subtree's live ranks: local leaf connections plus every
+        child aggregator's latest vouch, as one merged run list."""
+        runs: wire.Runs = []
+        if self.agg is not None:
+            runs = wire.ranks_to_runs(self.agg.ranks())
+        with self._inflight_lock:
+            vouches = list(self._vouch.values())
+        for v in vouches:
+            runs = wire.merge_runs(runs, v)
+        return runs
+
     def _hb_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):
-            alive = self.agg.ranks()
-            if not alive:
-                continue
+            if self._tierwire:
+                runs = self._vouched_runs()
+                if not runs:
+                    continue
+                payload = wire.encode_tier_heartbeat(self._tier,
+                                                     self._index, runs)
+                mt = MSG_THB
+            else:
+                alive = self.agg.ranks()
+                if not alive:
+                    continue
+                payload = wire.encode_batched_heartbeat(alive)
+                mt = MSG_BATCH_HB
             try:
                 with self._up_send_lock:
-                    wire.send_frame(self._up, self._secret, MSG_BATCH_HB, 0,
-                                    self._leader,
-                                    wire.encode_batched_heartbeat(alive))
+                    wire.send_frame(self._up, self._secret, mt, 0,
+                                    self._leader, payload)
             except (ConnectionError, OSError):
                 pass  # recv loop owns reconnect
+
+    def _tick_loop(self) -> None:
+        """Mid-tier linger clock: flush a partial group batch when no
+        further child deposit arrives to trigger it."""
+        interval = max(0.001, self.gagg._linger_s / 2.0)
+        while not self._stop.wait(interval):
+            try:
+                self.gagg.maybe_flush()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- downstream
     def _accept_loop(self) -> None:
@@ -321,8 +668,11 @@ class SubCoordinator:
             if mt not in (MSG_HELLO, MSG_RESUME):
                 raise ConnectionError(
                     f"sub-coordinator expected HELLO/RESUME, got {mt}")
-            # a RESUME needs no upstream replay here: the worker re-sends
-            # its in-flight frame itself, and submit() re-ships it
+            # a RESUME needs no upstream replay here: the worker (or child
+            # aggregator) re-sends its in-flight frames itself
+            if self.gagg is not None:
+                self._serve_child_aggregator(conn, rank)
+                return
             self.agg.register(rank)
             while True:
                 mt, seq, rank, payload = wire.recv_frame(conn, self._secret,
@@ -334,7 +684,7 @@ class SubCoordinator:
                     return
                 if mt == MSG_HEARTBEAT:
                     # local liveness is the open connection itself; the
-                    # periodic MSG_BATCH_HB vouches for it upstream
+                    # periodic batch heartbeat vouches for it upstream
                     continue
                 if mt in (MSG_METRICS, MSG_TRACE, MSG_BLACKBOX):
                     self._forward(mt, rank, payload)
@@ -351,8 +701,65 @@ class SubCoordinator:
         except (ConnectionError, OSError):
             pass
         finally:
-            if rank >= 0:
+            if rank >= 0 and self.agg is not None:
                 self.agg.unregister(rank)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_child_aggregator(self, conn, child: int) -> None:
+        """Mid-tier downstream: the child is itself an aggregator speaking
+        grouped frames; replies route back asynchronously (responses need
+        no 1:1 frame pairing, exactly like the host tier's downstream)."""
+        lock = threading.Lock()
+
+        def reply_fn(groups: List[Group], entries: List[Entry]) -> None:
+            try:
+                if groups:
+                    with lock:
+                        wire.send_frame(conn, self._secret, MSG_TBATCH_RESP,
+                                        0, 0,
+                                        wire.encode_tier_batch_resp(groups))
+                if entries:
+                    with lock:
+                        wire.send_frame(conn, self._secret, MSG_BATCH_RESP,
+                                        0, 0,
+                                        wire.encode_batched_entries(entries))
+            except (ConnectionError, OSError):
+                pass  # child reconnects and re-ships; upstream replay dedupes
+
+        self.gagg.register(child, reply_fn)
+        self._child_conns[child] = (conn, lock)
+        try:
+            while True:
+                mt, seq, rank, payload = wire.recv_frame(conn, self._secret,
+                                                         self._stop)
+                if mt == MSG_BYE:
+                    self._forward(MSG_BYE, rank, b"")
+                    return
+                if mt == MSG_THB:
+                    _, _, runs = wire.decode_tier_heartbeat(payload)
+                    with self._inflight_lock:
+                        self._vouch[child] = runs
+                    continue
+                if mt in (MSG_METRICS, MSG_TRACE, MSG_BLACKBOX):
+                    self._forward(mt, rank, payload)
+                    continue
+                if mt != MSG_TBATCH:
+                    raise ConnectionError(
+                        f"tier aggregator: unexpected message type {mt}")
+                _, _, groups = wire.decode_tier_batch(payload)
+                self.gagg.deposit(child, groups)
+        except ShutdownError:
+            pass
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.gagg.unregister(child)
+            self._child_conns.pop(child, None)
+            with self._inflight_lock:
+                self._vouch.pop(child, None)
             try:
                 conn.close()
             except OSError:
@@ -360,7 +767,10 @@ class SubCoordinator:
 
     def stop(self) -> None:
         self._stop.set()
-        self.agg.close()
+        if self.agg is not None:
+            self.agg.close()
+        if self.gagg is not None:
+            self.gagg.close()
         try:
             self._sock.close()
         except OSError:
@@ -369,3 +779,87 @@ class SubCoordinator:
             self._up.close()
         except OSError:
             pass
+
+
+class TierStandby:
+    """Warm standby for one mid-tier aggregator slot.
+
+    Mid-tier aggregators are stateless relays: every durable negotiation
+    artifact lives below them (each host's in-flight ledger re-ships on
+    reconnect) or above them (rank 0's per-subtree replay shards, the
+    replicated membership journal). So per-tier failover needs no journal
+    shard of its own — this standby watches the primary's TCP liveness
+    and, after ``misses`` consecutive failed probes, starts a replacement
+    aggregator via ``make_aggregator()`` and publishes
+    ``addr.{gen}.t{tier}.{index}.f{n}`` for the orphaned children to find
+    from their upstream-reconnect probe."""
+
+    def __init__(self, gen: int, tier: int, index: int, secret: str,
+                 make_aggregator: Callable[[], "SubCoordinator"],
+                 advertise: str = "127.0.0.1",
+                 probe_interval: float = 0.25, misses: int = 3):
+        self._gen = gen
+        self._tier = tier
+        self._index = index
+        self._secret = secret
+        self._make = make_aggregator
+        self._advertise = advertise
+        self._interval = probe_interval
+        self._misses = misses
+        self._stop = threading.Event()
+        self.promoted = False
+        self.agg: Optional[SubCoordinator] = None
+        self._thread = threading.Thread(target=self._run,
+                                        name="hvd_tier_standby", daemon=True)
+
+    def start(self) -> "TierStandby":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        key = "addr.%s.t%d.%d" % (self._gen, self._tier, self._index)
+        try:
+            addr, _ = _resolve_key(key, timeout=30)
+        except Exception:
+            return
+        host, _, port = addr.rpartition(":")
+        misses = 0
+        while not self._stop.wait(self._interval):
+            try:
+                s = socket.create_connection((host, int(port)), timeout=1.0)
+                s.close()
+                misses = 0
+            except OSError:
+                misses += 1
+                if misses >= self._misses:
+                    self._promote()
+                    return
+
+    def _promote(self) -> None:
+        if self._stop.is_set():
+            return
+        try:
+            self.agg = self._make()
+        except Exception:
+            logger.exception(
+                "tier standby: promotion failed (tier %d index %d)",
+                self._tier, self._index)
+            return
+        self.promoted = True
+        _publish_key("addr.%s.t%d.%d.f1" % (self._gen, self._tier,
+                                            self._index),
+                     "%s:%d" % (self._advertise, self.agg.port),
+                     self._secret)
+        instruments.coord_failovers().inc()
+        _blackbox.record(
+            _blackbox.K_FAILOVER, "tier_%d" % self._tier,
+            "tier standby promoted replacement aggregator for tier %d "
+            "index %d" % (self._tier, self._index))
+        logger.warning(
+            "tier standby: promoted replacement aggregator for tier %d "
+            "index %d (port %d)", self._tier, self._index, self.agg.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.agg is not None:
+            self.agg.stop()

@@ -23,6 +23,18 @@ Promotion triggers on replication-stream loss WITHOUT a prior ``MSG_BYE``
 few quick re-dials to ride out transient blips. One failover deep by
 design: the promoted coordinator does not accept a new standby.
 
+This composes with the hierarchical control plane: the promoted server is
+a full :class:`CoordinatorServer`, so it re-admits sub-coordinator
+``MSG_BATCH``/``MSG_BATCH_HB`` (and N-tier ``MSG_TBATCH``/``MSG_THB``)
+connections, and each sub-coordinator re-ships its in-flight batch ledger
+on RESUME — replay caches make that idempotent. Mid-tier aggregator slots
+have their own lighter failover (``hierarchy.TierStandby``): they hold no
+durable state, so their standby probes TCP liveness and starts a stateless
+replacement without touching this journal. Journal records tagged with a
+subtree only replicate to sinks scoped to that subtree (plus this global
+root stream), keeping rank-0 replication work bounded by its direct
+children.
+
 See docs/control-plane.md.
 """
 
@@ -131,7 +143,8 @@ class StandbyCoordinator:
                      self._next_cache_id) = wire.decode_coord_snapshot(
                          payload)
                     self._have_snapshot = True
-                    instruments.standby_journal_lag().set(0)
+                    instruments.standby_journal_lag().labels(
+                        tier="root").set(0)
                 elif mt == MSG_JOURNAL:
                     (self._jseq, self._epoch, self._members,
                      _reason) = wire.decode_coord_journal(payload)

@@ -852,7 +852,7 @@ def decode_coord_snapshot(buf: bytes):
 
 
 def encode_coord_journal(jseq: int, epoch: int, members: List[int],
-                         reason: str) -> bytes:
+                         reason: str, subtree: str = "") -> bytes:
     w = Writer()
     w.i64(jseq)
     w.i32(epoch)
@@ -860,6 +860,10 @@ def encode_coord_journal(jseq: int, epoch: int, members: List[int],
     for r in members:
         w.i32(r)
     w.str(reason)
+    if subtree:
+        # trailing optional block: old decoders stop before it, old frames
+        # simply end sooner for the tagged decoder below
+        w.str(subtree)
     return w.getvalue()
 
 
@@ -871,6 +875,195 @@ def decode_coord_journal(buf: bytes):
     members = [rd.i32() for _ in range(rd.u32())]
     reason = rd.str()
     return jseq, epoch, members, reason
+
+
+def decode_coord_journal_tagged(buf: bytes):
+    """Returns (jseq, epoch, members, reason, subtree).
+
+    ``subtree`` names the aggregation subtree whose churn produced this
+    record ("t{tier}.{index}") or "" for a whole-job record — the key a
+    tier-scoped standby filters its journal shard by."""
+    rd = Reader(buf)
+    jseq = rd.i64()
+    epoch = rd.i32()
+    members = [rd.i32() for _ in range(rd.u32())]
+    reason = rd.str()
+    subtree = rd.str() if rd.remaining() else ""
+    return jseq, epoch, members, reason, subtree
+
+
+# --------------------------------------------------------------------------
+# N-tier hierarchical batch frames (MSG_TBATCH / MSG_TBATCH_RESP /
+# MSG_THB). Above one host tier, per-rank batch entries stop scaling: a
+# pod-level aggregator fronting 100k ranks would re-ship 100k (rank, seq,
+# payload) triples upstream every round. Tier frames instead carry GROUPS —
+# one (seq, payload, runs) per distinct payload, where ``runs`` is a
+# run-length list [(start_rank, count), ...] naming every rank that
+# submitted those exact bytes. In steady state all ranks request the same
+# tensors, so a whole subtree collapses to one group and rank-0 work per
+# round is O(direct children), not O(ranks) (docs/control-plane.md).
+# --------------------------------------------------------------------------
+
+Runs = List[Tuple[int, int]]
+
+
+def ranks_to_runs(ranks) -> Runs:
+    """Compress a rank collection to sorted [(start, count)] runs."""
+    out: Runs = []
+    for r in sorted(ranks):
+        if out and out[-1][0] + out[-1][1] == r:
+            out[-1] = (out[-1][0], out[-1][1] + 1)
+        else:
+            out.append((r, 1))
+    return out
+
+
+def runs_to_ranks(runs: Runs) -> List[int]:
+    return [r for start, count in runs for r in range(start, start + count)]
+
+
+def runs_count(runs: Runs) -> int:
+    return sum(count for _, count in runs)
+
+
+def runs_contain(runs: Runs, rank: int) -> bool:
+    return any(start <= rank < start + count for start, count in runs)
+
+
+def merge_runs(a: Runs, b: Runs) -> Runs:
+    """Union of two disjoint run lists, coalescing adjacency — the O(runs)
+    step a mid-tier aggregator does instead of touching per-rank state."""
+    out: Runs = []
+    ia = ib = 0
+    while ia < len(a) or ib < len(b):
+        if ib >= len(b) or (ia < len(a) and a[ia][0] <= b[ib][0]):
+            start, count = a[ia]
+            ia += 1
+        else:
+            start, count = b[ib]
+            ib += 1
+        if out and out[-1][0] + out[-1][1] == start:
+            out[-1] = (out[-1][0], out[-1][1] + count)
+        else:
+            out.append((start, count))
+    return out
+
+
+def runs_intersect(a: Runs, b: Runs) -> Runs:
+    out: Runs = []
+    ia = ib = 0
+    while ia < len(a) and ib < len(b):
+        lo = max(a[ia][0], b[ib][0])
+        hi = min(a[ia][0] + a[ia][1], b[ib][0] + b[ib][1])
+        if lo < hi:
+            out.append((lo, hi - lo))
+        if a[ia][0] + a[ia][1] <= b[ib][0] + b[ib][1]:
+            ia += 1
+        else:
+            ib += 1
+    return out
+
+
+def runs_subtract(a: Runs, b: Runs) -> Runs:
+    """Ranks in ``a`` but not ``b`` — what stays in a relay's in-flight
+    ledger when a response covers only part of a shipped group."""
+    out: Runs = []
+    ib = 0
+    for start, count in a:
+        lo, hi = start, start + count
+        while lo < hi:
+            while ib < len(b) and b[ib][0] + b[ib][1] <= lo:
+                ib += 1
+            if ib >= len(b) or b[ib][0] >= hi:
+                out.append((lo, hi - lo))
+                break
+            if b[ib][0] > lo:
+                out.append((lo, b[ib][0] - lo))
+            lo = b[ib][0] + b[ib][1]
+        # rewind not needed: both lists are sorted and disjoint
+    return out
+
+
+def _write_runs(w: Writer, runs: Runs) -> None:
+    w.u32(len(runs))
+    for start, count in runs:
+        w.i32(start)
+        w.u32(count)
+
+
+def _read_runs(rd: Reader) -> Runs:
+    return [(rd.i32(), rd.u32()) for _ in range(rd.u32())]
+
+
+def encode_tier_batch(tier: int, index: int,
+                      groups: List[Tuple[int, bytes, Runs]]) -> bytes:
+    """MSG_TBATCH: [(seq, inner_payload, runs)] from tier aggregator
+    (tier, index); every rank in ``runs`` submitted exactly ``payload``."""
+    w = Writer()
+    w.u8(tier)
+    w.u32(index)
+    w.u32(len(groups))
+    for seq, payload, runs in groups:
+        w.u32(seq)
+        w.u32(len(payload))
+        w.parts.append(payload)
+        _write_runs(w, runs)
+    return w.getvalue()
+
+
+def decode_tier_batch(buf: bytes):
+    """Returns (tier, index, [(seq, payload, runs)])."""
+    rd = Reader(buf)
+    tier = rd.u8()
+    index = rd.u32()
+    groups = []
+    for _ in range(rd.u32()):
+        seq = rd.u32()
+        n = rd.u32()
+        payload = rd.buf[rd.off:rd.off + n]
+        rd.off += n
+        groups.append((seq, payload, _read_runs(rd)))
+    return tier, index, groups
+
+
+def encode_tier_batch_resp(groups: List[Tuple[int, bytes, Runs]]) -> bytes:
+    """MSG_TBATCH_RESP: [(seq, response_bytes, runs)] — one response per
+    request group, echoing the runs it covers for downstream routing."""
+    w = Writer()
+    w.u32(len(groups))
+    for seq, payload, runs in groups:
+        w.u32(seq)
+        w.u32(len(payload))
+        w.parts.append(payload)
+        _write_runs(w, runs)
+    return w.getvalue()
+
+
+def decode_tier_batch_resp(buf: bytes):
+    rd = Reader(buf)
+    groups = []
+    for _ in range(rd.u32()):
+        seq = rd.u32()
+        n = rd.u32()
+        payload = rd.buf[rd.off:rd.off + n]
+        rd.off += n
+        groups.append((seq, payload, _read_runs(rd)))
+    return groups
+
+
+def encode_tier_heartbeat(tier: int, index: int, runs: Runs) -> bytes:
+    """MSG_THB: every rank in ``runs`` is vouched alive by aggregator
+    (tier, index) — the run-length form of MSG_BATCH_HB."""
+    w = Writer()
+    w.u8(tier)
+    w.u32(index)
+    _write_runs(w, runs)
+    return w.getvalue()
+
+
+def decode_tier_heartbeat(buf: bytes):
+    rd = Reader(buf)
+    return rd.u8(), rd.u32(), _read_runs(rd)
 
 
 # --------------------------------------------------------------------------

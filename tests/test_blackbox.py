@@ -273,6 +273,24 @@ class TestSignatures:
         assert sigs.detect_reconnect_storm(_bundle({0: evs}))
         assert not sigs.detect_reconnect_storm(_bundle({0: evs[:-1]}))
 
+    def test_tier_aggregator_flap(self):
+        evs = [_ev(blackbox.K_RECONNECT, "tier_1",
+                   "sub-coordinator tier 1 index 0 reconnected upstream",
+                   rank=8, t=i)
+               for i in range(sigs.TIER_FLAP_COUNT)]
+        out = sigs.detect_tier_aggregator_flap(_bundle({8: evs}))
+        assert len(out) == 1
+        assert out[0]["id"] == "tier_aggregator_flap"
+        assert out[0]["evidence"]["tier"] == 1
+        assert out[0]["evidence"]["reconnects"] == sigs.TIER_FLAP_COUNT
+        assert not sigs.detect_tier_aggregator_flap(
+            _bundle({8: evs[:-1]}))
+        # per-rank reconnect events never count toward a TIER flap
+        rank_evs = [_ev(blackbox.K_RECONNECT, "rank_1", "resumed", rank=1,
+                        t=i) for i in range(sigs.TIER_FLAP_COUNT)]
+        assert not sigs.detect_tier_aggregator_flap(
+            _bundle({0: rank_evs}))
+
     def test_heartbeat_flap_counts_silences(self):
         evs = [_ev(blackbox.K_HEARTBEAT, "rank_1",
                    "rank 1 missed 1 heartbeat interval(s)", rank=1, t=1),

@@ -1010,6 +1010,275 @@ def test_hierarchical_1024_ranks_is_o_hosts():
                 assert len(resps) == 1
 
 
+class TestTierWireCodecs:
+    def test_runs_helpers_roundtrip(self):
+        ranks = [0, 1, 2, 5, 6, 9]
+        runs = wire.ranks_to_runs(ranks)
+        assert runs == [(0, 3), (5, 2), (9, 1)]
+        assert wire.runs_to_ranks(runs) == ranks
+        assert wire.runs_count(runs) == 6
+        assert wire.runs_contain(runs, 6)
+        assert not wire.runs_contain(runs, 7)
+
+    def test_runs_set_algebra(self):
+        a = wire.ranks_to_runs([0, 1, 2, 3])
+        b = wire.ranks_to_runs([2, 3, 4])
+        # merge takes DISJOINT lists (subtree coverage never overlaps) and
+        # coalesces adjacency into one run
+        assert wire.merge_runs([(0, 2)], [(2, 2), (8, 1)]) == [(0, 4),
+                                                               (8, 1)]
+        assert wire.runs_to_ranks(wire.runs_intersect(a, b)) == [2, 3]
+        assert wire.runs_to_ranks(wire.runs_subtract(a, b)) == [0, 1]
+        assert wire.runs_subtract(a, a) == []
+
+    def test_tier_batch_roundtrip(self):
+        groups = [(3, b"payload-a", [(0, 64), (128, 64)]),
+                  (4, b"payload-b", [(0, 8)])]
+        tier, index, got = wire.decode_tier_batch(
+            wire.encode_tier_batch(2, 7, groups))
+        assert (tier, index) == (2, 7)
+        assert got == groups
+
+    def test_tier_resp_and_heartbeat_roundtrip(self):
+        groups = [(9, b"resp", [(0, 1000)])]
+        assert wire.decode_tier_batch_resp(
+            wire.encode_tier_batch_resp(groups)) == groups
+        assert wire.decode_tier_heartbeat(
+            wire.encode_tier_heartbeat(3, 11, [(0, 5), (8, 2)])) == (
+                3, 11, [(0, 5), (8, 2)])
+
+    def test_tagged_journal_is_backward_compatible(self):
+        legacy = wire.encode_coord_journal(1, 2, [0, 1, 2], "why")
+        tagged = wire.encode_coord_journal(1, 2, [0, 1, 2], "why",
+                                           subtree="t2.1")
+        # the untagged decoder reads both shapes (old standbys keep
+        # working against a tagging primary)
+        assert (wire.decode_coord_journal(legacy)
+                == wire.decode_coord_journal(tagged)
+                == (1, 2, [0, 1, 2], "why"))
+        assert wire.decode_coord_journal_tagged(legacy) == (
+            1, 2, [0, 1, 2], "why", "")
+        assert wire.decode_coord_journal_tagged(tagged) == (
+            1, 2, [0, 1, 2], "why", "t2.1")
+
+
+class TestGroupAggregator:
+    def _agg(self, linger_s=60.0):
+        from horovod_tpu.runtime.hierarchy import GroupAggregator
+
+        flushed = []
+        agg = GroupAggregator(flushed.append, linger_s=linger_s)
+        return agg, flushed
+
+    def test_full_flush_merges_identical_payload_groups(self):
+        agg, flushed = self._agg()
+        replies = {1: [], 2: []}
+        agg.register(1, lambda g, e: replies[1].append((g, e)))
+        agg.register(2, lambda g, e: replies[2].append((g, e)))
+        agg.deposit(1, [(0, b"p", [(0, 4)])])
+        assert agg.flushes == 0  # still waiting for child 2
+        agg.deposit(2, [(0, b"p", [(4, 4)])])
+        assert agg.flushes == 1
+        # identical (seq, payload) groups coalesce into ONE upstream group
+        assert flushed == [[(0, b"p", [(0, 8)])]]
+
+    def test_response_routes_by_run_intersection(self):
+        agg, _ = self._agg()
+        replies = {1: [], 2: []}
+        agg.register(1, lambda g, e: replies[1].append((g, e)))
+        agg.register(2, lambda g, e: replies[2].append((g, e)))
+        agg.deposit(1, [(0, b"p", [(0, 4)])])
+        agg.deposit(2, [(0, b"p", [(4, 4)])])
+        agg.deliver_groups([(0, b"resp", [(0, 8)])])
+        assert replies[1] == [([(0, b"resp", [(0, 4)])], [])]
+        assert replies[2] == [([(0, b"resp", [(4, 4)])], [])]
+        assert agg.inflight_merged() == []
+
+    def test_partial_response_leaves_reshippable_remainder(self):
+        agg, _ = self._agg()
+        agg.register(1, lambda g, e: None)
+        agg.register(2, lambda g, e: None)
+        agg.deposit(1, [(0, b"p", [(0, 4)])])
+        agg.deposit(2, [(0, b"p", [(4, 4)])])
+        agg.deliver_groups([(0, b"resp", [(0, 4)])])
+        # the unanswered half stays eligible for the reconnect re-ship
+        assert agg.inflight_merged() == [(0, b"p", [(4, 4)])]
+
+    def test_deliver_entry_routes_deferred_joiner(self):
+        agg, _ = self._agg()
+        replies = []
+        agg.register(1, lambda g, e: replies.append((g, e)))
+        agg.deposit(1, [(0, b"p", [(3, 2)])])
+        agg.deliver_entry(4, 0, b"joiner")
+        assert replies == [([], [(4, 0, b"joiner")])]
+        # the per-rank answer subtracts exactly that rank from the ledger
+        assert agg.inflight_merged() == [(0, b"p", [(3, 1)])]
+
+    def test_unregister_keeps_inflight_for_rehoming_child(self):
+        agg, _ = self._agg()
+        agg.register(1, lambda g, e: None)
+        agg.deposit(1, [(0, b"p", [(0, 4)])])
+        agg.unregister(1)  # child connection dropped mid-round
+        # its rows survive: the child re-homes and re-ships, and upstream
+        # replay dedupe absorbs the duplicate
+        assert agg.inflight_merged() == [(0, b"p", [(0, 4)])]
+
+
+class TestGroupedExchange:
+    def test_tier_round_matches_flat_response_bytes(self):
+        st = make_state(world=4, threshold=0)
+        replies, deferred = st.exchange_tier(
+            2, "t2.0", [(0, _req_payload(), [(0, 4)])])
+        assert deferred == []
+        assert [(s, r) for s, _, r in replies] == [(0, [(0, 4)])]
+        # ONE grouped frame carried the whole round
+        assert st.frames_in == 1
+        flat = make_state(world=4, threshold=0)
+        flat_replies, _ = flat.exchange_batch(
+            [(r, 0, _req_payload()) for r in range(4)])
+        assert {d for _, _, d in flat_replies} == {replies[0][1]}
+
+    def test_shard_replay_is_idempotent(self):
+        st = make_state(world=2, threshold=0)
+        first, _ = st.exchange_tier(2, "t2.0",
+                                    [(0, _req_payload(), [(0, 2)])])
+        again, _ = st.exchange_tier(2, "t2.0",
+                                    [(0, _req_payload(), [(0, 2)])])
+        assert first == again  # answered from the subtree replay shard
+
+    def test_tier_and_flat_interoperate(self):
+        """Ranks 0-3 arrive as one group, ranks 4-5 flat: one barrier."""
+        st = make_state(world=6, threshold=0)
+        out = {}
+
+        def flat(r):
+            out[r] = st.exchange(r, 0, _req_payload())
+
+        ts = [threading.Thread(target=flat, args=(r,)) for r in (4, 5)]
+        for t in ts:
+            t.start()
+        replies, _ = st.exchange_tier(2, "t2.0",
+                                      [(0, _req_payload(), [(0, 4)])])
+        for t in ts:
+            t.join(timeout=30)
+        assert all(not t.is_alive() for t in ts)
+        assert replies[0][1] == out[4] == out[5]
+
+    def test_elastic_joiner_is_deferred_from_group(self):
+        st = make_state(world=2, elastic=True)
+        replies, deferred = st.exchange_tier(
+            2, "t2.0", [(0, _req_payload(epoch=0), [(0, 3)])])
+        # members answered as the narrowed run; the prospective joiner
+        # comes back for a dedicated deferred-admission thread
+        assert [(r, s) for r, s, _ in deferred] == [(2, 0)]
+        assert [(s, r) for s, _, r in replies] == [(0, [(0, 2)])]
+
+    def test_100k_ranks_reach_rank0_as_o_subtrees_frames(self):
+        """Tentpole acceptance shape: 102400 fake ranks behind 4 top-tier
+        subtrees negotiate with exactly 4 frames per round at rank 0 and
+        O(groups) work (no per-rank structures on the static path)."""
+        world, units = 102400, 4
+        per = world // units
+        st = make_state(world=world, threshold=0)
+        payload = _req_payload()
+        for rnd in range(3):
+            before = st.frames_in
+            datas = {}
+
+            def unit(u, rnd=rnd):
+                r, d = st.exchange_tier(
+                    4, "t4.%d" % u,
+                    [(rnd, payload, [(u * per, per)])])
+                assert d == []
+                datas[u] = r[0][1]
+
+            ts = [threading.Thread(target=unit, args=(u,))
+                  for u in range(units)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert all(not t.is_alive() for t in ts), "round deadlocked"
+            assert st.frames_in - before == units
+            assert len(set(datas.values())) == 1
+
+
+class TestTierFailover:
+    """Satellite 1: a sub-coordinator that loses its upstream probes the
+    failover keys and re-homes, re-shipping its in-flight ledger."""
+
+    def _kv(self, monkeypatch):
+        from horovod_tpu.run import rendezvous
+
+        secret = rendezvous.make_secret()
+        kv = rendezvous.KVStoreServer(secret).start()
+        monkeypatch.setenv("HVD_KV_ADDR", f"127.0.0.1:{kv.port}")
+        monkeypatch.setenv("HVD_SECRET", secret)
+        return kv, secret
+
+    def _tier_round(self, sock, secret, seq, payload, runs, timeout=30):
+        from horovod_tpu.runtime.coordinator import MSG_TBATCH
+        from horovod_tpu.runtime.coordinator import MSG_TBATCH_RESP
+
+        wire.send_frame(sock, secret, MSG_TBATCH, seq, 101,
+                        wire.encode_tier_batch(1, 0, [(seq, payload,
+                                                       runs)]))
+        stop = threading.Event()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                mt, _, _, data = wire.recv_frame(sock, secret, stop)
+            except socket.timeout:
+                continue
+            if mt == MSG_TBATCH_RESP:
+                return wire.decode_tier_batch_resp(data)
+        raise AssertionError("no tier response within %ss" % timeout)
+
+    def test_subcoord_rehomes_via_failover_key(self, monkeypatch):
+        from horovod_tpu.runtime.coordinator import (MSG_HELLO,
+                                                     _publish_key)
+        from horovod_tpu.runtime.hierarchy import SubCoordinator
+
+        kv, secret = self._kv(monkeypatch)
+        st = make_state(world=2, threshold=0)
+        server = CoordinatorServer(st, secret)
+        sub = None
+        child = None
+        server2 = None
+        try:
+            sub = SubCoordinator("127.0.0.1", server.port, secret,
+                                 leader_rank=0, tier=2, index=0, tiers=2,
+                                 up_fail_base="addr.901")
+            child = socket.create_connection(("127.0.0.1", sub.port),
+                                             timeout=5)
+            child.settimeout(0.5)
+            wire.send_frame(child, secret, MSG_HELLO, 0, 101)
+            got = self._tier_round(child, secret, 0, _req_payload(),
+                                   [(0, 2)])
+            assert [(s, r) for s, _, r in got] == [(0, [(0, 2)])]
+
+            # primary upstream dies abruptly; a replacement comes up under
+            # the failover key the sub-coordinator probes on reconnect
+            server.die()
+            server2 = CoordinatorServer(make_state(world=2, threshold=0),
+                                        secret)
+            _publish_key("addr.901.f1", f"127.0.0.1:{server2.port}",
+                         secret)
+            got = self._tier_round(child, secret, 1, _req_payload(),
+                                   [(0, 2)])
+            assert [(s, r) for s, _, r in got] == [(1, [(0, 2)])]
+            assert sub._up_addr == ("127.0.0.1", server2.port)
+        finally:
+            if child is not None:
+                child.close()
+            if sub is not None:
+                sub.stop()
+            if server2 is not None:
+                server2.stop()
+            server.stop()
+            kv.stop()
+
+
 class TestStormProofRendezvous:
     def test_join_storm_coalesces_to_one_epoch(self, monkeypatch):
         """64 simultaneous joiners -> exactly ONE membership epoch bump."""
@@ -1144,6 +1413,8 @@ class TestFlatWireByteIdentity:
         monkeypatch.delenv("HOROVOD_HIERARCHICAL_COORD", raising=False)
         monkeypatch.delenv("HOROVOD_STANDBY_COORD", raising=False)
         monkeypatch.delenv("HOROVOD_ADMISSION_BATCH_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_HIERARCHY_TIERS", raising=False)
+        monkeypatch.delenv("HOROVOD_HIERARCHY_FANOUT", raising=False)
         sent_types = []
         real = wire.send_frame
 
@@ -1379,8 +1650,15 @@ def _failover_train_fn():
     def train(state):
         ctrl = hvd.basics._engine().controller
         while state.step < 12:
-            if hvd.rank() == 0 and state.step == 5:
-                os._exit(23)  # SIGKILL-equivalent: no BYE, server dies too
+            if state.step == 5 and ctrl.epoch() == 0:
+                # barrier before the kill: every rank has logged AND
+                # committed step 4, so restore can never sync a survivor
+                # past a step another survivor hasn't logged yet (rank 0
+                # dying between serving two ranks' step-4 data otherwise
+                # loses the slower rank's row to the rollback)
+                hvd.allreduce(np.zeros(1, np.float32), name="prekill")
+                if hvd.rank() == 0:
+                    os._exit(23)  # SIGKILL-equivalent: no BYE, server dies
             g = np.float32(hvd.rank() + 1) * (np.asarray(state.w) - target)
             avg = hvd.allreduce(g, name=f"grad{state.step}",
                                 op=hvd.Average)
@@ -1594,3 +1872,110 @@ def test_hierarchical_mode_end_to_end():
             if p.poll() is None:
                 p.kill()
         kv.stop()
+
+
+# ------------------- integration: hierarchical x standby SIGKILL failover
+@pytest.mark.integration
+def test_hierarchical_standby_sigkill():
+    """ISSUE acceptance: SIGKILL rank 0 with BOTH the hierarchical control
+    plane and the warm standby enabled. Ranks 1+2 negotiate through their
+    host's sub-coordinator; when rank 0 dies, the standby on rank 1
+    promotes and the sub-coordinator re-homes upstream via the
+    ``addr.{gen}.f1`` failover key, re-shipping its in-flight batch ledger
+    — no step lost, none double-applied, survivors bit-identical."""
+    import cloudpickle
+
+    from horovod_tpu.run import rendezvous
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_failover_train_fn, (), {})))
+
+    # two simulated hosts: rank 0 alone on host 0; ranks 1+2 on host 1
+    # behind rank 1's sub-coordinator (rank 1 also runs the standby), so
+    # the failover exercises the aggregator re-home, not just the direct
+    # worker reconnect
+    placement = {0: ("0", "0"), 1: ("0", "1"), 2: ("1", "1")}
+    procs = []
+    try:
+        for r in range(3):
+            local, cross = placement[r]
+            env = dict(os.environ)
+            env.update({
+                "HVD_NUM_PROCS": "3",
+                "HVD_PROCESS_ID": str(r),
+                "HVD_KV_ADDR": addr,
+                "HVD_SECRET": secret,
+                "HVD_ELASTIC": "1",
+                "HVD_LOCAL_RANK": local,
+                "HVD_CROSS_RANK": cross,
+                "HOROVOD_HIERARCHICAL_COORD": "1",
+                "HOROVOD_STANDBY_COORD": "1",
+                "HOROVOD_RECONNECT_GRACE": "15",
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "PYTHONPATH": os.pathsep.join(
+                    [os.path.dirname(here), here]),
+            })
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+        deadline = time.time() + 180
+        blobs = {}
+        while time.time() < deadline and len(blobs) < 2:
+            for r in (1, 2):
+                if r not in blobs:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+            if len(blobs) < 2 and all(p.poll() is not None for p in procs):
+                time.sleep(1.0)  # final PUTs may still be in flight
+                for r in (1, 2):
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+                break
+            time.sleep(0.25)
+        assert len(blobs) == 2, (
+            f"survivors produced no result (got ranks {sorted(blobs)}); "
+            f"exit codes {[p.poll() for p in procs]}")
+        logs = {}
+        for r, blob in blobs.items():
+            ok, log = pickle.loads(blob)
+            assert ok, f"rank {r} raised:\n{log}"
+            logs[r] = log
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
+
+    assert procs[0].wait(timeout=10) == 23
+
+    for r in (1, 2):
+        steps = [row[0] for row in logs[r]]
+        # every step exactly once: none lost, none double-applied
+        assert steps == list(range(12)), (r, steps)
+        epochs = {s: e for s, _, e, _ in logs[r]}
+        assert all(epochs[s] == 0 for s in range(5)), (r, epochs)
+        assert all(epochs[s] == 1 for s in range(5, 12)), (r, epochs)
+        assert logs[r][-1][3] == [1, 2], (r, logs[r][-1])
+
+    # bit-identical across survivors at every step, on the expected
+    # trajectory (mean gradient 2.0 with 3 members, 2.5 with 2)
+    w1 = [row[1] for row in logs[1]]
+    w2 = [row[1] for row in logs[2]]
+    assert w1 == w2, "survivors diverged after failover"
+    w = 4.0
+    for step in range(12):
+        c = 2.0 if step < 5 else 2.5
+        w = w - 0.1 * c * (w - 1.0)
+        assert abs(w1[step] - w) < 1e-4 * max(1.0, abs(w)), (
+            f"step {step}: got {w1[step]}, expected ~{w} — a step was "
+            f"lost or double-applied across the failover")
