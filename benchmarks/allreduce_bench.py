@@ -238,6 +238,71 @@ def bench_compression(sizes_mb, iters, warmup, modes):
     return results
 
 
+_ALGO_WIRES = ("off", "int8", "int4")
+
+
+def bench_algo_sweep(sizes_mb, iters, warmup, wires=_ALGO_WIRES):
+    """Algorithm-zoo sweep on the compiled fast path: one jitted shard_map
+    program per (payload size, algorithm, bitwidth) cell — the flat
+    bidirectional ring, the recursive-halving/doubling tree, and the
+    two-level hierarchical schedule, each over the exact and quantized
+    wires. One JSON row per cell (step time, algbw, catalog wire bytes);
+    the driver derives the per-size "tuned" row as the step-time argmin,
+    which is what the joint tuner converges to online (docs/autotune.md).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.basics import MESH_AXIS, Average
+    from horovod_tpu.ops import compression as comp
+
+    mesh = hvd.mesh()
+    n = hvd.num_replicas()
+    block = comp.block_size()
+    hosts = spmd.mesh_hosts(n)
+    zoo = (("ring", spmd.quantized_allreduce),
+           ("tree", spmd.quantized_allreduce_tree),
+           ("hier", spmd.quantized_allreduce_hier))
+    results = []
+    for mb in sizes_mb:
+        nelem = max(1, int(mb * (1 << 20)) // 4)
+        x = jnp.arange(n * nelem, dtype=jnp.float32).reshape(n, nelem)
+        x = jax.device_put(x, NamedSharding(mesh, P(MESH_AXIS)))
+        for algo, fn in zoo:
+            for wire in wires:
+                def body(row, fn=fn, wire=wire):
+                    return fn(row[0], Average, MESH_AXIS, wire)[None]
+
+                reduce = jax.jit(spmd._shard_map(
+                    body, mesh, in_specs=P(MESH_AXIS),
+                    out_specs=P(MESH_AXIS)))
+                out = reduce(x)
+                for _ in range(warmup - 1):
+                    out = reduce(x)
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = reduce(x)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / iters
+                mode = "none" if wire == "off" else wire
+                wire_bytes = comp.gspmd_wire_footprint(
+                    nelem, mode, n, block, algorithm=algo,
+                    hosts=hosts if algo == "hier" else None)
+                results.append({
+                    "path": "algo", "algorithm": algo, "mode": wire,
+                    "size_mb": mb, "n": n,
+                    "time_us": round(dt * 1e6, 1),
+                    "algbw_gbps": round(nelem * 4 / dt / 1e9, 3),
+                    "wire_bytes": wire_bytes,
+                })
+                print(json.dumps(results[-1]))
+    return results
+
+
 def bench_bucket_overlap(bucket_mbs, iters, warmup, layers=16, np_=8):
     """Backward-pass bucket-overlap sweep (HOROVOD_BUCKET_MB,
     docs/overlap.md): a synthetic gradient pytree (``layers`` x
@@ -396,7 +461,12 @@ def bench_straggler_chaos(chaos, iters, warmup, np_=4, victim=1,
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes-mb", default="0.0625,0.25,1,4,16,64",
-                    help="comma-separated message sizes in MB")
+                    help="comma-separated message sizes in MB (may be "
+                         "empty when --sizes-kb carries the sweep)")
+    ap.add_argument("--sizes-kb", default=None,
+                    help="extra sub-MB message sizes in KB, merged into "
+                         "the sweep (e.g. '4,16' for the latency-bound "
+                         "payloads the tree algorithm targets)")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--path", choices=["spmd", "eager", "allgather",
@@ -438,8 +508,19 @@ def main(argv=None):
                          "against --history")
     ap.add_argument("--regression-window", type=int, default=None)
     ap.add_argument("--regression-tolerance", type=float, default=None)
+    ap.add_argument("--algo-sweep", action="store_true",
+                    help="sweep the collective-algorithm zoo (ring/tree/"
+                         "hier x off/int8/int4) on the compiled fast path; "
+                         "one JSON row per cell plus the per-size tuned "
+                         "argmin; headline allreduce_algo_tuned_algbw_gbps "
+                         "feeds --history/--check-regression")
     args = ap.parse_args(argv)
-    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    sizes = [float(s) for s in args.sizes_mb.split(",") if s.strip()]
+    if args.sizes_kb:
+        sizes = sorted(set(sizes) | {
+            float(k) / 1024.0 for k in args.sizes_kb.split(",") if k.strip()})
+    if not sizes:
+        ap.error("no message sizes: give --sizes-mb and/or --sizes-kb")
 
     import horovod_tpu as hvd
 
@@ -562,6 +643,61 @@ def main(argv=None):
                           f"{verdict['samples']} runs)", file=sys.stderr)
                     rc = 3
             append_record(args.history, result)
+        if rc:
+            sys.exit(rc)
+        return results
+
+    if args.algo_sweep:
+        hvd.init()
+        results = bench_algo_sweep(sizes, args.iters, args.warmup)
+        by_size = {}
+        for r in results:
+            by_size.setdefault(r["size_mb"], []).append(r)
+        tuned = []
+        for mb in sorted(by_size):
+            # the per-size winner: what the joint tuner's argmin settles on,
+            # >= every fixed (algorithm, bitwidth) at this size by
+            # construction (the ISSUE acceptance)
+            best = min(by_size[mb], key=lambda r: r["time_us"])
+            tuned.append(best)
+            print(json.dumps({"metric": "allreduce_algo_tuned",
+                              "size_mb": mb,
+                              "algorithm": best["algorithm"],
+                              "mode": best["mode"],
+                              "time_us": best["time_us"],
+                              "algbw_gbps": best["algbw_gbps"]}))
+        peak = max(tuned, key=lambda r: r["algbw_gbps"])
+        result = {"metric": "allreduce_algo_tuned_algbw_gbps",
+                  "value": peak["algbw_gbps"], "unit": "GB/s",
+                  "config": {k: peak[k] for k in ("algorithm", "mode",
+                                                  "size_mb", "n")}}
+        print(json.dumps(result))
+        rc = 0
+        if args.history:
+            from benchmarks.history import (append_record, check_regression,
+                                            load_history)
+
+            # compare against the trajectory BEFORE appending, same as the
+            # compression headline below
+            if args.check_regression:
+                verdict = check_regression(
+                    load_history(args.history, metric=result["metric"]),
+                    result["value"],
+                    **{k: v for k, v in (
+                        ("window", args.regression_window),
+                        ("tolerance", args.regression_tolerance))
+                       if v is not None})
+                print("# regression check: %s" % json.dumps(verdict),
+                      file=sys.stderr)
+                if verdict["regression"]:
+                    print(f"# REGRESSION: {result['metric']} = "
+                          f"{result['value']} fell below the floor "
+                          f"{verdict['floor']} (baseline "
+                          f"{verdict['baseline']} over "
+                          f"{verdict['samples']} runs)", file=sys.stderr)
+                    rc = 3
+            append_record(args.history, result)
+        hvd.shutdown()
         if rc:
             sys.exit(rc)
         return results

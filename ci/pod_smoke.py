@@ -912,6 +912,63 @@ def check_gspmd_quantized() -> None:
           f"({r.stdout.strip().splitlines()[-1]})")
 
 
+def check_algo_hierarchical() -> None:
+    """Hierarchical collective smoke (docs/gspmd.md algorithm zoo): on a
+    simulated 2-host x 4-chip factorization (HOROVOD_MESH_HOSTS=2 over the
+    8-device virtual mesh) the two-level schedule must agree with the flat
+    ring — bit-identical across ranks, within float tolerance of the
+    ring's result (the schedules reduce in different orders, so last-ulp
+    equality is the per-rank invariant, not the cross-algorithm one) —
+    while crossing host boundaries with strictly fewer bytes per the
+    gspmd_cross_host_footprint catalog."""
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from horovod_tpu import spmd\n"
+        "from horovod_tpu.basics import Average, MESH_AXIS\n"
+        "from horovod_tpu.ops import compression as comp\n"
+        "n = len(jax.devices())\n"
+        "assert n == 8, n\n"
+        "assert spmd.mesh_hosts(n) == 2  # the env factorization: 2x4\n"
+        "mesh = jax.make_mesh((n,), (MESH_AXIS,))\n"
+        "d = 16384\n"
+        "rng = np.random.RandomState(0)\n"
+        "data = rng.randn(n, d).astype(np.float32)\n"
+        "def run(fn, wire):\n"
+        "    body = lambda r: fn(r[0], Average, MESH_AXIS, wire)[None]\n"
+        "    sm = spmd._shard_map(body, mesh, in_specs=P(MESH_AXIS),\n"
+        "                         out_specs=P(MESH_AXIS))\n"
+        "    return np.asarray(jax.jit(sm)(data))\n"
+        "for wire, tol in (('off', 1e-5), ('int8', 0.05)):\n"
+        "    ring = run(spmd.quantized_allreduce, wire)\n"
+        "    hier = run(spmd.quantized_allreduce_hier, wire)\n"
+        "    for p in range(1, n):  # replicated params rest on this\n"
+        "        assert (hier[p] == hier[0]).all(), (wire, p)\n"
+        "    assert np.abs(hier[0] - ring[0]).max() < tol, wire\n"
+        "block = comp.block_size()\n"
+        "xring = comp.gspmd_cross_host_footprint(d, 'int8', n, 2, block,\n"
+        "                                        'ring')\n"
+        "xhier = comp.gspmd_cross_host_footprint(d, 'int8', n, 2, block,\n"
+        "                                        'hier')\n"
+        "assert 0 < xhier < xring, (xhier, xring)\n"
+        "print(f'hier == ring on 2x4, cross-host {xhier} B < ring "
+        "{xring} B ({100.0 * xhier / xring:.0f}%)')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               HOROVOD_MESH_HOSTS="2",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"hierarchical-algorithm smoke job failed:\n{r.stderr[-2000:]}")
+    print(f"ok: hierarchical collective smoke — 2x4 factorization matched "
+          f"the flat ring with fewer cross-host bytes "
+          f"({r.stdout.strip().splitlines()[-1]})")
+
+
 def check_moe_quantized() -> None:
     """Quantized MoE dispatch smoke (docs/moe.md): capacity-factor Switch
     dispatch on a dp=2 x ep=4 virtual mesh with HOROVOD_MOE_WIRE=int8 in
@@ -1204,14 +1261,15 @@ def main():
     check_straggler_adaptive()
     check_adaptive_wire()
     check_gspmd_quantized()
+    check_algo_hierarchical()
     check_moe_quantized()
     check_serving_kill()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
           "+ bucket overlap + blackbox doctor + coordinator failover "
           "+ tier aggregator re-home + straggler adaptive + adaptive wire "
-          "+ quantized GSPMD wire + quantized MoE dispatch "
-          "+ serving worker-kill valid")
+          "+ quantized GSPMD wire + hierarchical collective "
+          "+ quantized MoE dispatch + serving worker-kill valid")
 
 
 if __name__ == "__main__":

@@ -86,6 +86,15 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/scaling_bench.py --three-way --iters 3 \
         --elements 65536
 
+stage "algo: collective algorithm zoo, joint tuner, footprint catalog"
+python -m pytest tests/test_algo.py -q
+# acceptance: the (size x algorithm x bitwidth) sweep on the compiled
+# fast path — the per-size tuned argmin >= every fixed combo by
+# construction; sub-64KB points exercise the tree's latency regime
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/allreduce_bench.py --algo-sweep \
+        --sizes-mb "" --sizes-kb 4,16 --iters 3
+
 stage "moe: capacity-factor Switch dispatch over the quantized all_to_all"
 python -m pytest tests/test_moe.py tests/test_expert_parallel.py -q
 # acceptance: four-config head-to-head (exact one-hot vs capacity vs

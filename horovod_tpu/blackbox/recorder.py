@@ -41,6 +41,8 @@ K_ANOMALY = "anomaly"          # live anomaly-watch detection
 K_FAILOVER = "failover"        # coordinator failover (standby promotion or
                                # a worker redialing the promoted standby)
 K_BITWIDTH = "bitwidth"        # adaptive-wire bitwidth decision change
+K_ALGO = "algorithm"           # collective-algorithm decision change or
+                               # joint-tuner settle (name = size class)
 K_EXCLUDED = "excluded"        # straggler policy excluded/readmitted/
                                # escalated a rank (detail names the host)
 

@@ -40,6 +40,9 @@ TIER_FLAP_COUNT = 3
 HEARTBEAT_FLAP_TRANSITIONS = 2
 #: bitwidth decision changes for ONE bucket that constitute thrash
 BITWIDTH_THRASH_FLIPS = 4
+#: collective-algorithm changes for ONE payload-size class that constitute
+#: thrash (the zoo recompiles the step program on every switch)
+ALGO_THRASH_FLIPS = 4
 #: exclusion episodes for one rank past which it is chronic, not noise
 CHRONIC_STRAGGLER_EPISODES = 3
 
@@ -339,6 +342,40 @@ def detect_bitwidth_thrash(bundle) -> List[dict]:
     return sigs
 
 
+def detect_algorithm_thrash(bundle) -> List[dict]:
+    """A payload-size class whose collective algorithm keeps flipping
+    (many K_ALGO decision changes for one class) is thrashing: its payload
+    profile sits on a zoo decision boundary, and every flip retraces and
+    recompiles the step program. Pin the schedule with
+    HOROVOD_GSPMD_ALGO=ring|tree|hier, or let the joint tuner settle
+    (HOROVOD_AUTOTUNE_ALGO) instead of flipping by hand."""
+    flips: Dict[str, int] = {}
+    last: Dict[str, str] = {}
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_ALGO:
+            continue
+        name = ev.get("name") or "?"
+        detail = ev.get("detail") or ""
+        # settle events are terminal decisions, not flips
+        if detail.startswith("settled"):
+            continue
+        # dedupe rank-interleaved streams on transition, as bitwidth does
+        if detail == last.get(name):
+            continue
+        last[name] = detail
+        flips[name] = flips.get(name, 0) + 1
+    sigs = []
+    for name, n in sorted(flips.items()):
+        if n >= ALGO_THRASH_FLIPS:
+            sigs.append(make_signature(
+                "algorithm_thrash", SEV_WARNING,
+                "collective algorithm thrashing: size class '%s' changed "
+                "algorithm %d times (pin HOROVOD_GSPMD_ALGO or let the "
+                "joint tuner settle)" % (name, n),
+                size_class=name, flips=n))
+    return sigs
+
+
 def detect_chronic_straggler(bundle) -> List[dict]:
     """A rank the straggler policy (runtime/straggler.py) excluded over
     and over. Each exclusion records a K_EXCLUDED event carrying a
@@ -423,6 +460,7 @@ DETECTORS = (
     detect_tier_aggregator_flap,
     detect_heartbeat_flap,
     detect_bitwidth_thrash,
+    detect_algorithm_thrash,
 )
 
 

@@ -142,6 +142,14 @@ def adaptive_bitwidth():
         "(4 = int4, 8 = int8, 16 = bf16 fallback).")
 
 
+def collective_algorithm():
+    return get_registry().gauge(
+        "hvd_collective_algorithm",
+        "Collective algorithm in play per payload-size class "
+        "(0 = ring, 1 = tree, 2 = hierarchical — ops/adaptive.ALGO_CODES).",
+        labels=("class",))
+
+
 def error_feedback_roundtrips():
     return get_registry().counter(
         "hvd_error_feedback_roundtrips_total",

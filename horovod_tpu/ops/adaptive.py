@@ -44,6 +44,28 @@ import numpy as np
 MODES = ("int4", "int8", "bf16")
 BITS = {"int4": 4, "int8": 8, "bf16": 16}
 
+#: collective algorithm zoo for the compiled fast path (spmd.py), in
+#: exploration order: the ring is the incumbent (byte-identical to the
+#: pre-zoo wire), the tree is latency-optimal for small payloads, the
+#: hierarchical schedule wins on multi-host factorizations
+ALGORITHMS = ("ring", "tree", "hier")
+#: gauge encoding for hvd_collective_algorithm{class}
+ALGO_CODES = {"ring": 0, "tree": 1, "hier": 2}
+
+#: payload-size classes the joint (algorithm, bitwidth) tuner scores
+#: independently — the winning algorithm is a function of payload size
+#: (PAPERS.md arXiv:1810.11112), so one global argmin would let large
+#: buckets outvote the latency-bound small ones. Bounds in wire bytes.
+SIZE_CLASSES = (("small", 1 << 16), ("medium", 1 << 22), ("large", None))
+
+
+def size_class(nbytes: int) -> str:
+    """Class name for one round's payload bytes (upper bounds inclusive)."""
+    for name, bound in SIZE_CLASSES:
+        if bound is None or nbytes <= bound:
+            return name
+    return SIZE_CLASSES[-1][0]
+
 #: elements of the reduced bucket sampled per observation (deterministic
 #: prefix — identical on every rank, cheap on the host)
 SAMPLE = 4096
@@ -97,11 +119,33 @@ def autotuned_cap() -> str:
         return _autotuned_cap
 
 
+# The coordinator's joint tuner broadcasts the winning collective algorithm
+# as the fourth tuned field (runtime/wire.py flag byte 3); "" means no
+# broadcast has arrived and spmd.resolve_algorithm falls back to its static
+# size/topology heuristic.
+_autotuned_algo = ""
+
+
+def set_autotuned_algorithm(algo: str) -> None:
+    global _autotuned_algo
+    if algo not in ALGORITHMS:
+        return  # a newer coordinator speaking an unknown member: ignore
+    with _cap_lock:
+        _autotuned_algo = algo
+
+
+def autotuned_algorithm() -> str:
+    with _cap_lock:
+        return _autotuned_algo
+
+
 def reset() -> None:
-    """Test hook: forget the broadcast cap and the cached gate verdicts."""
-    global _autotuned_cap
+    """Test hook: forget the broadcast cap/algorithm and the cached gate
+    verdicts."""
+    global _autotuned_cap, _autotuned_algo
     with _cap_lock:
         _autotuned_cap = "int4"
+        _autotuned_algo = ""
     ConvergenceGate.shared().forget()
 
 
@@ -395,3 +439,103 @@ class BitwidthTuner:
             if best_mean is None or mean < best_mean:
                 best, best_mean = m, mean
         self._settled = best or self._candidates[-1]
+
+
+class _ClassSearch:
+    """Episode walk over (algorithm, cap) combos for ONE payload-size
+    class (:class:`JointTuner` state)."""
+
+    __slots__ = ("combos", "idx", "rounds", "seconds", "settled")
+
+    def __init__(self, combos):
+        self.combos = combos
+        self.idx = 0
+        self.rounds = 0
+        self.seconds: Dict[Tuple[str, str], list] = {c: [] for c in combos}
+        self.settled: Optional[Tuple[str, str]] = None
+
+    def current(self) -> Tuple[str, str]:
+        return self.settled if self.settled is not None \
+            else self.combos[self.idx]
+
+
+class JointTuner:
+    """Rank-0 joint ``(algorithm, bitwidth-cap)`` search, per payload-size
+    class (autotune v3 — the :class:`BitwidthTuner` grown an algorithm
+    axis).
+
+    Every gate-admitted combination — zoo member x bitwidth cap, least
+    aggressive first, so exploration starts schedule- and byte-identical
+    to the pre-autotune wire — runs for ``episode_rounds`` scored
+    negotiation rounds inside its payload-size class (:func:`size_class`
+    of the round's wire bytes: the winning algorithm is a function of
+    payload size, so classes settle independently). Episodes are scored by
+    measured step time, not bytes: a cheaper wire on a slower schedule
+    loses. After the walk the per-class argmin mean step time wins (ties
+    go to the later, more aggressive combo) and that class settles.
+
+    :meth:`cap` and :meth:`algorithm` expose the combo for the most
+    recently observed round's class — what the next tuned ``ResponseList``
+    broadcast (fields 3 and 4, runtime/wire.py) should carry so every
+    rank applies the winner for the traffic actually in flight. Settling
+    records one blackbox ``K_ALGO`` decision event per class.
+    """
+
+    def __init__(self, episode_rounds: int = 8):
+        self.episode_rounds = episode_rounds
+        gate = ConvergenceGate.shared()
+        caps = [m for m in reversed(MODES)
+                if m != "int4" or gate.allows("int4")]
+        self._combos = [(a, c) for a in ALGORITHMS for c in caps]
+        self._cls: Dict[str, _ClassSearch] = {
+            name: _ClassSearch(list(self._combos))
+            for name, _ in SIZE_CLASSES}
+        self._last_cls = SIZE_CLASSES[0][0]
+
+    def active(self) -> bool:
+        return any(s.settled is None for s in self._cls.values())
+
+    def choice(self, cls: Optional[str] = None) -> Tuple[str, str]:
+        return self._cls[cls or self._last_cls].current()
+
+    def cap(self) -> str:
+        return self.choice()[1]
+
+    def algorithm(self) -> str:
+        return self.choice()[0]
+
+    def observe(self, round_bytes: int, round_seconds: float) -> None:
+        """One scored negotiation round under the current class combo."""
+        if round_bytes <= 0 or round_seconds <= 0:
+            return
+        cls = size_class(int(round_bytes))
+        self._last_cls = cls
+        s = self._cls[cls]
+        if s.settled is not None:
+            return
+        s.seconds[s.combos[s.idx]].append(float(round_seconds))
+        s.rounds += 1
+        if s.rounds >= self.episode_rounds:
+            s.rounds = 0
+            s.idx += 1
+            if s.idx >= len(s.combos):
+                self._settle(cls, s)
+
+    def _settle(self, cls: str, s: _ClassSearch) -> None:
+        best, best_mean = None, None
+        for c in s.combos:
+            vals = s.seconds[c]
+            if not vals:
+                continue
+            mean = sum(vals) / len(vals)
+            # <=: on a tie the later (more aggressive) combo sticks
+            if best_mean is None or mean <= best_mean:
+                best, best_mean = c, mean
+        s.settled = best or s.combos[-1]
+        from .. import blackbox as _blackbox
+        from ..metrics import instruments
+
+        _blackbox.record(_blackbox.K_ALGO, cls,
+                         "settled %s/%s" % s.settled)
+        instruments.collective_algorithm().labels(**{"class": cls}).set(
+            ALGO_CODES.get(s.settled[0], 0))

@@ -156,32 +156,100 @@ def wire_footprint(num_elements: int, mode: str,
     raise ValueError(f"unknown compression mode {mode!r}")
 
 
-def gspmd_wire_footprint(num_elements: int, mode: str, world: int,
-                         block: int | None = None) -> int:
-    """Bytes ONE rank puts on the wire for one ring allreduce on the
-    compiled path (`spmd.quantized_allreduce`: reduce-scatter +
-    all-gather, each phase ``world - 1`` hops of one chunk).
-
-    Quantized modes move packed rows — ``[block payload | 4 scale bytes]``
-    for int8, ``[block//2 | 4]`` for int4 — over a chunk rounded up to
-    whole blocks. ``none``/``fp32`` (``bf16``/``fp16``) count the plain
-    GSPMD ring moving raw 4-byte (2-byte) elements with no scale overhead:
-    the exact-wire denominator behind ``hvd_quantization_ratio`` and the
-    three-way `scaling_bench`. The ZeRO-1 variant (gradient reduce-scatter
-    + update all-gather) moves the same total. ``world == 1`` is wireless.
-    """
-    if world <= 1:
-        return 0
+def _gspmd_seg_bytes(elems: int, mode: str, block: int | None) -> int:
+    """Bytes one exchanged segment of ``elems`` f32 elements costs on a
+    GSPMD wire: packed rows for int8/int4, raw elements otherwise."""
     per_elem = {"none": 4, "fp32": 4, "fp16": 2, "bf16": 2}.get(mode)
     if per_elem is not None:
-        return 2 * (world - 1) * -(-num_elements // world) * per_elem
+        return elems * per_elem
     if mode not in ("int8", "int4"):
         raise ValueError(f"unknown GSPMD wire mode {mode!r}")
     block = block or block_size()
-    per_rank = -(-num_elements // world)
-    rows = -(-per_rank // block)
+    rows = -(-elems // block)
     row_bytes = (block if mode == "int8" else block // 2) + 4
-    return 2 * (world - 1) * rows * row_bytes
+    return rows * row_bytes
+
+
+def gspmd_wire_footprint(num_elements: int, mode: str, world: int,
+                         block: int | None = None,
+                         algorithm: str = "ring",
+                         hosts: int | None = None) -> int:
+    """Bytes ONE rank puts on the wire for one allreduce on the compiled
+    path, per zoo member (`spmd.quantized_allreduce` and friends).
+
+    Quantized modes move packed rows — ``[block payload | 4 scale bytes]``
+    for int8, ``[block//2 | 4]`` for int4 — over chunks rounded up to
+    whole blocks. ``none``/``fp32`` (``bf16``/``fp16``) count the same
+    schedule moving raw 4-byte (2-byte) elements with no scale overhead:
+    the exact-wire denominator behind ``hvd_quantization_ratio`` and the
+    three-way `scaling_bench`. ``world == 1`` is wireless.
+
+    ``algorithm`` rows (docs/autotune.md):
+
+    * ``ring`` — reduce-scatter + all-gather, each phase ``world - 1``
+      hops of one per-rank chunk. The ZeRO-1 variant moves the same
+      total. Byte-identical to the pre-zoo catalog.
+    * ``tree`` — recursive halving/doubling, ``2 * log2(world)``
+      exchanges of a payload half (`spmd.quantized_allreduce_tree`);
+      non-power-of-two worlds ride the ring and cost ring bytes.
+    * ``hier`` — intra-host reduce-scatter + all-gather over
+      ``chips = world // hosts`` plus the cross-host phase on the owned
+      chunk (`spmd.quantized_allreduce_hier`); ``hosts`` must be a proper
+      divisor of ``world`` or the ring row applies.
+    """
+    if world <= 1:
+        return 0
+    if algorithm == "tree" and world & (world - 1) == 0:
+        half = -(-num_elements // 2)
+        rounds = world.bit_length() - 1
+        return 2 * rounds * _gspmd_seg_bytes(half, mode, block)
+    if (algorithm == "hier" and hosts and 1 < hosts < world
+            and world % hosts == 0):
+        chips = world // hosts
+        chunk = -(-num_elements // chips)
+        sub = -(-chunk // hosts)
+        intra = 2 * (chips - 1) * _gspmd_seg_bytes(chunk, mode, block)
+        cross = 2 * (hosts - 1) * _gspmd_seg_bytes(sub, mode, block)
+        return intra + cross
+    return (2 * (world - 1)
+            * _gspmd_seg_bytes(-(-num_elements // world), mode, block))
+
+
+def gspmd_cross_host_footprint(num_elements: int, mode: str, world: int,
+                               hosts: int, block: int | None = None,
+                               algorithm: str = "ring") -> int:
+    """Bytes crossing a host boundary, summed over ALL ranks, for one
+    allreduce under a host-major ``(hosts, chips)`` layout — the number
+    the hierarchical schedule exists to shrink (`ci/pod_smoke.py`
+    ``check_algo_hierarchical``).
+
+    ``ring``: the flat ring has ``hosts`` boundary edges and every edge
+    carries ``world - 1`` chunk segments per phase. ``hier``: only the
+    phase-2 host-ring rows cross hosts — ``chips`` parallel rings of
+    ``hosts`` edges, each edge carrying ``hosts - 1`` sub-chunk segments
+    per phase. ``tree``: at recursion distance ``d >= chips`` every rank's
+    partner is on another host; smaller distances stay intra-host.
+    """
+    if world <= 1 or hosts <= 1 or world % hosts:
+        return 0
+    chips = world // hosts
+    if algorithm == "hier":
+        chunk = -(-num_elements // chips)
+        sub = -(-chunk // hosts)
+        return (2 * (hosts - 1) * chips * hosts
+                * _gspmd_seg_bytes(sub, mode, block))
+    if algorithm == "tree" and world & (world - 1) == 0:
+        total = 0
+        seg = -(-num_elements // 2)
+        d = world >> 1
+        while d >= 1:
+            if d >= chips:  # partner p ^ d sits on another host
+                total += 2 * world * _gspmd_seg_bytes(seg, mode, block)
+            seg = -(-seg // 2)
+            d >>= 1
+        return total
+    chunk = -(-num_elements // world)
+    return 2 * (world - 1) * hosts * _gspmd_seg_bytes(chunk, mode, block)
 
 
 def moe_wire_footprint(per_peer_elements: int, mode: str, world: int,

@@ -1363,6 +1363,13 @@ class CoordState:
         self.tuned = (self.threshold, float(self.tuner.cycle_time_ms()))
         if self.bw_tuner is not None:
             self.tuned = self.tuned + (self.bw_tuner.cap(),)
+            # joint (algorithm, bitwidth) tuner only: the fourth tuned
+            # field carries the collective algorithm for the traffic class
+            # in flight; the plain BitwidthTuner has no algorithm axis and
+            # the frame stays byte-identical to the 3-field wire
+            algo = getattr(self.bw_tuner, "algorithm", None)
+            if algo is not None:
+                self.tuned = self.tuned + (algo(),)
         return self.tuned
 
     def _negotiate(self, per_rank, seq: int = -1) -> bytes:
@@ -1645,7 +1652,14 @@ class CoordState:
                 and m.compression.startswith("adaptive")):
             from ..ops import adaptive as _adaptive
 
-            self.bw_tuner = _adaptive.BitwidthTuner()
+            # HOROVOD_AUTOTUNE_ALGO upgrades the bitwidth-cap search to the
+            # joint (algorithm, bitwidth) tuner (autotune v3); unset keeps
+            # the PR 10 cap-only walk and the 3-field tuned broadcast
+            if os.environ.get("HOROVOD_AUTOTUNE_ALGO", "").strip() not in (
+                    "", "0", "false", "off"):
+                self.bw_tuner = _adaptive.JointTuner()
+            else:
+                self.bw_tuner = _adaptive.BitwidthTuner()
         p = self.table.get(m.name)
         if p is None:
             p = _Pending(self.order_ctr)
@@ -2640,6 +2654,10 @@ class CoordController:
                 from ..ops import adaptive as _adaptive
 
                 _adaptive.set_autotuned_cap(tuned[2])
+                if len(tuned) > 3 and tuned[3]:
+                    # fourth field: the joint tuner's collective algorithm
+                    # — spmd "auto" steps and the executor follow it
+                    _adaptive.set_autotuned_algorithm(tuned[3])
         if rflags & wire.RESP_SHUTDOWN:
             if reason.startswith("stall shutdown"):
                 # abnormal abort: surface loudly (parity with the in-process

@@ -77,6 +77,9 @@ class Executor:
         # the compiled program moved per reduce+gather round
         self.last_wire_mode: str = ""
         self.last_wire_bytes: int = 0
+        # collective algorithm the most recent allreduce rode ("ring" =
+        # GSPMD psum or the flat ring; "tree"/"hier" = zoo members)
+        self.last_algorithm: str = "ring"
 
     def _build_two_level_mesh(self, state):
         from jax.sharding import Mesh
@@ -216,6 +219,60 @@ class Executor:
                                out_specs=P(("dcn", "ici")),
                                check_vma=False)
             fn = jax.jit(sm)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _algo_choice(self) -> str:
+        """Coordinator-plane collective algorithm selection: an explicit
+        ``HOROVOD_GSPMD_ALGO=ring|tree|hier`` wins; unset or ``auto``
+        follows the joint tuner's broadcast
+        (`ops/adaptive.set_autotuned_algorithm`, the fourth tuned
+        ``ResponseList`` field) and stays ``ring`` — the untouched dispatch
+        — until one arrives."""
+        from .. import spmd as _spmd
+        from ..ops import adaptive as _adaptive
+
+        v = _spmd.gspmd_algo()  # validates the env value
+        if os.environ.get("HOROVOD_GSPMD_ALGO", "").strip().lower() in (
+                "ring", "tree", "hier"):
+            return v
+        return _adaptive.autotuned_algorithm() or "ring"
+
+    def _allreduce_tree_fn(self, n: int, length: int, dtype: str,
+                           average: bool, prescale: float, postscale: float):
+        """Recursive-halving/doubling allreduce over the rank mesh
+        (`spmd.quantized_allreduce_tree` on the exact wire): O(log n)
+        latency rounds instead of the ring's n-1 — the zoo member the
+        tuner picks for small payloads."""
+        key = ("allreduce_tree", n, length, dtype, average, prescale,
+               postscale)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .. import spmd as _spmd
+            from ..basics import Sum
+
+            mesh = self._mesh
+            size = self._world
+
+            def body(row):  # [1, L]: this rank's contribution
+                x = row[0]
+                if prescale != 1.0:
+                    x = x * np.asarray(prescale, x.dtype)
+                out = _spmd.quantized_allreduce_tree(x, Sum, MESH_AXIS,
+                                                     wire="off")
+                if average:
+                    out = out / np.asarray(size, out.dtype)
+                if postscale != 1.0:
+                    out = out * np.asarray(postscale, out.dtype)
+                return out.astype(x.dtype)[None]
+
+            sm = _spmd._shard_map(body, mesh, in_specs=P(MESH_AXIS),
+                                  out_specs=P(MESH_AXIS))
+            fn = jax.jit(sm, out_shardings=NamedSharding(mesh,
+                                                         P(MESH_AXIS)))
             self._fn_cache[key] = fn
         return fn
 
@@ -699,7 +756,14 @@ class Executor:
                 bufs.append(self._jax.device_put(z, self._rank_devices[r]))
         wire = self._effective_wire(response, entries_by_rank, dtype,
                                     length, adasum)
-        hier = self._hier_allreduce and not adasum and not wire
+        algo = self._algo_choice()
+        hier = not adasum and not wire and (
+            self._hier_allreduce or (algo == "hier"
+                                     and self._mesh2 is not None))
+        tree = (algo == "tree" and not adasum and not wire and not hier
+                and world > 1 and (world & (world - 1)) == 0
+                and np.issubdtype(np.dtype(dtype), np.floating)
+                and np.dtype(dtype).itemsize <= 4)
         two_level = hier or (wire == "int8-dcn" and self._mesh2 is not None)
         g = self._global_array(bufs, length,
                                self._row_sharding2() if two_level else None)
@@ -714,13 +778,19 @@ class Executor:
             fn = self._allreduce_q_fn(world, length, dtype, response.average,
                                       e0.prescale_factor,
                                       e0.postscale_factor, wire)
+        elif tree:
+            fn = self._allreduce_tree_fn(world, length, dtype,
+                                         response.average,
+                                         e0.prescale_factor,
+                                         e0.postscale_factor)
         elif hier:
             fn = self._allreduce2_fn(world, length, dtype, response.average,
                                      e0.prescale_factor, e0.postscale_factor)
         else:
             fn = self._allreduce_fn(world, length, dtype, response.average,
                                     e0.prescale_factor, e0.postscale_factor)
-        self._record_wire(wire, length, dtype)
+        self._record_wire(wire, length, dtype,
+                          "tree" if tree else ("hier" if hier else "ring"))
         out = fn(g)
         rows = self._shard_by_rank(out)
         return {
@@ -728,8 +798,10 @@ class Executor:
             for r in ranks
         }
 
-    def _record_wire(self, wire: str, length: int, dtype: str) -> None:
+    def _record_wire(self, wire: str, length: int, dtype: str,
+                     algorithm: str = "ring") -> None:
         self.last_wire_mode = wire
+        self.last_algorithm = algorithm
         if wire == "bf16":
             # cast wire: scatter + gather, 2 bytes/element, no scales
             self.last_wire_bytes = 2 * length * 2
@@ -739,6 +811,8 @@ class Executor:
                 bits=4 if wire == "int4" else 8)["wire_bytes"]
         else:
             self.last_wire_bytes = 2 * length * np.dtype(dtype).itemsize
+        from .. import spmd as _spmd
+        _spmd._note_algorithm(algorithm, length)
 
     def _exec_allreduce_mp(self, response, entries_by_rank, adasum):
         """Coordinated multiprocess allreduce/adasum: shapes, dtype and scale
@@ -763,7 +837,14 @@ class Executor:
                                        self._rank_devices[r])
         wire = self._effective_wire(response, entries_by_rank, dtype,
                                     length, adasum)
-        hier = self._hier_allreduce and not adasum and not wire
+        algo = self._algo_choice()
+        hier = not adasum and not wire and (
+            self._hier_allreduce or (algo == "hier"
+                                     and self._mesh2 is not None))
+        tree = (algo == "tree" and not adasum and not wire and not hier
+                and world > 1 and (world & (world - 1)) == 0
+                and np.issubdtype(np.dtype(dtype), np.floating)
+                and np.dtype(dtype).itemsize <= 4)
         two_level = hier or (wire == "int8-dcn" and self._mesh2 is not None)
         g = self._global_array([buf], length,
                                self._row_sharding2() if two_level else None)
@@ -778,13 +859,19 @@ class Executor:
             fn = self._allreduce_q_fn(world, length, dtype, response.average,
                                       response.prescale, response.postscale,
                                       wire)
+        elif tree:
+            fn = self._allreduce_tree_fn(world, length, dtype,
+                                         response.average,
+                                         response.prescale,
+                                         response.postscale)
         elif hier:
             fn = self._allreduce2_fn(world, length, dtype, response.average,
                                      response.prescale, response.postscale)
         else:
             fn = self._allreduce_fn(world, length, dtype, response.average,
                                     response.prescale, response.postscale)
-        self._record_wire(wire, length, dtype)
+        self._record_wire(wire, length, dtype,
+                          "tree" if tree else ("hier" if hier else "ring"))
         out = fn(g)
         if entries is None:
             self._jax.block_until_ready(out)

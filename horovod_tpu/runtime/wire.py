@@ -450,15 +450,22 @@ def encode_response_list(flags: int, last_joined: int,
         w.str(s)
     # tuned flag byte: 0 = absent, 1 = (threshold, cycle_ms) — byte-
     # identical to the pre-bitwidth wire — 2 adds the autotuned bitwidth
-    # cap string (adaptive wire; decoders before flag 2 never see it
-    # because the coordinator only emits 2 when a cap exists)
+    # cap string (adaptive wire), 3 adds the joint tuner's collective
+    # algorithm string on top. Decoders before flag N never see the newer
+    # fields because the coordinator only emits N when the field exists,
+    # so each absent field keeps the frame byte-identical to its
+    # predecessor wire (pinned in test_coord.py).
     has_cap = tuned is not None and len(tuned) > 2 and tuned[2]
-    w.u8(0 if tuned is None else (2 if has_cap else 1))
+    has_algo = has_cap and len(tuned) > 3 and tuned[3]
+    w.u8(0 if tuned is None else
+         (3 if has_algo else (2 if has_cap else 1)))
     if tuned is not None:
         w.i64(int(tuned[0]))
         w.f64(float(tuned[1]))
         if has_cap:
             w.str(str(tuned[2]))
+        if has_algo:
+            w.str(str(tuned[3]))
     w.i32(epoch)
     w.u32(0 if members is None else len(members))
     for r in (members or ()):
@@ -517,6 +524,8 @@ def decode_response_list(buf: bytes):
         if tflag:
             tuned = (rd.i64(), rd.f64())
             if tflag >= 2:
+                tuned = tuned + (rd.str(),)
+            if tflag >= 3:
                 tuned = tuned + (rd.str(),)
     epoch = rd.i32() if rd.remaining() >= 4 else -1
     members: Optional[List[int]] = None

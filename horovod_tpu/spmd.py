@@ -325,6 +325,330 @@ def quantized_allreduce(x, op: int = Average, axis: str = MESH_AXIS,
     return flat.reshape(x.shape).astype(x.dtype)
 
 
+# ------------------------------------------------ algorithm zoo (autotune v3)
+# The flat bidirectional ring above is bandwidth-optimal but pays world-1
+# latency rounds; the MPI characterization study (PAPERS.md arXiv:1810.11112)
+# and the reference's hierarchical allreduce (operations.cc:440-454) both
+# show the winning algorithm is a function of payload size x world size x
+# topology. The zoo: "ring" (above), "tree" (recursive halving/doubling,
+# O(log w) rounds — latency-optimal for small payloads), "hier" (intra-host
+# reduce-scatter -> cross-host allreduce -> intra-host all-gather over a
+# (host, chip) factorization). Every member rides the same packed int8/int4
+# rows, the same EF-residual convention and the same _wire_eligible exact
+# fallbacks as the ring. See docs/autotune.md.
+
+_GSPMD_ALGOS = ("ring", "tree", "hier", "auto")
+
+#: payloads at or under this many f32 elements (256 KB) are latency-bound
+#: on the flat ring — the "auto" tree/ring crossover before any tuner
+#: measurement arrives
+_TREE_AUTO_MAX = 1 << 16
+
+
+def gspmd_algo(value: Optional[str] = None) -> str:
+    """Resolve the compiled-path collective algorithm (``HOROVOD_GSPMD_ALGO``).
+
+    Returns ``"ring"`` (the default — byte-identical to the pre-zoo
+    program), ``"tree"``, ``"hier"`` or ``"auto"``. ``value`` overrides the
+    env var (the ``make_train_step(algorithm=...)`` argument)."""
+    v = os.environ.get("HOROVOD_GSPMD_ALGO", "") if value is None else value
+    v = (v or "").strip().lower()
+    if v in ("", "0", "off", "none"):
+        return "ring"
+    if v not in _GSPMD_ALGOS:
+        raise ValueError(
+            f"HOROVOD_GSPMD_ALGO must be ring|tree|hier|auto, got {v!r}")
+    return v
+
+
+def mesh_hosts(world: int) -> int:
+    """``(host, chip)`` factorization for the hierarchical allreduce.
+
+    ``HOROVOD_MESH_HOSTS`` pins the host count (it must divide the world
+    size — the launcher's host-major rank numbering is assumed, rank =
+    host * chips + chip, matching the executor's ("dcn","ici") mesh).
+    Unset auto-factorizes: the largest divisor of ``world`` at most
+    sqrt(world), so 8 -> 2x4, 16 -> 4x4; 1 (no factorization, ring
+    fallback) when ``world`` is prime."""
+    v = os.environ.get("HOROVOD_MESH_HOSTS", "").strip()
+    if v:
+        hosts = int(v)
+        if hosts < 1 or world % hosts:
+            raise ValueError(
+                f"HOROVOD_MESH_HOSTS={hosts} does not divide the world "
+                f"size {world} (host-major rank numbering needs "
+                f"world = hosts * chips)")
+        return hosts
+    hosts, d = 1, 2
+    while d * d <= world:
+        if world % d == 0:
+            hosts = d
+        d += 1
+    return hosts
+
+
+def resolve_algorithm(total: int, world: int,
+                      algorithm: Optional[str] = None) -> str:
+    """Effective zoo member for one payload of ``total`` f32 elements.
+
+    Explicit choices pass through; ``"auto"`` follows the coordinator's
+    tuned broadcast when one has arrived
+    (`ops/adaptive.set_autotuned_algorithm`, shipped as the fourth tuned
+    ``ResponseList`` field) and otherwise the static heuristic: small
+    payloads ride the tree when the world is a power of two, multi-host
+    factorizations ride the hierarchical schedule, everything else the
+    ring."""
+    a = gspmd_algo(algorithm)
+    if a != "auto":
+        return a
+    from .ops.adaptive import autotuned_algorithm
+
+    tuned = autotuned_algorithm()
+    if tuned:
+        return tuned
+    if total <= _TREE_AUTO_MAX and world & (world - 1) == 0 and world > 1:
+        return "tree"
+    if mesh_hosts(world) > 1:
+        return "hier"
+    return "ring"
+
+
+def _ring_reduce_scatter(flat, axis: str, wire: str, block: int,
+                         size: int, pos, perm):
+    """Ring reduce-scatter over a sub-ring of ``size`` members embedded in
+    ``axis``: ``pos`` is this rank's (traced) position on its ring and
+    ``perm`` the global ppermute rotating every sub-ring one step forward
+    in parallel. ``flat`` is the 1-D f32 local contribution, already
+    padded to ``size * chunk``; returns the summed chunk position ``pos``
+    owns — the same schedule as :func:`quantized_reduce_scatter`, just
+    with ring geometry supplied by the caller."""
+    chunk = flat.shape[0] // size
+    if size == 1:
+        return flat
+
+    def local_chunk(k):
+        idx = jnp.mod(pos - k - 1, size)
+        return jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+    acc = local_chunk(0)
+    if wire not in _GSPMD_WIRES:
+        for k in range(1, size):
+            acc = jax.lax.ppermute(acc, axis, perm) + local_chunk(k)
+        return acc
+    pack, unpack = _pack_fns(wire)
+    for k in range(1, size):
+        wired = jax.lax.ppermute(pack(acc.reshape(-1, block)), axis, perm)
+        q, scales = unpack(wired)
+        acc = (q.astype(jnp.float32) * scales).reshape(-1) + local_chunk(k)
+    return acc
+
+
+def _ring_all_gather(chunk, axis: str, wire: str, block: int,
+                     size: int, pos, perm):
+    """Ring all-gather over a sub-ring (geometry as in
+    :func:`_ring_reduce_scatter`). The owner packs its chunk once and the
+    packed rows (raw f32 on an exact wire) make ``size - 1`` hops
+    unchanged, so every ring member reconstructs each chunk from identical
+    bytes — the bit-identity property of :func:`quantized_all_gather`."""
+    num = chunk.shape[0]
+    if size == 1:
+        return chunk
+    out = jnp.zeros((size * num,), jnp.float32)
+    if wire not in _GSPMD_WIRES:
+        cur = chunk
+        for k in range(size):
+            idx = jnp.mod(pos - k, size)
+            out = jax.lax.dynamic_update_slice_in_dim(out, cur, idx * num, 0)
+            if k + 1 < size:
+                cur = jax.lax.ppermute(cur, axis, perm)
+        return out
+    pack, unpack = _pack_fns(wire)
+    pad = (-num) % block
+    padded = jnp.pad(chunk, (0, pad)) if pad else chunk
+    cur = pack(padded.reshape(-1, block))
+    for k in range(size):
+        q, scales = unpack(cur)
+        val = (q.astype(jnp.float32) * scales).reshape(-1)[:num]
+        idx = jnp.mod(pos - k, size)
+        out = jax.lax.dynamic_update_slice_in_dim(out, val, idx * num, 0)
+        if k + 1 < size:
+            cur = jax.lax.ppermute(cur, axis, perm)
+    return out
+
+
+def quantized_allreduce_tree(x, op: int = Average, axis: str = MESH_AXIS,
+                             wire: Optional[str] = None,
+                             block: Optional[int] = None):
+    """Recursive-halving/doubling allreduce — O(log w) rounds, the
+    latency-optimal zoo member for small payloads; call inside shard_map.
+
+    Reduce phase: log2(w) recursive-halving exchanges at distances w/2,
+    w/4, ..., 1. Each round partners ``p`` and ``p ^ d`` split the active
+    window ("bit set keeps the upper half"), ship the half the partner
+    keeps — packed int8/int4 rows on a quantized wire, raw f32 otherwise —
+    and add; after the last round rank ``p`` owns the fully summed chunk
+    ``p``, the same ownership convention as the ring. Gather phase: log2(w)
+    recursive-doubling exchanges of *packed bytes*: each chunk is
+    quantized once by its owner and forwarded verbatim, so every rank
+    decodes identical bytes and the result is bit-identical everywhere
+    (the :func:`quantized_all_gather` property).
+
+    Falls back to the ring (:func:`quantized_allreduce`) on
+    non-power-of-two worlds — the halving recursion needs 2^k members —
+    and to the exact :func:`allreduce` for payloads the wire cannot carry
+    (:func:`_wire_eligible`) or non-float dtypes.
+    """
+    wire = gspmd_wire(wire)
+    if op == Adasum:
+        raise NotImplementedError(
+            "the GSPMD tree allreduce does not support Adasum; use "
+            "spmd.adasum (exact) instead")
+    block = _wire_block(block)
+    m = jax.lax.psum(1, axis)
+    if m & (m - 1) or m == 1:
+        return quantized_allreduce(x, op, axis, wire, block)
+    if wire in _GSPMD_WIRES and not _wire_eligible(x.size, x.dtype, wire,
+                                                   block):
+        return allreduce(x, op, axis)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return allreduce(x, op, axis)
+    num = x.size
+    quant = wire in _GSPMD_WIRES
+    chunk = _ring_chunk(num, m, block) if quant else -(-num // m)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = m * chunk - num
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    p = jax.lax.axis_index(axis)
+    rounds = int(m).bit_length() - 1
+    if quant:
+        pack, unpack = _pack_fns(wire)
+    # recursive halving: every window half is a whole number of chunks,
+    # hence (quantized) a whole number of blocks — no ragged rows
+    win = flat
+    for k in range(rounds):
+        d = m >> (k + 1)
+        half = win.shape[0] // 2
+        bit = jnp.equal((p // d) % 2, 1)
+        lower, upper = win[:half], win[half:]
+        keep = jnp.where(bit, upper, lower)
+        send = jnp.where(bit, lower, upper)
+        perm = [(j, j ^ d) for j in range(m)]
+        if quant:
+            wired = jax.lax.ppermute(pack(send.reshape(-1, block)), axis,
+                                     perm)
+            q, scales = unpack(wired)
+            recv = (q.astype(jnp.float32) * scales).reshape(-1)
+        else:
+            recv = jax.lax.ppermute(send, axis, perm)
+        win = keep + recv
+    # recursive doubling: forward the owner-packed rows verbatim so every
+    # rank decodes the same bytes (bit-identity)
+    if quant:
+        rows = chunk // block
+        packed = pack(win.reshape(-1, block))
+        buf = jnp.zeros((m * rows, packed.shape[1]), packed.dtype)
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, packed, p * rows, 0)
+        for k in range(rounds):
+            d = 1 << k
+            lo = (p // d) * d
+            seg = jax.lax.dynamic_slice_in_dim(buf, lo * rows, d * rows)
+            perm = [(j, j ^ d) for j in range(m)]
+            recv = jax.lax.ppermute(seg, axis, perm)
+            buf = jax.lax.dynamic_update_slice_in_dim(buf, recv,
+                                                      (lo ^ d) * rows, 0)
+        q, scales = unpack(buf)
+        out = (q.astype(jnp.float32) * scales).reshape(-1)[:num]
+    else:
+        buf = jnp.zeros((m * chunk,), jnp.float32)
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, win, p * chunk, 0)
+        for k in range(rounds):
+            d = 1 << k
+            lo = (p // d) * d
+            seg = jax.lax.dynamic_slice_in_dim(buf, lo * chunk, d * chunk)
+            perm = [(j, j ^ d) for j in range(m)]
+            recv = jax.lax.ppermute(seg, axis, perm)
+            buf = jax.lax.dynamic_update_slice_in_dim(buf, recv,
+                                                      (lo ^ d) * chunk, 0)
+        out = buf[:num]
+    if op == Average:
+        out = out / m
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def quantized_allreduce_hier(x, op: int = Average, axis: str = MESH_AXIS,
+                             wire: Optional[str] = None,
+                             block: Optional[int] = None,
+                             hosts: Optional[int] = None):
+    """2-level hierarchical allreduce over a ``(host, chip)`` factorization
+    of the replica axis; call inside shard_map.
+
+    The reference's NCCLHierarchicalAllreduce decomposition
+    (`operations.cc:440-454`) on the packed wire: intra-host ring
+    reduce-scatter (chips on one host talk over ICI), cross-host allreduce
+    of each owned chunk — every chip is the representative for the chunk
+    it owns, riding a host-ring reduce-scatter + all-gather that only
+    crosses hosts — then intra-host ring all-gather. Both gather phases
+    forward owner-packed bytes verbatim and the phase-2 result is
+    bit-identical across hosts, so the final result is bit-identical on
+    every rank. Cross-host traffic shrinks from the flat ring's
+    ``2(w-1)`` chunk exchanges per boundary edge to the phase-2 rows alone
+    (`ops/compression.gspmd_cross_host_footprint`).
+
+    ``hosts`` defaults to :func:`mesh_hosts` (``HOROVOD_MESH_HOSTS`` or the
+    auto factorization); rank numbering is host-major (rank = host * chips
+    + chip), matching the executor's ("dcn","ici") mesh. Falls back to the
+    flat ring when the factorization is degenerate (hosts <= 1, hosts ==
+    world, or world % hosts != 0) and to the exact :func:`allreduce` for
+    payloads the wire cannot carry.
+    """
+    wire = gspmd_wire(wire)
+    if op == Adasum:
+        raise NotImplementedError(
+            "the GSPMD hierarchical allreduce does not support Adasum; "
+            "use spmd.adasum (exact) instead")
+    block = _wire_block(block)
+    m = jax.lax.psum(1, axis)
+    h = mesh_hosts(m) if hosts is None else int(hosts)
+    if h <= 1 or h >= m or m % h:
+        return quantized_allreduce(x, op, axis, wire, block)
+    if wire in _GSPMD_WIRES and not _wire_eligible(x.size, x.dtype, wire,
+                                                   block):
+        return allreduce(x, op, axis)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return allreduce(x, op, axis)
+    num = x.size
+    c = m // h  # chips per host
+    quant = wire in _GSPMD_WIRES
+    chunk = _ring_chunk(num, c, block) if quant else -(-num // c)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = c * chunk - num
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    p = jax.lax.axis_index(axis)
+    hp, l = p // c, p % c  # (host, chip) of this rank, host-major
+    intra = [(j, (j // c) * c + ((j % c) + 1) % c) for j in range(m)]
+    inter = [(j, (((j // c) + 1) % h) * c + (j % c)) for j in range(m)]
+    # phase 1: intra-host reduce-scatter — chip l ends with chunk l of the
+    # host-local sum
+    chunk_l = _ring_reduce_scatter(flat, axis, wire, block, c, l, intra)
+    # phase 2: cross-host allreduce of chunk l among the h chips sharing
+    # local index l (RS + AG over the host ring — the only phase whose
+    # bytes cross a host boundary)
+    sub = _ring_chunk(chunk, h, block) if quant else -(-chunk // h)
+    pad2 = h * sub - chunk
+    if pad2:
+        chunk_l = jnp.pad(chunk_l, (0, pad2))
+    owned = _ring_reduce_scatter(chunk_l, axis, wire, block, h, hp, inter)
+    chunk_g = _ring_all_gather(owned, axis, wire, block, h, hp,
+                               inter)[:chunk]
+    # phase 3: intra-host all-gather of the globally reduced chunks
+    out = _ring_all_gather(chunk_g, axis, wire, block, c, l, intra)[:num]
+    if op == Average:
+        out = out / m
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 def _wire_roundtrip(flat, wire: str, block: int):
     """The value one quantized hop delivers for a local contribution — the
     EF-SGD numerator, same absmax/qmax block math as
@@ -477,7 +801,8 @@ def replicate(tree, mesh: Optional[Mesh] = None):
 def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
                     donate: bool = True, zero1: bool = False,
                     example_opt_state=None,
-                    compression: Optional[str] = None) -> Callable:
+                    compression: Optional[str] = None,
+                    algorithm: Optional[str] = None) -> Callable:
     """Build the jitted data-parallel train step (the bench hot loop).
 
     ``loss_fn(params, batch) -> scalar loss`` computed on the *local* shard;
@@ -500,12 +825,22 @@ def make_train_step(loss_fn: Callable, tx, mesh: Optional[Mesh] = None,
     build the state with :func:`quantized_opt_state`, and see docs/gspmd.md.
     With the wire off, this function compiles the exact same program as
     before the knob existed (the cache-key pin tested in tests/test_gspmd.py).
+
+    ``algorithm`` selects the collective schedule for the quantized wire
+    (``"ring"``/``"tree"``/``"hier"``/``"auto"``; ``None`` resolves
+    ``HOROVOD_GSPMD_ALGO``). Unset/``"ring"`` compiles the byte-identical
+    pre-zoo ring program (pinned in tests); ``"auto"`` resolves per
+    payload size and topology at trace time (:func:`resolve_algorithm`).
+    With the wire off the partitioner inserts the psum itself and the
+    algorithm knob is inert; ``zero1=True`` keeps the ring — its chunk
+    layout IS the optimizer-state sharding.
     """
     import optax
 
     wire = gspmd_wire(compression)
     if wire:
-        return _make_quantized_step(loss_fn, tx, mesh, donate, zero1, wire)
+        return _make_quantized_step(loss_fn, tx, mesh, donate, zero1, wire,
+                                    algorithm=algorithm)
 
     mesh = mesh or basics.mesh()
     repl = NamedSharding(mesh, P())
@@ -589,18 +924,48 @@ def quantized_opt_state(tx, params, mesh: Optional[Mesh] = None,
 _gspmd_bytes = {"wire": 0.0, "exact": 0.0}
 
 
-def _record_gspmd_wire(total: int, wire: str, world: int, block: int):
-    """Truthful byte accounting for one quantized-ring round (eagerly, per
-    step call — counters cannot tick inside the compiled program). Bytes
-    come from the same catalog the three-way bench reads
-    (`ops/compression.gspmd_wire_footprint`)."""
+#: last algorithm recorded per payload-size class — K_ALGO events fire on
+#: change only, so hvddoctor's algorithm_thrash signature counts real flips
+_algo_last: dict = {}
+
+
+def _note_algorithm(algorithm: str, total: int) -> None:
+    """Gauge + flight-recorder trail for the compiled plane's algorithm
+    choice: ``hvd_collective_algorithm{class}`` tracks the member in play
+    per payload-size class, and a blackbox ``K_ALGO`` event records each
+    change (`blackbox/signatures.detect_algorithm_thrash`)."""
+    from . import blackbox as _blackbox
+    from .metrics import instruments
+    from .ops.adaptive import ALGO_CODES, size_class
+
+    cls = size_class(total * 4)
+    instruments.collective_algorithm().labels(**{"class": cls}).set(
+        ALGO_CODES.get(algorithm, 0))
+    prev = _algo_last.get(cls)
+    if prev != algorithm:
+        _algo_last[cls] = algorithm
+        if prev is not None:
+            _blackbox.record(_blackbox.K_ALGO, cls, f"{prev}->{algorithm}")
+
+
+def _record_gspmd_wire(total: int, wire: str, world: int, block: int,
+                       algorithm: str = "ring"):
+    """Truthful byte accounting for one quantized collective round (eagerly,
+    per step call — counters cannot tick inside the compiled program).
+    Bytes come from the same catalog the three-way bench reads
+    (`ops/compression.gspmd_wire_footprint`), per the algorithm actually
+    traced."""
     from .metrics import instruments
     from .ops import compression as comp
 
-    wire_b = comp.gspmd_wire_footprint(total, wire, world, block)
-    exact_b = comp.gspmd_wire_footprint(total, "none", world, block)
+    hosts = mesh_hosts(world) if algorithm == "hier" else None
+    wire_b = comp.gspmd_wire_footprint(total, wire, world, block,
+                                       algorithm=algorithm, hosts=hosts)
+    exact_b = comp.gspmd_wire_footprint(total, "none", world, block,
+                                        algorithm=algorithm, hosts=hosts)
     instruments.wire_bytes().labels(compression=f"gspmd-{wire}").inc(wire_b)
     instruments.wire_bytes_exact().inc(exact_b)
+    _note_algorithm(algorithm, total)
     _gspmd_bytes["wire"] += wire_b
     _gspmd_bytes["exact"] += exact_b
     if _gspmd_bytes["exact"]:
@@ -610,7 +975,8 @@ def _record_gspmd_wire(total: int, wire: str, world: int, block: int):
 
 def _make_quantized_step(loss_fn: Callable, tx, mesh: Optional[Mesh],
                          donate: bool, zero1: bool, wire: str,
-                         block: Optional[int] = None) -> Callable:
+                         block: Optional[int] = None,
+                         algorithm: Optional[str] = None) -> Callable:
     """The explicit-collective variant of make_train_step: gradients ride
     the quantized ppermute ring instead of GSPMD's inserted psum.
 
@@ -621,12 +987,20 @@ def _make_quantized_step(loss_fn: Callable, tx, mesh: Optional[Mesh],
     elementwise optimizer math runs on this rank's 1/N chunk only, then
     all-gathers the param delta over the same quantized ring — the ZeRO-1
     schedule with every collective on the packed wire.
+
+    ``algorithm`` swaps the allreduce schedule for a zoo member
+    (docs/autotune.md); ``"ring"``/unset traces the identical pre-zoo
+    program, and ``zero1=True`` always keeps the ring (its chunk layout is
+    the optimizer-state sharding). The EF residual convention is
+    algorithm-independent: every member delivers the same one-hop
+    quantization of the corrected gradient (``_wire_roundtrip``).
     """
     import optax
 
     mesh = mesh or basics.mesh()
     n = mesh.shape[MESH_AXIS]
     block = _wire_block(block)
+    algo = gspmd_algo(algorithm)
 
     def _flatten_f32(leaves):
         parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
@@ -670,8 +1044,16 @@ def _make_quantized_step(loss_fn: Callable, tx, mesh: Optional[Mesh],
                 treedef, _split_like(upd_flat, g_leaves))
             params = optax.apply_updates(params, updates)
         else:
-            reduced = quantized_allreduce(
-                corrected, Average, MESH_AXIS, wire, block)
+            a = resolve_algorithm(total, n, algo)
+            if a == "tree":
+                reduced = quantized_allreduce_tree(
+                    corrected, Average, MESH_AXIS, wire, block)
+            elif a == "hier":
+                reduced = quantized_allreduce_hier(
+                    corrected, Average, MESH_AXIS, wire, block)
+            else:
+                reduced = quantized_allreduce(
+                    corrected, Average, MESH_AXIS, wire, block)
             grads = jax.tree_util.tree_unflatten(
                 treedef, _split_like(reduced, g_leaves))
             updates, inner = tx.update(grads, inner, params)
@@ -697,11 +1079,18 @@ def _make_quantized_step(loss_fn: Callable, tx, mesh: Optional[Mesh],
 
     jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
+    # "auto" resolves at trace time (the first call); pin the same answer
+    # for accounting so a later tuned broadcast can't make the byte
+    # counters disagree with the program actually compiled
+    resolved: dict = {}
+
     @functools.wraps(jitted)
     def instrumented(params, opt_state, batch):
         total = int(opt_state[1].shape[1])  # read before donation
         out = jitted(params, opt_state, batch)
-        _record_gspmd_wire(total, wire, n, block)
+        a = resolved.setdefault(
+            total, "ring" if zero1 else resolve_algorithm(total, n, algo))
+        _record_gspmd_wire(total, wire, n, block, a)
         return out
 
     instrumented.jitted = jitted  # .lower()/.compile() escape hatch

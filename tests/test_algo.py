@@ -1,0 +1,388 @@
+"""Collective algorithm zoo tests (docs/gspmd.md, docs/autotune.md): the
+ring / recursive-halving-doubling tree / two-level hierarchical schedules
+inside the compiled fast path — parity against exact ``psum``, cross-rank
+bit-identity, odd-world fallbacks, the footprint catalog's algorithm axis,
+and the joint ``(algorithm, bitwidth)`` tuner.
+
+Runs on the 8-device virtual CPU platform like the rest of the suite.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import spmd
+from horovod_tpu.basics import MESH_AXIS, Adasum, Average
+from horovod_tpu.ops import adaptive, compression as comp
+
+BLOCK = 256  # pin the block so HOROVOD_INT8_BLOCK in the env can't skew
+
+ZOO = {"ring": spmd.quantized_allreduce,
+       "tree": spmd.quantized_allreduce_tree,
+       "hier": spmd.quantized_allreduce_hier}
+
+
+def _mesh(n=8):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), (MESH_AXIS,))
+
+
+def _run(fn, data, mesh, wire, **kw):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def body(row):
+        return fn(row[0], Average, MESH_AXIS, wire, **kw)[None]
+
+    sm = spmd._shard_map(body, mesh, in_specs=P(MESH_AXIS),
+                         out_specs=P(MESH_AXIS))
+    return np.asarray(jax.jit(sm)(data))
+
+
+# ------------------------------------------------------------ knob parsing
+def test_gspmd_algo_env_parsing(monkeypatch):
+    monkeypatch.delenv("HOROVOD_GSPMD_ALGO", raising=False)
+    assert spmd.gspmd_algo() == "ring"
+    for off in ("", "0", "off", "none", "OFF"):
+        monkeypatch.setenv("HOROVOD_GSPMD_ALGO", off)
+        assert spmd.gspmd_algo() == "ring"
+    for v in ("ring", "tree", "hier", "auto", "TREE"):
+        monkeypatch.setenv("HOROVOD_GSPMD_ALGO", v)
+        assert spmd.gspmd_algo() == v.lower()
+    assert spmd.gspmd_algo("hier") == "hier"
+    monkeypatch.setenv("HOROVOD_GSPMD_ALGO", "butterfly")
+    with pytest.raises(ValueError, match="ring|tree|hier|auto"):
+        spmd.gspmd_algo()
+    with pytest.raises(ValueError):
+        spmd.gspmd_algo("nccl")
+
+
+def test_mesh_hosts(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MESH_HOSTS", raising=False)
+    # auto: largest divisor <= sqrt(world)
+    assert spmd.mesh_hosts(8) == 2
+    assert spmd.mesh_hosts(16) == 4
+    assert spmd.mesh_hosts(12) == 3
+    assert spmd.mesh_hosts(7) == 1   # prime: no factorization
+    assert spmd.mesh_hosts(1) == 1
+    monkeypatch.setenv("HOROVOD_MESH_HOSTS", "4")
+    assert spmd.mesh_hosts(8) == 4
+    monkeypatch.setenv("HOROVOD_MESH_HOSTS", "3")
+    with pytest.raises(ValueError, match="divide"):
+        spmd.mesh_hosts(8)
+
+
+def test_resolve_algorithm(monkeypatch):
+    monkeypatch.delenv("HOROVOD_GSPMD_ALGO", raising=False)
+    adaptive.reset()
+    # explicit choices pass through untouched
+    for a in ("ring", "tree", "hier"):
+        assert spmd.resolve_algorithm(10**9, 7, a) == a
+    # auto heuristic: small + power-of-two world -> tree
+    assert spmd.resolve_algorithm(1024, 8, "auto") == "tree"
+    assert spmd.resolve_algorithm(spmd._TREE_AUTO_MAX, 8, "auto") == "tree"
+    # large payload on a factorizable world -> hier
+    assert spmd.resolve_algorithm(1 << 22, 8, "auto") == "hier"
+    # large + prime world -> ring
+    assert spmd.resolve_algorithm(1 << 22, 7, "auto") == "ring"
+    # small + non-power-of-two world: no tree
+    assert spmd.resolve_algorithm(1024, 6, "auto") in ("hier", "ring")
+    # a tuned broadcast beats the static heuristic
+    adaptive.set_autotuned_algorithm("hier")
+    assert spmd.resolve_algorithm(1024, 8, "auto") == "hier"
+    adaptive.reset()
+    assert adaptive.autotuned_algorithm() == ""
+    assert spmd.resolve_algorithm(1024, 8, "auto") == "tree"
+
+
+# ------------------------------------------------------- numeric parity
+@pytest.mark.parametrize("algo", sorted(ZOO))
+def test_zoo_exact_wire_matches_psum(algo):
+    mesh = _mesh(8)
+    rng = np.random.RandomState(7)
+    data = rng.randn(8, 1000).astype(np.float32)
+    want = data.mean(axis=0)
+    out = _run(ZOO[algo], data, mesh, "off", block=BLOCK)
+    for p in range(8):
+        np.testing.assert_allclose(out[p], want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+@pytest.mark.parametrize("algo", sorted(ZOO))
+def test_zoo_quantized_error_bounded_and_bit_identical(algo, wire):
+    if wire == "int4" and not adaptive.ConvergenceGate.shared().allows(
+            "int4"):
+        pytest.skip("int4 refused by the convergence gate on this host")
+    mesh = _mesh(8)
+    rng = np.random.RandomState(11)
+    data = rng.randn(8, 2000).astype(np.float32)
+    want = data.mean(axis=0)
+    out = _run(ZOO[algo], data, mesh, wire, block=BLOCK)
+    # quantization-bounded: blockwise absmax grids bound the per-hop error
+    tol = 0.05 if wire == "int8" else 0.6
+    assert np.abs(out[0] - want).max() < tol
+    # every rank must hold bit-identical results (params stay in lockstep)
+    for p in range(1, 8):
+        assert (out[p] == out[0]).all()
+
+
+def test_tree_odd_world_falls_back_to_ring():
+    mesh7 = _mesh(7)
+    rng = np.random.RandomState(3)
+    data = rng.randn(7, 777).astype(np.float32)
+    tree = _run(ZOO["tree"], data, mesh7, "int8", block=BLOCK)
+    ring = _run(ZOO["ring"], data, mesh7, "int8", block=BLOCK)
+    # non-power-of-two world: the tree IS the ring (same trace), bit-equal
+    assert (tree == ring).all()
+
+
+def test_hier_prime_world_falls_back_to_ring(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MESH_HOSTS", raising=False)
+    mesh7 = _mesh(7)
+    rng = np.random.RandomState(5)
+    data = rng.randn(7, 512).astype(np.float32)
+    hier = _run(ZOO["hier"], data, mesh7, "int8", block=BLOCK)
+    ring = _run(ZOO["ring"], data, mesh7, "int8", block=BLOCK)
+    assert (hier == ring).all()
+
+
+def test_tree_adasum_not_implemented():
+    mesh = _mesh(8)
+    data = np.zeros((8, 64), np.float32)
+    with pytest.raises(NotImplementedError):
+        _run(lambda x, op, ax, w, **kw: spmd.quantized_allreduce_tree(
+            x, Adasum, ax, w, **kw), data, mesh, "off")
+
+
+def test_hier_explicit_hosts_matches_auto(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MESH_HOSTS", raising=False)
+    mesh = _mesh(8)
+    rng = np.random.RandomState(9)
+    data = rng.randn(8, 900).astype(np.float32)
+    auto = _run(ZOO["hier"], data, mesh, "int8", block=BLOCK)
+    exp2 = _run(ZOO["hier"], data, mesh, "int8", block=BLOCK, hosts=2)
+    assert (auto == exp2).all()  # mesh_hosts(8) == 2
+    # a different valid factorization still averages correctly
+    exp4 = _run(ZOO["hier"], data, mesh, "off", block=BLOCK, hosts=4)
+    np.testing.assert_allclose(exp4[0], data.mean(axis=0), rtol=1e-6,
+                               atol=1e-6)
+
+
+# --------------------------------------------------- footprint catalog
+def test_footprint_algorithm_axis():
+    n, w, b = 4096, 8, 256
+    ring = comp.gspmd_wire_footprint(n, "int8", w, b)
+    assert ring == comp.gspmd_wire_footprint(n, "int8", w, b,
+                                             algorithm="ring")
+    # tree: 2*log2(w) exchanges of payload halves
+    seg = lambda e: -(-e // b) * (b + 4)
+    assert comp.gspmd_wire_footprint(n, "int8", w, b, algorithm="tree") \
+        == 2 * 3 * seg(n // 2)
+    # hier: intra reduce-scatter/all-gather + cross-host phase rows
+    chips, hosts = 4, 2
+    chunk = -(-n // chips)
+    assert comp.gspmd_wire_footprint(n, "int8", w, b, algorithm="hier",
+                                     hosts=hosts) \
+        == 2 * (chips - 1) * seg(chunk) + 2 * (hosts - 1) * seg(
+            -(-chunk // hosts))
+    # degenerate shapes fall back to the ring row, matching the trace
+    assert comp.gspmd_wire_footprint(n, "int8", 6, b,
+                                     algorithm="tree") \
+        == comp.gspmd_wire_footprint(n, "int8", 6, b)
+    assert comp.gspmd_wire_footprint(n, "int8", w, b, algorithm="hier",
+                                     hosts=1) == ring
+    assert comp.gspmd_wire_footprint(n, "int8", 1, b,
+                                     algorithm="tree") == 0
+
+
+@pytest.mark.parametrize("mode", ["none", "int8", "int4"])
+def test_hier_moves_fewer_cross_host_bytes(mode):
+    # c(h-1)/(w-1) < 1 for every valid factorization: the hierarchical
+    # schedule always crosses host boundaries with fewer bytes
+    for w, h in ((8, 2), (8, 4), (16, 4), (12, 3)):
+        ring = comp.gspmd_cross_host_footprint(1 << 16, mode, w, h, BLOCK,
+                                               "ring")
+        hier = comp.gspmd_cross_host_footprint(1 << 16, mode, w, h, BLOCK,
+                                               "hier")
+        assert 0 < hier < ring, (w, h, mode)
+
+
+# ------------------------------------------------------------ joint tuner
+def test_size_class_boundaries():
+    assert adaptive.size_class(1) == "small"
+    assert adaptive.size_class(1 << 16) == "small"
+    assert adaptive.size_class((1 << 16) + 1) == "medium"
+    assert adaptive.size_class(1 << 22) == "medium"
+    assert adaptive.size_class((1 << 22) + 1) == "large"
+
+
+def test_joint_tuner_walk_and_argmin():
+    adaptive.reset()
+    t = adaptive.JointTuner(episode_rounds=2)
+    # exploration starts schedule- and byte-identical to the old wire
+    assert t._combos[0] == ("ring", "bf16")
+    assert t.active() and t.choice("small") == ("ring", "bf16")
+    times = {c: 1.0 for c in t._combos}
+    times[("tree", "int8")] = 0.25  # the winner for the small class
+    for _ in range(2 * len(t._combos)):
+        t.observe(1024, times[t.choice("small")])
+    assert t._cls["small"].settled == ("tree", "int8")
+    assert t.choice("small") == ("tree", "int8")
+    # cap()/algorithm() track the most recently observed class
+    assert (t.algorithm(), t.cap()) == ("tree", "int8")
+    # other classes are untouched and still walking
+    assert t._cls["large"].settled is None and t.active()
+
+
+def test_joint_tuner_classes_settle_independently():
+    adaptive.reset()
+    t = adaptive.JointTuner(episode_rounds=1)
+    for _ in range(len(t._combos)):
+        t.observe(512, 1.0 if t.choice("small")[0] != "tree" else 0.1)
+    for _ in range(len(t._combos)):
+        t.observe(1 << 23, 1.0 if t.choice("large")[0] != "hier" else 0.1)
+    assert t._cls["small"].settled[0] == "tree"
+    assert t._cls["large"].settled[0] == "hier"
+    assert t._cls["medium"].settled is None
+
+
+def test_joint_tuner_respects_int4_gate(monkeypatch):
+    # Other tests may have left an instance-level `allows` shadow on the
+    # shared singleton; reset it so the class-level patch takes effect.
+    monkeypatch.setattr(adaptive.ConvergenceGate, "_shared", None)
+    monkeypatch.setattr(adaptive.ConvergenceGate, "allows",
+                        lambda self, m: m != "int4")
+    t = adaptive.JointTuner()
+    assert all(cap != "int4" for _, cap in t._combos)
+    assert {a for a, _ in t._combos} == set(adaptive.ALGORITHMS)
+
+
+def test_joint_tuner_ignores_unscored_rounds():
+    adaptive.reset()
+    t = adaptive.JointTuner(episode_rounds=1)
+    t.observe(0, 1.0)
+    t.observe(1024, 0.0)
+    assert t._cls["small"].rounds == 0 and t._cls["small"].idx == 0
+
+
+def test_autotuned_algorithm_broadcast():
+    adaptive.reset()
+    assert adaptive.autotuned_algorithm() == ""
+    adaptive.set_autotuned_algorithm("tree")
+    assert adaptive.autotuned_algorithm() == "tree"
+    adaptive.set_autotuned_algorithm("warp")  # unknown: ignored
+    assert adaptive.autotuned_algorithm() == "tree"
+    adaptive.reset()
+    assert adaptive.autotuned_algorithm() == ""
+
+
+# ----------------------------------------------------- blackbox / doctor
+def test_algorithm_thrash_signature():
+    from horovod_tpu.blackbox import K_ALGO
+    from horovod_tpu.blackbox.signatures import (
+        ALGO_THRASH_FLIPS, detect_algorithm_thrash)
+
+    def ev(detail):
+        return {"kind": K_ALGO, "name": "small", "detail": detail,
+                "rank": 0, "t": 0.0}
+
+    flips = ["ring->tree", "tree->ring"] * ALGO_THRASH_FLIPS
+    bundle = {0: {"events": [ev(d) for d in flips]}}
+    sigs = detect_algorithm_thrash(bundle)
+    assert len(sigs) == 1
+    assert sigs[0]["id"] == "algorithm_thrash"
+    assert "small" in sigs[0]["summary"]
+    assert sigs[0]["evidence"]["flips"] >= ALGO_THRASH_FLIPS
+
+    # tuner settles and single decisions are healthy, as is every rank
+    # reporting the same change
+    calm = {0: {"events": [ev("ring->tree")] +
+                [ev("settled tree/int8")] * 10},
+            1: {"events": [ev("ring->tree")]}}
+    assert detect_algorithm_thrash(calm) == []
+
+
+def test_gauge_and_event_on_algorithm_change(monkeypatch, tmp_path):
+    from horovod_tpu import blackbox
+    from horovod_tpu.metrics import instruments
+
+    monkeypatch.setenv("HOROVOD_BLACKBOX", "1")
+    monkeypatch.setenv("HOROVOD_BLACKBOX_DIR", str(tmp_path))
+    spmd._algo_last.clear()
+    try:
+        rec = blackbox.maybe_activate()
+        spmd._note_algorithm("ring", 1024)
+        spmd._note_algorithm("tree", 1024)  # change -> one K_ALGO event
+        spmd._note_algorithm("tree", 1024)  # steady state -> no event
+        evs = [e for e in rec.events()
+               if e.kind == blackbox.K_ALGO and e.name == "small"]
+        assert len(evs) == 1 and evs[0].detail == "ring->tree"
+        g = instruments.collective_algorithm().labels(**{"class": "small"})
+        assert g.value == adaptive.ALGO_CODES["tree"]
+    finally:
+        blackbox.reset_for_tests()
+        spmd._algo_last.clear()
+
+
+# --------------------------------------------------- compiled-step plumbing
+def test_train_step_algorithm_knob(monkeypatch):
+    import jax.numpy as jnp
+    import optax
+
+    monkeypatch.setenv("HOROVOD_GSPMD_WIRE", "int8")
+    hvd.init()
+    mesh, n = hvd.mesh(), hvd.num_replicas()
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 512).astype(np.float32)
+    y = rng.randn(32).astype(np.float32)
+    params = {"w": jnp.zeros((512,), jnp.float32)}
+
+    def loss_fn(p, b):
+        xb, yb = b
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    tx = optax.sgd(0.05)
+    data = spmd.shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+
+    def one(algorithm):
+        step = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False,
+                                    algorithm=algorithm)
+        p = spmd.replicate(params, mesh)
+        o = spmd.quantized_opt_state(tx, params, mesh)
+        p, o, _ = step(p, o, data)
+        return np.asarray(p["w"])
+
+    ring, tree, hier = one("ring"), one("tree"), one("hier")
+    # the env default (unset -> ring) is the same compiled program
+    assert (one(None) == ring).all()
+    # every zoo member lands within the int8 quantization envelope of the
+    # ring's update (same payload, same grids, different hop schedule)
+    scale = max(float(np.abs(ring).max()), 1e-6)
+    assert np.abs(tree - ring).max() < 0.1 * scale
+    assert np.abs(hier - ring).max() < 0.1 * scale
+
+    monkeypatch.setenv("HOROVOD_GSPMD_ALGO", "gossip")
+    with pytest.raises(ValueError):
+        spmd.make_train_step(loss_fn, tx, mesh=mesh)
+
+
+def test_executor_algo_choice(monkeypatch):
+    from horovod_tpu.runtime.executor import Executor
+
+    ex = Executor.__new__(Executor)
+    adaptive.reset()
+    monkeypatch.delenv("HOROVOD_GSPMD_ALGO", raising=False)
+    assert Executor._algo_choice(ex) == "ring"
+    monkeypatch.setenv("HOROVOD_GSPMD_ALGO", "tree")
+    assert Executor._algo_choice(ex) == "tree"
+    # auto: the tuner broadcast decides, ring until one arrives
+    monkeypatch.setenv("HOROVOD_GSPMD_ALGO", "auto")
+    assert Executor._algo_choice(ex) == "ring"
+    adaptive.set_autotuned_algorithm("hier")
+    assert Executor._algo_choice(ex) == "hier"
+    # an explicit pin beats the broadcast
+    monkeypatch.setenv("HOROVOD_GSPMD_ALGO", "ring")
+    assert Executor._algo_choice(ex) == "ring"
+    adaptive.reset()

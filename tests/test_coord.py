@@ -1979,3 +1979,47 @@ def test_hierarchical_standby_sigkill():
         assert abs(w1[step] - w) < 1e-4 * max(1.0, abs(w)), (
             f"step {step}: got {w1[step]}, expected ~{w} — a step was "
             f"lost or double-applied across the failover")
+
+
+class TestTunedWireByteIdentity:
+    """The joint tuner's 4th tuned field (collective algorithm) rides a new
+    flag byte (3). Absent, the frame must stay byte-identical to the PR-10
+    3-field bitwidth wire — pinned against golden hex — and old-style
+    3-field frames must decode unchanged."""
+
+    # encode_response_list(0, -1, [], [], [], tuned=(4096, 2.5, "int8"))
+    GOLDEN_TUNED3 = (
+        "0000000000ffffffff00000000000000000200100000000000000000000000000"
+        "44004000000696e7438ffffffff0000000000000000")
+
+    def test_three_field_frame_bytes_pinned(self):
+        out = wire.encode_response_list(0, -1, [], [], [],
+                                        tuned=(4096, 2.5, "int8"))
+        assert out.hex() == self.GOLDEN_TUNED3
+
+    def test_three_field_golden_decodes_unchanged(self):
+        decoded = wire.decode_response_list(bytes.fromhex(
+            self.GOLDEN_TUNED3))
+        assert decoded[6] == (4096, 2.5, "int8")
+
+    def test_empty_algorithm_keeps_old_bytes(self):
+        # a JointTuner that has not settled an algorithm (or a plain
+        # BitwidthTuner) must not grow the frame
+        old = wire.encode_response_list(0, -1, [], [], [],
+                                        tuned=(4096, 2.5, "int8"))
+        new = wire.encode_response_list(0, -1, [], [], [],
+                                        tuned=(4096, 2.5, "int8", ""))
+        assert new == old
+
+    def test_algorithm_field_roundtrip(self):
+        for algo in ("ring", "tree", "hier"):
+            buf = wire.encode_response_list(0, -1, [], [], [],
+                                            tuned=(4096, 2.5, "int8", algo))
+            assert wire.decode_response_list(buf)[6] \
+                == (4096, 2.5, "int8", algo)
+        # flag ladder stays monotone: each tier adds exactly one field
+        for tuned, want in (((64, 5.0), (64, 5.0)),
+                            ((64, 5.0, "bf16"), (64, 5.0, "bf16")),
+                            (None, None)):
+            buf = wire.encode_response_list(0, -1, [], [], [], tuned=tuned)
+            assert wire.decode_response_list(buf)[6] == want

@@ -454,3 +454,96 @@ def test_knob_unset_compiles_identical_program(monkeypatch):
     monkeypatch.setenv("HOROVOD_GSPMD_WIRE", "int8")
     quant = spmd.make_train_step(loss_fn, tx, mesh=mesh)
     assert hasattr(quant, "jitted")  # the instrumented quantized wrapper
+
+
+def _golden_quantized_ring_step(loss_fn, tx, mesh, wire, block):
+    """Verbatim copy of _make_quantized_step's pre-algorithm-zoo body
+    (zero1 off, donate off): the golden the HOROVOD_GSPMD_ALGO pin
+    compares against. If the exact ring trace drifts, update BOTH on
+    purpose — an accidental change invalidates every user's jit cache."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[spmd.MESH_AXIS]
+
+    def _flatten_f32(leaves):
+        parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _split_like(flat, leaves):
+        out, off = [], 0
+        for l in leaves:
+            out.append(flat[off:off + l.size].reshape(l.shape)
+                       .astype(l.dtype))
+            off += l.size
+        return out
+
+    def local_step(params, inner, ef, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        flat = _flatten_f32(g_leaves)
+        total = flat.shape[0]
+        corrected = flat + ef[0]
+        use_ring = spmd._wire_eligible(total, corrected.dtype, wire, block)
+        if use_ring:
+            new_ef = (corrected
+                      - spmd._wire_roundtrip(corrected, wire, block))[None]
+        else:
+            new_ef = jnp.zeros_like(ef)
+        reduced = spmd.quantized_allreduce(
+            corrected, hvd.Average, spmd.MESH_AXIS, wire, block)
+        grads = jax.tree_util.tree_unflatten(
+            treedef, _split_like(reduced, g_leaves))
+        updates, inner = tx.update(grads, inner, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.pmean(loss, spmd.MESH_AXIS)
+        return params, inner, new_ef, loss
+
+    def step(params, opt_state, batch):
+        inner, ef = opt_state
+        inner_specs = jax.tree_util.tree_map(lambda l: P(), inner)
+        fn = spmd._shard_map(
+            local_step, mesh,
+            in_specs=(P(), inner_specs, P(spmd.MESH_AXIS),
+                      P(spmd.MESH_AXIS)),
+            out_specs=(P(), inner_specs, P(spmd.MESH_AXIS), P()))
+        params, inner, ef, loss = fn(params, inner, ef, batch)
+        return params, (inner, ef), loss
+
+    return jax.jit(step)
+
+
+def test_algo_unset_compiles_identical_quantized_program(monkeypatch):
+    """HOROVOD_GSPMD_ALGO unset/"ring" pins: the quantized fast path must
+    lower to byte-identical StableHLO as the pre-zoo ring builder — the
+    algorithm axis is free until someone actually flips it."""
+    import optax
+
+    hvd.init()
+    monkeypatch.setenv("HOROVOD_GSPMD_WIRE", "int8")
+    monkeypatch.setenv("HOROVOD_INT8_BLOCK", str(BLOCK))
+    monkeypatch.delenv("HOROVOD_GSPMD_ALGO", raising=False)
+    mesh, n = hvd.mesh(), hvd.num_replicas()
+    params, loss_fn, batch = _linreg(n)
+    tx = optax.sgd(0.05)
+    p = spmd.replicate(params, mesh)
+    o = spmd.quantized_opt_state(tx, params, mesh)
+    data = spmd.shard_batch(batch, mesh)
+
+    golden = _golden_quantized_ring_step(loss_fn, tx, mesh, "int8", BLOCK
+                                         ).lower(p, o, data).as_text()
+    unset = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False
+                                 ).jitted.lower(p, o, data).as_text()
+    assert unset == golden
+    ring = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False,
+                                algorithm="ring"
+                                ).jitted.lower(p, o, data).as_text()
+    assert ring == golden
+
+    # and a zoo member really changes the traced program
+    tree = spmd.make_train_step(loss_fn, tx, mesh=mesh, donate=False,
+                                algorithm="tree"
+                                ).jitted.lower(p, o, data).as_text()
+    assert tree != golden
