@@ -66,6 +66,15 @@ python -m pytest tests/test_tracing.py -q
 stage "doctor: blackbox flight recorder, signatures, hvddoctor, anomaly watch"
 python -m pytest tests/test_blackbox.py -q
 
+stage "restart: async sharded checkpointing + peer-redundant recovery"
+python -m pytest tests/test_ckpt.py -q -m "not integration"
+# the write-behind contract is the gate: per-commit stall must stay ~0
+# (the step path pays a buffer swap, never disk I/O), and the O(shard)
+# peer-restore time appends a direction="lower" row to the perf history.
+# the kill-and-replace integration rides the integration suite below.
+python benchmarks/ckpt_bench.py --shard-mb 2 --commits 15 \
+    --history /tmp/hvd_ci_ckpt_hist.jsonl --check-regression
+
 stage "overlap: bucketed backward drain, fused kernels, hvdprof overlap %"
 python -m pytest tests/test_overlap.py -q
 

@@ -45,6 +45,8 @@ K_ALGO = "algorithm"           # collective-algorithm decision change or
                                # joint-tuner settle (name = size class)
 K_EXCLUDED = "excluded"        # straggler policy excluded/readmitted/
                                # escalated a rank (detail names the host)
+K_CKPT = "checkpoint"          # checkpoint lifecycle: shard snapshot
+                               # landed, bundle finalized, peer restore
 
 DEFAULT_EVENTS = 4096
 

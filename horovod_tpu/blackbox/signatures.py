@@ -446,6 +446,64 @@ def detect_latency_regression(bundle) -> List[dict]:
     return sigs
 
 
+def detect_stale_checkpoint(bundle) -> List[dict]:
+    """Checkpoint bundles that stopped finalizing, and the member holding
+    them back. Every shard write records a K_CKPT ``snapshot`` event with
+    ``step=N ... index=I``; rank 0 records ``finalize`` when a manifest
+    lands (ckpt/manager.py). Shards advancing past the last finalized
+    bundle with one member's snapshot head trailing the rest means that
+    rank's writer is wedged or starved — name it, since the bundle can
+    only finalize when EVERY member's shard of the same step lands. A
+    ``restore`` whose detail shows ``journal_head > step`` is the same
+    disease seen from the recovery side: the replacement restored an old
+    disk bundle while a peer held fresher state it could not reach."""
+    heads: Dict[int, int] = {}        # reporting rank -> latest snap step
+    last_final = -1
+    stale_restores = []
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_CKPT:
+            continue
+        name = ev.get("name") or ""
+        detail = ev.get("detail") or ""
+        sm = re.search(r"step=(-?\d+)", detail)
+        step = int(sm.group(1)) if sm else -1
+        if name == "snapshot":
+            r = ev.get("rank", src)
+            heads[r] = max(heads.get(r, -1), step)
+        elif name == "finalize":
+            last_final = max(last_final, step)
+        elif name in ("restore", "peer_restore"):
+            jm = re.search(r"journal_head=(-?\d+)", detail)
+            jhead = int(jm.group(1)) if jm else -1
+            if jhead > step >= 0:
+                stale_restores.append((ev.get("rank", src), step, jhead))
+    sigs = []
+    if len(heads) >= 2:
+        lead = max(heads.values())
+        lagger = min(heads, key=lambda r: heads[r])
+        lag = lead - heads[lagger]
+        if lead > last_final and lag >= 2:
+            sigs.append(make_signature(
+                "stale_checkpoint", SEV_WARNING,
+                "checkpoint bundles are not finalizing: shards reached "
+                "step %d but the last complete bundle is step %d — rank "
+                "%d's snapshots stop at step %d, holding every newer "
+                "bundle open (wedged writer thread or starved disk on "
+                "that rank)" % (lead, last_final, lagger, heads[lagger]),
+                rank=lagger, head=heads[lagger], lead=lead,
+                last_finalized=last_final))
+    for r, step, jhead in stale_restores:
+        sigs.append(make_signature(
+            "stale_checkpoint", SEV_WARNING,
+            "stale checkpoint restore: rank %d restored step %d from the "
+            "disk bundle while a buddy journal already held step %d — "
+            "the peer restore path was unreachable, so the resumed "
+            "trajectory lost %d committed step(s)"
+            % (r, step, jhead, jhead - step),
+            rank=r, restored_step=step, journal_head=jhead))
+    return sigs
+
+
 #: every event-based detector the doctor runs, in reporting order
 DETECTORS = (
     detect_collective_deadlock,
@@ -461,6 +519,7 @@ DETECTORS = (
     detect_heartbeat_flap,
     detect_bitwidth_thrash,
     detect_algorithm_thrash,
+    detect_stale_checkpoint,
 )
 
 

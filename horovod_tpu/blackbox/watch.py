@@ -48,6 +48,14 @@ SIGNALS = (
     ("moe_load_imbalance", 1.0),
 )
 
+#: the checkpoint bundle-age signal is THRESHOLD-based, not baselined: a
+#: rolling baseline would learn a steadily growing age as normal, which is
+#: exactly the failure (bundles that stopped finalizing). It fires when
+#: ``hvd_ckpt_bundle_age_steps`` exceeds this factor times
+#: HOROVOD_CKPT_INTERVAL, presence-gated so jobs without checkpointing
+#: never sample it.
+CKPT_AGE_FACTOR = 2.0
+
 _watch = None
 _watch_lock = threading.Lock()
 
@@ -93,6 +101,7 @@ class AnomalyWatch:
                                   min_samples=min_samples, floor=floor)
             for name, floor in SIGNALS}
         self._active = {name: False for name, _ in SIGNALS}
+        self._ckpt_active = False
         self._prev = {}          # cumulative-counter memory between samples
         self._samples = 0
         self._signatures = []    # most recent detections (healthz surface)
@@ -213,8 +222,46 @@ class AnomalyWatch:
                 self._active[name] = anomalous
                 instruments.anomaly_active().labels(signal=name).set(
                     1 if anomalous else 0)
+        fired.extend(self._check_ckpt_age(snapshot))
         if fired:
             self._signatures = (self._signatures + fired)[-16:]
+        return fired
+
+    def _check_ckpt_age(self, snapshot) -> list:
+        """Threshold check on ``hvd_ckpt_bundle_age_steps`` (see
+        CKPT_AGE_FACTOR above): fires once per episode when the age
+        exceeds CKPT_AGE_FACTOR x HOROVOD_CKPT_INTERVAL, clears when a
+        bundle finalizes and the gauge drops back."""
+        from ..metrics import instruments
+
+        if "hvd_ckpt_bundle_age_steps" not in snapshot:
+            return []
+        try:
+            interval = max(1, int(os.environ.get("HOROVOD_CKPT_INTERVAL",
+                                                 "10")))
+        except ValueError:
+            interval = 10
+        age = _series_total(snapshot, "hvd_ckpt_bundle_age_steps")
+        threshold = CKPT_AGE_FACTOR * interval
+        anomalous = age > threshold
+        fired = []
+        if anomalous and not self._ckpt_active:
+            sig = make_signature(
+                "anomaly:ckpt_bundle_age_steps", SEV_WARNING,
+                "anomaly: checkpoint bundle age %d steps exceeds %.0f "
+                "(%gx HOROVOD_CKPT_INTERVAL=%d) — shards are landing but "
+                "bundles never finalize; see hvddoctor stale_checkpoint "
+                "for the lagging rank"
+                % (age, threshold, CKPT_AGE_FACTOR, interval),
+                signal="ckpt_bundle_age_steps", value=age,
+                threshold=threshold, related="stale_checkpoint")
+            fired.append(sig)
+            logger.warning("anomaly watch: %s", sig["summary"])
+            _record(K_ANOMALY, "ckpt_bundle_age_steps", sig["summary"])
+        if anomalous != self._ckpt_active:
+            self._ckpt_active = anomalous
+            instruments.anomaly_active().labels(
+                signal="ckpt_bundle_age_steps").set(1 if anomalous else 0)
         return fired
 
     def state(self) -> dict:

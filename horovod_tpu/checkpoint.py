@@ -12,7 +12,6 @@ without the file (or with stale copies) still start consistent.
 from __future__ import annotations
 
 import os
-import tempfile
 from typing import Any, Optional
 
 import jax
@@ -33,34 +32,29 @@ def save(path: str, state: Any, overwrite: bool = True) -> bool:
     # Overwrite guard: every rank must take the same raise/return path or
     # the survivors hang in the next collective. The file may exist only on
     # rank 0's host (only rank 0 writes), so the verdict is rank 0's,
-    # broadcast to everyone; broadcast_from_root re-raises root-side errors
-    # symmetrically.
+    # broadcast to everyone as a plain boolean; every rank then raises the
+    # SAME FileExistsError naming the path. (Raising inside the broadcast
+    # would surface as a generic re-wrapped error on non-root ranks — the
+    # caller's `except FileExistsError` must work on all of them.)
     if not overwrite:
         if basics.is_initialized() and basics.size() > 1:
-            def _guard():
-                if os.path.exists(path):
-                    raise FileExistsError(f"checkpoint exists: {path}")
-                return True
-
-            broadcast_from_root(_guard, 0, name=f"ckpt.guard.{path}")
-        elif os.path.exists(path):
+            exists = bool(broadcast_from_root(
+                lambda: os.path.exists(path), 0,
+                name=f"ckpt.guard.{path}"))
+        else:
+            exists = os.path.exists(path)
+        if exists:
             raise FileExistsError(f"checkpoint exists: {path}")
     if basics.is_initialized() and basics.rank() != 0:
         return False
     data = serialization.to_bytes(jax.device_get(state))
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    from .ckpt import bundle
+
+    # the atomic temp-file + rename convention lives in ckpt/bundle.py now
+    # (one code path for every checkpoint byte in the tree); with
+    # HOROVOD_CKPT_DIR set this is literally the async bundle subsystem's
+    # writer path, so legacy save() and bundle shards share semantics
+    bundle.atomic_write_bytes(path, data)
     return True
 
 

@@ -1,0 +1,33 @@
+"""Async sharded checkpointing with peer-redundant shard recovery.
+
+Three cooperating pieces (docs/checkpoint.md):
+
+- :mod:`.bundle` — the on-disk format: one directory per step, one shard
+  file per member, a manifest renamed into place atomically once every
+  shard landed. A crash mid-write leaves the previous complete bundle
+  authoritative.
+- :mod:`.writer` — :class:`AsyncShardWriter`, the host-memory double
+  buffer + off-path writer thread that keeps
+  ``hvd_checkpoint_stall_seconds`` ~0.
+- :mod:`.buddy` — shard journaling to the ring successor over the standby
+  replication framing, so a replacement restores in O(shard) from its
+  buddy's host memory with no disk read and no O(model) broadcast.
+
+:mod:`.manager` ties them to the commit boundary and the coordinator's
+``MSG_CKPT_MARK`` / ``MSG_CKPT_DONE`` consistency epoch. The whole
+subsystem is off — zero new frames, byte-identical wire traffic — unless
+``HOROVOD_CKPT_DIR`` is set.
+"""
+
+from . import bundle  # noqa: F401
+from .buddy import (BuddyClient, BuddyServer, apply_delta,  # noqa: F401
+                    fetch_shard, shard_delta)
+from .bundle import (atomic_write_bytes, complete_steps,  # noqa: F401
+                     finalize_manifest, latest_complete_step,
+                     prune_bundles, read_bundle_bytes, read_manifest,
+                     read_shard, write_shard)
+from .manager import (CkptManager, active, buddy_enabled,  # noqa: F401
+                      ckpt_dir, ckpt_interval, ensure_manager,
+                      load_latest, pack_tree, partition_bounds, shutdown,
+                      unpack_tree)
+from .writer import AsyncShardWriter  # noqa: F401

@@ -1,0 +1,276 @@
+"""Peer-redundant shard journaling over the standby replication framing.
+
+Each rank journals its committed shard to the NEXT member on the ring
+(rank at position ``(index + 1) % world``), so every shard exists twice:
+once on its owner, once in its buddy's host memory. A lost rank's
+hot-spare replacement then restores from the buddy in O(shard) — no
+checkpoint read off disk, no O(model) re-broadcast from a survivor.
+
+The stream reuses the hardened control-plane framing and the standby
+replication frame types (``MSG_REPL_HELLO`` / ``MSG_SNAPSHOT`` /
+``MSG_JOURNAL`` / ``MSG_BYE``, runtime/standby.py): the hello payload
+names the role — ``push:{index}`` from the shard's owner, ``fetch:{index}``
+from a replacement restoring it. After the first full-shard SNAPSHOT the
+owner ships only JOURNAL deltas: the fixed-size blocks whose bytes changed
+since the last push, which keeps steady-state journal traffic proportional
+to what the optimizer actually touched, not to the shard.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import blackbox as _blackbox
+from ..exceptions import ShutdownError
+from ..metrics import instruments
+from ..runtime import wire
+from ..runtime.coordinator import (MSG_BYE, MSG_JOURNAL, MSG_REPL_HELLO,
+                                   MSG_SNAPSHOT)
+
+logger = logging.getLogger("horovod_tpu.ckpt")
+
+#: delta granularity: a journal block is shipped iff any byte in it changed
+DELTA_BLOCK = 64 << 10
+
+
+def shard_delta(prev: Optional[bytes], cur: bytes,
+                block: int = DELTA_BLOCK) -> List[Tuple[int, bytes]]:
+    """The ``(offset, bytes)`` blocks of ``cur`` that differ from ``prev``.
+    A length change (or no prior push) degenerates to one whole-shard
+    block — correctness never depends on the delta being small."""
+    if prev is None or len(prev) != len(cur):
+        return [(0, cur)]
+    out = []
+    for off in range(0, len(cur), block):
+        a, b = prev[off:off + block], cur[off:off + block]
+        if a != b:
+            out.append((off, b))
+    return out
+
+
+def apply_delta(prev: Optional[bytes], total_len: int,
+                blocks: List[Tuple[int, bytes]]) -> bytes:
+    """Patch ``blocks`` over ``prev`` into a ``total_len``-byte shard."""
+    buf = bytearray(prev if prev is not None and len(prev) == total_len
+                    else total_len)
+    for off, data in blocks:
+        buf[off:off + len(data)] = data
+    return bytes(buf)
+
+
+class BuddyServer:
+    """Holds the journaled shards pushed by this rank's ring predecessors
+    and serves them to fetching replacements. One daemon accept thread;
+    one thread per stream, mirroring CoordinatorServer's replication
+    shipper."""
+
+    def __init__(self, secret: str, rank: int = 0, host: str = "0.0.0.0"):
+        self.secret = secret
+        self.rank = rank
+        #: fires once per shard index on the FIRST bytes journaled here —
+        #: the manager's cue to advertise this host as that shard's
+        #: restore source
+        self.on_hold: Optional[Callable[[int], None]] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # shard index -> (journal head step, shard bytes)
+        self._shards: Dict[int, Tuple[int, bytes]] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self._sock.settimeout(0.5)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="hvd_ckpt_buddy", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- inventory
+    def head(self, index: int) -> Optional[int]:
+        """Journal-head step held for shard ``index`` (None = nothing)."""
+        with self._lock:
+            ent = self._shards.get(index)
+            return ent[0] if ent else None
+
+    def get(self, index: int) -> Optional[Tuple[int, bytes]]:
+        with self._lock:
+            return self._shards.get(index)
+
+    def put(self, index: int, step: int, data: bytes) -> None:
+        with self._lock:
+            fresh = index not in self._shards
+            self._shards[index] = (step, data)
+        if fresh and self.on_hold is not None:
+            try:
+                self.on_hold(index)
+            except Exception:
+                logger.debug("ckpt buddy: on_hold(%d) failed", index,
+                             exc_info=True)
+
+    # ----------------------------------------------------------------- serve
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(0.5)
+            threading.Thread(target=self._serve, args=(conn,),
+                             name="hvd_ckpt_buddy_conn",
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            mt, _, peer, payload = wire.recv_frame(conn, self.secret,
+                                                   self._stop)
+            if mt != MSG_REPL_HELLO:
+                return
+            role, _, idx = payload.decode("utf-8", "replace").partition(":")
+            index = int(idx)
+            if role == "fetch":
+                self._serve_fetch(conn, peer, index)
+            elif role == "push":
+                self._serve_push(conn, peer, index)
+        except (ShutdownError, ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_fetch(self, conn, peer: int, index: int) -> None:
+        ent = self.get(index)
+        if ent is None:
+            # nothing journaled for that slot: BYE = "restore elsewhere"
+            wire.send_frame(conn, self.secret, MSG_BYE, 0, self.rank)
+            return
+        step, data = ent
+        wire.send_frame(conn, self.secret, MSG_SNAPSHOT, 0, self.rank,
+                        wire.encode_shard_snapshot(index, step, data))
+        bb = _blackbox.active()
+        if bb is not None:
+            bb.record(_blackbox.K_CKPT, "peer_serve",
+                      "index=%d step=%d nbytes=%d -> rank %d"
+                      % (index, step, len(data), peer), self.rank)
+
+    def _serve_push(self, conn, peer: int, index: int) -> None:
+        while not self._stop.is_set():
+            mt, _, _, payload = wire.recv_frame(conn, self.secret,
+                                                self._stop)
+            if mt == MSG_BYE:
+                return
+            if mt == MSG_SNAPSHOT:
+                idx, step, data = wire.decode_shard_snapshot(payload)
+                self.put(idx, step, data)
+            elif mt == MSG_JOURNAL:
+                idx, step, total, blocks = wire.decode_shard_journal(
+                    payload)
+                with self._lock:
+                    prev = self._shards.get(idx)
+                    self._shards[idx] = (step, apply_delta(
+                        prev[1] if prev else None, total, blocks))
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class BuddyClient:
+    """The shard owner's journaling stream to its ring successor. Lazy
+    dial; a push failure tears the stream down and the next push re-dials
+    and resends a full snapshot (the buddy may have restarted with empty
+    memory — deltas only ride a stream that began with a snapshot)."""
+
+    def __init__(self, addr: Tuple[str, int], secret: str, index: int,
+                 rank: int = 0):
+        self.addr = addr
+        self.secret = secret
+        self.index = index
+        self.rank = rank
+        self._sock: Optional[socket.socket] = None
+        self._last: Optional[bytes] = None
+        self.pushed_bytes = 0
+
+    def _dial(self) -> None:
+        from ..runtime.standby import dial_repl
+
+        self._sock = dial_repl(self.addr, self.secret, self.rank,
+                               ("push:%d" % self.index).encode())
+        self._last = None
+
+    def push(self, step: int, data: bytes) -> int:
+        """Journal the committed shard; returns payload bytes shipped.
+        Raises ConnectionError/OSError after one redial attempt fails —
+        the caller treats the buddy as gone and relies on disk."""
+        for attempt in (0, 1):
+            try:
+                if self._sock is None:
+                    self._dial()
+                if self._last is None:
+                    payload = wire.encode_shard_snapshot(self.index, step,
+                                                         data)
+                    wire.send_frame(self._sock, self.secret, MSG_SNAPSHOT,
+                                    0, self.rank, payload)
+                else:
+                    blocks = shard_delta(self._last, data)
+                    payload = wire.encode_shard_journal(
+                        self.index, step, len(data), blocks)
+                    wire.send_frame(self._sock, self.secret, MSG_JOURNAL,
+                                    0, self.rank, payload)
+                self._last = data
+                n = len(payload)
+                self.pushed_bytes += n
+                instruments.checkpoint_bytes().labels(kind="peer").inc(n)
+                return n
+            except (ConnectionError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                wire.send_frame(self._sock, self.secret, MSG_BYE, 0,
+                                self.rank)
+            except (ConnectionError, OSError):
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._last = None
+
+
+def fetch_shard(addr: Tuple[str, int], secret: str, index: int,
+                rank: int = 0,
+                timeout: float = 5.0) -> Optional[Tuple[int, bytes]]:
+    """One-shot restore: dial a buddy and fetch shard ``index``. Returns
+    (journal head step, shard bytes), or None when the buddy holds
+    nothing for that slot."""
+    from ..runtime.standby import dial_repl
+
+    stop = threading.Event()
+    sock = dial_repl(addr, secret, rank, ("fetch:%d" % index).encode(),
+                     timeout=timeout)
+    try:
+        mt, _, _, payload = wire.recv_frame(sock, secret, stop)
+        if mt != MSG_SNAPSHOT:
+            return None
+        _, step, data = wire.decode_shard_snapshot(payload)
+        return step, data
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

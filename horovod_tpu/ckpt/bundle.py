@@ -1,0 +1,233 @@
+"""On-disk bundle format for async sharded checkpoints (docs/checkpoint.md).
+
+A bundle is one directory per checkpointed step::
+
+    HOROVOD_CKPT_DIR/
+      step_000120/
+        rank_0.shard          # shard slot 0's bytes
+        rank_1.shard
+        replica.blob          # replicated slots (written by slot 0 only)
+        manifest.json         # written LAST, atomically — the commit record
+
+The manifest is the bundle's commit record: it is renamed into place
+(temp file + ``os.replace``, the same convention as ``checkpoint.py``)
+only after every member shard of the SAME step has landed, so a crash at
+any earlier point leaves a ``step_*`` directory without a manifest — an
+incomplete bundle that restore ignores. The previous complete bundle
+stays authoritative; no reader can ever observe a half-written one.
+
+Shard files themselves are also written via temp-file + rename, so a
+partially-flushed shard never carries the final name. Every row in the
+manifest records the shard's byte length and CRC32; readers verify both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+MANIFEST = "manifest.json"
+REPLICA = "replica.blob"
+SCHEMA_VERSION = 1
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def atomic_write_bytes(path: str, data: bytes) -> int:
+    """Write ``data`` at ``path`` atomically (temp file in the same
+    directory + ``os.replace``) — the one code path every checkpoint
+    write in the tree goes through. Returns bytes written."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt_tmp_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return len(data)
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, "step_%06d" % step)
+
+
+def shard_path(root: str, step: int, index: int) -> str:
+    return os.path.join(step_dir(root, step), "rank_%d.shard" % index)
+
+
+def replica_path(root: str, step: int) -> str:
+    return os.path.join(step_dir(root, step), REPLICA)
+
+
+def write_shard(root: str, step: int, index: int,
+                data: bytes) -> Tuple[int, int]:
+    """Land one shard file (atomic). Returns (nbytes, crc32)."""
+    atomic_write_bytes(shard_path(root, step, index), data)
+    return len(data), zlib.crc32(data) & 0xFFFFFFFF
+
+
+def write_replica(root: str, step: int, data: bytes) -> Tuple[int, int]:
+    """Land the replicated-slots blob (written by shard slot 0 only)."""
+    atomic_write_bytes(replica_path(root, step), data)
+    return len(data), zlib.crc32(data) & 0xFFFFFFFF
+
+
+def finalize_manifest(root: str, step: int, epoch: int,
+                      shards: Dict[int, dict],
+                      replica: Optional[dict] = None,
+                      total_len: Optional[int] = None) -> str:
+    """Write the bundle's commit record — call ONLY once every member
+    shard of ``step`` has landed. ``shards`` maps shard index ->
+    ``{"nbytes": int, "crc": int}``. Atomic rename, so a crash mid-write
+    leaves the previous complete bundle authoritative."""
+    doc = {
+        "schema": SCHEMA_VERSION,
+        "step": int(step),
+        "epoch": int(epoch),
+        "world": len(shards),
+        "shards": {str(i): {"file": "rank_%d.shard" % i,
+                            "nbytes": int(info["nbytes"]),
+                            "crc": int(info["crc"])}
+                   for i, info in shards.items()},
+    }
+    if replica is not None:
+        doc["replica"] = {"file": REPLICA,
+                          "nbytes": int(replica["nbytes"]),
+                          "crc": int(replica["crc"])}
+    if total_len is not None:
+        doc["total_len"] = int(total_len)
+    path = os.path.join(step_dir(root, step), MANIFEST)
+    atomic_write_bytes(path, json.dumps(doc, sort_keys=True,
+                                        indent=1).encode())
+    return path
+
+
+def read_manifest(root: str, step: int) -> Optional[dict]:
+    """The bundle's manifest, or None when absent/corrupt (an incomplete
+    bundle — a crash beat the rename; restore must skip it)."""
+    try:
+        with open(os.path.join(step_dir(root, step), MANIFEST), "rb") as f:
+            doc = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema", 0) > SCHEMA_VERSION:
+        return None
+    return doc
+
+
+def _bundle_complete(root: str, step: int, doc: dict) -> bool:
+    d = step_dir(root, step)
+    entries: List[dict] = list((doc.get("shards") or {}).values())
+    if doc.get("replica"):
+        entries.append(doc["replica"])
+    for info in entries:
+        p = os.path.join(d, info.get("file", ""))
+        try:
+            if os.path.getsize(p) != int(info.get("nbytes", -1)):
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def complete_steps(root: str) -> List[int]:
+    """Steps with a finalized manifest AND every listed file present at
+    its recorded size, ascending."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        step = int(m.group(1))
+        doc = read_manifest(root, step)
+        if doc is not None and _bundle_complete(root, step, doc):
+            out.append(step)
+    return sorted(out)
+
+
+def latest_complete_step(root: str) -> Optional[int]:
+    steps = complete_steps(root)
+    return steps[-1] if steps else None
+
+
+def read_shard(root: str, step: int, index: int,
+               verify: bool = True) -> bytes:
+    with open(shard_path(root, step, index), "rb") as f:
+        data = f.read()
+    if verify:
+        doc = read_manifest(root, step) or {}
+        info = (doc.get("shards") or {}).get(str(index))
+        if info is not None and (zlib.crc32(data) & 0xFFFFFFFF
+                                 != int(info["crc"])):
+            raise IOError("checkpoint shard %s (step %d) fails its "
+                          "manifest CRC" % (index, step))
+    return data
+
+
+def read_replica(root: str, step: int, verify: bool = True) -> bytes:
+    with open(replica_path(root, step), "rb") as f:
+        data = f.read()
+    if verify:
+        doc = read_manifest(root, step) or {}
+        info = doc.get("replica")
+        if info is not None and (zlib.crc32(data) & 0xFFFFFFFF
+                                 != int(info["crc"])):
+            raise IOError("checkpoint replica blob (step %d) fails its "
+                          "manifest CRC" % step)
+    return data
+
+
+def read_bundle_bytes(root: str, step: int) -> bytes:
+    """Concatenate every shard of a byte-partitioned bundle in slot order
+    and trim to the manifest's ``total_len`` (the full serialized state
+    under plain data parallelism)."""
+    doc = read_manifest(root, step)
+    if doc is None:
+        raise FileNotFoundError(
+            "no complete checkpoint bundle for step %d under %s"
+            % (step, root))
+    blob = b"".join(read_shard(root, step, i)
+                    for i in sorted(int(k) for k in doc["shards"]))
+    total = doc.get("total_len")
+    return blob[:total] if total is not None else blob
+
+
+def prune_bundles(root: str, keep: int = 2) -> List[int]:
+    """Drop the oldest complete bundles beyond ``keep``, plus any
+    incomplete ``step_*`` directory older than the newest complete bundle
+    (debris from a crash mid-write). Returns the steps removed."""
+    steps = complete_steps(root)
+    removed = []
+    for step in steps[:-keep] if keep > 0 else steps:
+        shutil.rmtree(step_dir(root, step), ignore_errors=True)
+        removed.append(step)
+    latest = steps[-1] if steps else None
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return removed
+    for name in names:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        step = int(m.group(1))
+        if (latest is not None and step < latest
+                and read_manifest(root, step) is None):
+            shutil.rmtree(step_dir(root, step), ignore_errors=True)
+            removed.append(step)
+    return sorted(set(removed))

@@ -1,0 +1,506 @@
+"""Per-rank checkpoint orchestrator (docs/checkpoint.md).
+
+``CkptManager`` hangs off the commit boundary: when ``HOROVOD_CKPT_DIR``
+is set, every ``ElasticState.commit()`` that crosses the configured step
+interval packs this rank's shard — its sharded slots plus the elastic
+executor's error-feedback residuals, or its byte-partition chunk of the
+full replica when no slot is marked sharded — into host memory, hands it
+to the :class:`~.writer.AsyncShardWriter`, and announces
+``MSG_CKPT_MARK`` to the coordinator. Off the step path the writer lands
+the shard file, reports ``MSG_CKPT_DONE`` (the coordinator finalizes the
+bundle manifest once every member shard of the same step landed), and
+journals the shard to the ring successor's :class:`~.buddy.BuddyServer`.
+
+Knobs: ``HOROVOD_CKPT_DIR`` (bundle root; unset = the whole subsystem is
+off and no new wire frames exist), ``HOROVOD_CKPT_INTERVAL`` (commit
+steps between snapshots, default 10), ``HOROVOD_CKPT_BUDDY`` (peer
+journaling on/off, default on), ``HOROVOD_CKPT_KEEP`` (complete bundles
+retained, default 2).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+from flax import serialization
+
+from .. import blackbox as _blackbox
+from ..metrics import instruments
+from . import buddy as buddy_mod
+from . import bundle
+from .writer import AsyncShardWriter
+
+logger = logging.getLogger("horovod_tpu.ckpt")
+
+_mgr: Optional["CkptManager"] = None
+_mgr_lock = threading.Lock()
+
+
+# ------------------------------------------------------------------- knobs
+def ckpt_dir() -> Optional[str]:
+    return os.environ.get("HOROVOD_CKPT_DIR") or None
+
+
+def ckpt_interval() -> int:
+    try:
+        return max(1, int(os.environ.get("HOROVOD_CKPT_INTERVAL", "10")))
+    except ValueError:
+        return 10
+
+
+def buddy_enabled() -> bool:
+    return os.environ.get("HOROVOD_CKPT_BUDDY", "1") not in (
+        "0", "false", "False", "off")
+
+
+def _keep_bundles() -> int:
+    try:
+        return max(1, int(os.environ.get("HOROVOD_CKPT_KEEP", "2")))
+    except ValueError:
+        return 2
+
+
+# -------------------------------------------------------------- blob packing
+def pack_tree(tree: Any) -> bytes:
+    """Template-free serialization (msgpack): restore needs no structure
+    handed in, so a replacement process can unpack a buddy's journal head
+    before it has built any state of its own."""
+    import jax
+
+    return serialization.msgpack_serialize(
+        jax.tree_util.tree_map(lambda x: x, jax.device_get(tree)))
+
+
+def unpack_tree(data: bytes) -> Any:
+    return serialization.msgpack_restore(data)
+
+
+def partition_bounds(total: int, world: int, index: int) -> Tuple[int, int]:
+    """Byte bounds of shard ``index`` when a full replica is partitioned
+    1/N (plain-DP mode) — ``optim.zero.shard_bounds`` with a 1-byte block:
+    exact slices, so concatenation in slot order reassembles the blob
+    byte-for-byte."""
+    from ..optim.zero import shard_bounds
+
+    return shard_bounds(total, max(1, world), index, block=1)
+
+
+class CkptManager:
+    """One per process. Thread-safety: ``on_state_commit`` runs on the
+    training thread; ``_on_written`` runs on the writer thread; the buddy
+    server threads only touch their own store."""
+
+    def __init__(self, root: str, rank: int, world: int,
+                 controller=None, interval: Optional[int] = None,
+                 buddy: Optional[bool] = None, secret: str = ""):
+        self.root = root
+        self.rank = rank
+        self.world = max(1, world)
+        self.controller = controller
+        self.interval = interval if interval is not None else ckpt_interval()
+        self.secret = secret or os.environ.get("HVD_SECRET", "")
+        self._buddy_on = buddy if buddy is not None else buddy_enabled()
+        self._lock = threading.Lock()
+        self._last_snap_step = -1
+        self._last_done_step = -1
+        self.last_restore: Optional[dict] = None  # forensics for tests
+        self.writer = AsyncShardWriter(root, on_written=self._on_written,
+                                       rank=rank)
+        # journal receiver for my ring predecessor's shard
+        self.buddy_server: Optional[buddy_mod.BuddyServer] = None
+        self._buddy_client: Optional[buddy_mod.BuddyClient] = None
+        # after a failed push, skip buddy traffic for a few seconds: the
+        # push is synchronous with commit, and paying a resolve/dial
+        # timeout on every step while the successor is down would turn a
+        # redundancy feature into a straggler
+        self._push_retry_at = 0.0
+        if self._buddy_on:
+            advertise, bind = self._addresses()
+            self.buddy_server = buddy_mod.BuddyServer(self.secret,
+                                                      rank=rank, host=bind)
+            self.buddy_server.on_hold = self._publish_held_shard
+            self._publish("ckpt.buddy.%d" % rank,
+                          "%s:%d" % (advertise, self.buddy_server.port))
+        # rank 0 hosts the coordinator state machine: point its finalize
+        # hook at the bundle writer so the manifest lands exactly when the
+        # last member DONE arrives
+        state = getattr(controller, "_state", None)
+        if state is not None:
+            state.on_ckpt_finalize = self._finalize_bundle
+
+    # ------------------------------------------------------------ addressing
+    @staticmethod
+    def _addresses() -> Tuple[str, str]:
+        from ..runtime.coordinator import _advertise_host
+
+        advertise = _advertise_host()
+        return advertise, ("127.0.0.1" if advertise == "127.0.0.1"
+                           else "0.0.0.0")
+
+    def _publish(self, key: str, addr: str) -> None:
+        from ..runtime.coordinator import _publish_key, has_address_channel
+
+        if not has_address_channel():
+            return
+        try:
+            _publish_key(key, addr, self.secret)
+        except Exception:
+            logger.debug("ckpt: publish %s failed", key, exc_info=True)
+
+    def _publish_held_shard(self, index: int) -> None:
+        """A predecessor started journaling shard ``index`` here: advertise
+        this host as its restore source for a future replacement."""
+        if self.buddy_server is not None:
+            advertise, _ = self._addresses()
+            self._publish("ckpt.shard.%d" % index,
+                          "%s:%d" % (advertise, self.buddy_server.port))
+
+    @staticmethod
+    def _resolve(key: str, timeout: float) -> Optional[Tuple[str, int]]:
+        from ..runtime.coordinator import _resolve_key, has_address_channel
+
+        if not has_address_channel():
+            return None
+        try:
+            addr, _secret = _resolve_key(key, timeout)
+            host, _, port = addr.rpartition(":")
+            return host, int(port)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------ membership
+    def _membership(self) -> Tuple[list, int]:
+        ctrl = self.controller
+        if ctrl is not None:
+            try:
+                return sorted(ctrl.members()), ctrl.epoch()
+            except Exception:
+                pass
+        return list(range(self.world)), 0
+
+    def shard_index(self) -> int:
+        members, _ = self._membership()
+        try:
+            return members.index(self.rank)
+        except ValueError:
+            return self.rank
+
+    # ------------------------------------------------------- commit boundary
+    def on_state_commit(self, state, step: int) -> bool:
+        """Called from ``ElasticState.commit()``. Returns True when a disk
+        snapshot was taken (interval due).
+
+        Sharded mode (``state.mark_sharded`` used): the buddy journal is
+        pushed SYNCHRONOUSLY on every commit — the journal is part of the
+        commit transaction, so a rank's journal head never lags its last
+        commit and a replacement's restore is bit-identical with the
+        survivors' restored snapshots. Disk snapshots stay interval-gated
+        and fully async. Plain DP: every rank already holds the full
+        replica (a lost rank costs nothing unique), so both the
+        byte-partition disk shard and the buddy push are interval-gated."""
+        members, epoch = self._membership()
+        if self.rank not in members:
+            return False
+        index = members.index(self.rank)
+        sharded = sorted(getattr(state, "_sharded", ()) or ())
+        committed = dict(getattr(state, "_committed", {}) or {})
+        due = (self._last_snap_step < 0
+               or step - self._last_snap_step >= self.interval)
+        if sharded:
+            shard_tree: Dict[str, Any] = {
+                "slots": {k: committed[k] for k in sharded
+                          if k in committed},
+                "ef": self._ef_snapshot(),
+            }
+            shard = pack_tree(shard_tree)
+            if due:
+                replica = None
+                if index == 0:
+                    repl = {k: v for k, v in committed.items()
+                            if k not in shard_tree["slots"]}
+                    replica = pack_tree({"slots": repl})
+                self.snapshot(step, epoch, index, shard, replica)
+            if self._buddy_on:
+                self._push_buddy(step, index, shard)
+            return due
+        if not due:
+            return False
+        # plain DP: shard = this slot's exact byte-partition chunk of the
+        # serialized state, so the union of shards IS the checkpoint and
+        # no rank writes O(model) bytes
+        blob = pack_tree({"slots": committed, "ef": self._ef_snapshot()})
+        lo, hi = partition_bounds(len(blob), len(members), index)
+        shard = blob[lo:hi]
+        self.snapshot(step, epoch, index, shard, None)
+        if self._buddy_on:
+            self._push_buddy(step, index, shard)
+        return True
+
+    def snapshot(self, step: int, epoch: int, index: int, shard: bytes,
+                 replica: Optional[bytes] = None) -> None:
+        """Double-buffer one shard snapshot and announce MSG_CKPT_MARK."""
+        with self._lock:
+            self._last_snap_step = step
+        self.writer.submit(step, epoch, index, shard, replica)
+        ctrl = self.controller
+        if ctrl is not None and hasattr(ctrl, "send_ckpt_mark"):
+            ctrl.send_ckpt_mark(step, epoch, index)
+        age = step - self._last_done_step if self._last_done_step >= 0 \
+            else 0
+        instruments.ckpt_bundle_age_steps().set(age)
+
+    # ----------------------------------------------------- writer completion
+    def _on_written(self, step: int, epoch: int, index: int, nbytes: int,
+                    crc: int) -> None:
+        ctrl = self.controller
+        if ctrl is not None and hasattr(ctrl, "send_ckpt_done"):
+            ctrl.send_ckpt_done(step, epoch, index, nbytes, crc)
+        elif self.world == 1:
+            self._finalize_bundle(step, epoch,
+                                  {index: {"nbytes": nbytes, "crc": crc}})
+
+    def _push_buddy(self, step: int, index: int, shard: bytes) -> None:
+        members, _ = self._membership()
+        if len(members) < 2 or time.monotonic() < self._push_retry_at:
+            return
+        succ = members[(members.index(self.rank) + 1) % len(members)] \
+            if self.rank in members else None
+        if succ is None:
+            return
+        client = self._buddy_client
+        if client is None or client.index != index:
+            addr = self._resolve("ckpt.buddy.%d" % succ, timeout=2.0)
+            if addr is None:
+                self._push_retry_at = time.monotonic() + 3.0
+                return
+            if client is not None:
+                client.close()
+            client = buddy_mod.BuddyClient(addr, self.secret, index,
+                                           rank=self.rank)
+            self._buddy_client = client
+        try:
+            client.push(step, shard)
+            self._push_retry_at = 0.0
+        except (ConnectionError, OSError) as exc:
+            logger.debug("ckpt: buddy push to rank %s failed (%s); disk "
+                         "bundle remains the restore source", succ, exc)
+            # drop the cached stream: the successor may come back at a new
+            # address (hot-spare replacement republished ckpt.buddy.N), so
+            # the next push must re-resolve, not redial the corpse
+            client.close()
+            self._buddy_client = None
+
+    # ---------------------------------------------------- bundle finalization
+    def _finalize_bundle(self, step: int, epoch: int,
+                         shards: Dict[int, dict]) -> None:
+        """Rank 0 only (coordinator callback / single-process path): land
+        the manifest — the bundle's atomic commit record."""
+        try:
+            replica = None
+            rp = bundle.replica_path(self.root, step)
+            if os.path.exists(rp):
+                with open(rp, "rb") as f:
+                    data = f.read()
+                replica = {"nbytes": len(data),
+                           "crc": zlib.crc32(data) & 0xFFFFFFFF}
+            bundle.finalize_manifest(self.root, step, epoch, shards,
+                                     replica=replica)
+            bundle.prune_bundles(self.root, keep=_keep_bundles())
+        except Exception:
+            logger.warning("ckpt: manifest finalize for step %d failed",
+                           step, exc_info=True)
+            return
+        self.note_finalized(step)
+        bb = _blackbox.active()
+        if bb is not None:
+            bb.record(_blackbox.K_CKPT, "finalize",
+                      "step=%d epoch=%d shards=%d" % (step, epoch,
+                                                      len(shards)),
+                      self.rank)
+
+    def note_finalized(self, step: int) -> None:
+        with self._lock:
+            if step > self._last_done_step:
+                self._last_done_step = step
+        instruments.ckpt_bundle_age_steps().set(0)
+
+    # ---------------------------------------------------------------- restore
+    def _ef_snapshot(self) -> Dict[str, Any]:
+        ex = self._executor()
+        return ex.residual_state() if ex is not None else {}
+
+    def _ef_load(self, st: Dict[str, Any]) -> None:
+        ex = self._executor()
+        if ex is not None and st:
+            ex.load_residual_state(st)
+
+    @staticmethod
+    def _executor():
+        from .. import basics
+
+        try:
+            ex = getattr(basics._engine(), "_executor", None)
+        except Exception:
+            return None
+        return ex if hasattr(ex, "residual_state") else None
+
+    def fetch_peer_shard(self, index: int,
+                         timeout: float = 3.0) -> Optional[Tuple[int, bytes]]:
+        """The journal head for shard ``index`` from whichever host holds
+        it (O(shard) bytes over the wire), or None."""
+        addr = self._resolve("ckpt.shard.%d" % index, timeout=timeout)
+        if addr is None:
+            return None
+        try:
+            return buddy_mod.fetch_shard(addr, self.secret, index,
+                                         rank=self.rank, timeout=timeout)
+        except (ConnectionError, OSError):
+            return None
+
+    def restore_sharded_slots(self, state) -> bool:
+        """Replacement-rank restore path (called from
+        ``ElasticState.sync`` before the replicated broadcast): install
+        the journal head for this rank's shard slot into the state's
+        sharded slots and the executor's EF residuals. Peer first
+        (O(shard), no disk); the latest complete disk bundle second.
+        Returns True when a shard was restored."""
+        sharded = sorted(getattr(state, "_sharded", ()) or ())
+        if not sharded:
+            return False
+        index = self.shard_index()
+        got = self.fetch_peer_shard(index)
+        source = "peer"
+        journal_head = got[0] if got is not None else -1
+        if got is None:
+            step = bundle.latest_complete_step(self.root)
+            if step is None:
+                return False
+            doc = bundle.read_manifest(self.root, step) or {}
+            members, _ = self._membership()
+            if doc.get("world") != len(members):
+                # shard layout belongs to a different world size; a
+                # mis-sliced restore is worse than a fresh start
+                logger.warning("ckpt: bundle step %d has world=%s, job "
+                               "has %d members — skipping restore",
+                               step, doc.get("world"), len(members))
+                return False
+            try:
+                got = (step, bundle.read_shard(self.root, step, index))
+            except OSError:
+                return False
+            source = "bundle"
+            if doc.get("replica"):
+                # whole-job restart: every rank installs the replicated
+                # slots from the bundle too (identical bytes everywhere,
+                # so the sync broadcast that follows only confirms them)
+                try:
+                    rep = unpack_tree(bundle.read_replica(self.root, step))
+                    for k, v in ((rep or {}).get("slots") or {}).items():
+                        if k in state._values:
+                            state._values[k] = v
+                except OSError:
+                    pass
+        step, data = got
+        tree = unpack_tree(data)
+        slots = (tree or {}).get("slots") or {}
+        for k in sharded:
+            if k in slots:
+                state._values[k] = slots[k]
+        self._ef_load((tree or {}).get("ef") or {})
+        if source == "bundle":
+            # the buddy may still hold a newer journal head we could not
+            # reach; probe once more so stale restores are on the record
+            head = self.fetch_peer_shard(index, timeout=0.5)
+            journal_head = head[0] if head is not None else -1
+        nbytes = len(data)
+        self.last_restore = {"source": source, "step": step,
+                             "journal_head": journal_head,
+                             "index": index, "nbytes": nbytes}
+        bb = _blackbox.active()
+        if bb is not None:
+            name = "peer_restore" if source == "peer" else "restore"
+            bb.record(_blackbox.K_CKPT, name,
+                      "source=%s step=%d journal_head=%d index=%d "
+                      "nbytes=%d" % (source, step, journal_head, index,
+                                     nbytes), self.rank)
+        logger.info("ckpt: restored shard %d from %s (step %d, %d bytes)",
+                    index, source, step, nbytes)
+        return True
+
+    # -------------------------------------------------------------- lifecycle
+    def drain(self, timeout: float = 30.0) -> bool:
+        return self.writer.drain(timeout)
+
+    def stop(self) -> None:
+        self.writer.stop()
+        if self._buddy_client is not None:
+            self._buddy_client.close()
+        if self.buddy_server is not None:
+            self.buddy_server.stop()
+
+
+# ------------------------------------------------------------ module surface
+def active() -> Optional[CkptManager]:
+    """The process's manager, or None when ``HOROVOD_CKPT_DIR`` is unset —
+    the one check every integration point makes, so knobs-unset jobs pay
+    a single attribute read and produce zero new frames."""
+    return _mgr
+
+
+def ensure_manager() -> Optional[CkptManager]:
+    """Build the process manager on first use (idempotent). Reads the
+    runtime's rank/world/controller when initialized; falls back to a
+    single-process manager otherwise (legacy ``checkpoint.save``
+    delegation, benches, unit tests)."""
+    global _mgr
+    root = ckpt_dir()
+    if root is None:
+        return None
+    with _mgr_lock:
+        if _mgr is not None:
+            return _mgr
+        rank, world, ctrl = 0, 1, None
+        from .. import basics
+
+        if basics.is_initialized():
+            rank, world = basics.rank(), basics.size()
+            try:
+                ctrl = basics._engine().controller
+            except Exception:
+                ctrl = None
+        _mgr = CkptManager(root, rank, world, controller=ctrl)
+        basics.register_shutdown_hook(shutdown)
+        return _mgr
+
+
+def shutdown() -> None:
+    global _mgr
+    with _mgr_lock:
+        mgr, _mgr = _mgr, None
+    if mgr is not None:
+        mgr.stop()
+
+
+def load_latest(root: str) -> Optional[Tuple[int, dict]]:
+    """Offline restore helper: the latest complete bundle as
+    ``(step, {"slots": ..., "ef": ...})`` — replica blob merged with every
+    shard's sharded slots (slot layout), or the reassembled byte-partition
+    blob (plain-DP layout)."""
+    step = bundle.latest_complete_step(root)
+    if step is None:
+        return None
+    doc = bundle.read_manifest(root, step) or {}
+    if doc.get("replica"):
+        out: dict = {"slots": {}, "ef": {}}
+        rep = unpack_tree(bundle.read_replica(root, step))
+        out["slots"].update((rep or {}).get("slots") or {})
+        for i in sorted(int(k) for k in doc.get("shards") or {}):
+            tree = unpack_tree(bundle.read_shard(root, step, i))
+            out["slots"].update((tree or {}).get("slots") or {})
+        return step, out
+    return step, unpack_tree(bundle.read_bundle_bytes(root, step))

@@ -1,0 +1,129 @@
+"""Async shard writer: host-memory double buffer + off-path writer thread.
+
+The step path pays only for handing a snapshot over (a buffer swap under a
+lock — ``hvd_checkpoint_stall_seconds`` measures exactly that hand-off and
+must stay ~0); the writer thread owns every byte of disk I/O. Double
+buffering means at most one snapshot is in flight and one pending: a new
+snapshot arriving while the writer is busy REPLACES the pending one (the
+freshest commit wins — trickling a stale snapshot to disk after a newer
+one exists would only age the bundle).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import blackbox as _blackbox
+from ..metrics import instruments
+from . import bundle
+
+logger = logging.getLogger("horovod_tpu.ckpt")
+
+
+class AsyncShardWriter:
+    """Trickles (step, epoch, shard bytes[, replica bytes]) snapshots to
+    ``root/step_{s}/rank_{index}.shard`` off the step path. The shard
+    index rides each submit (not the constructor): a rank's slot in the
+    sorted member list changes across membership epochs, and the writer
+    thread must land the file under the slot current at snapshot time.
+
+    ``on_written(step, epoch, index, nbytes, crc)`` fires from the writer
+    thread after the shard file (and replica blob, when given) landed —
+    the hook the manager uses to send MSG_CKPT_DONE and push the buddy
+    journal.
+    """
+
+    def __init__(self, root: str, on_written: Optional[Callable] = None,
+                 rank: int = 0):
+        self.root = root
+        self.rank = rank
+        self.on_written = on_written
+        self._cv = threading.Condition()
+        self._pending = None       # (step, epoch, index, shard, replica)
+        self._busy = False
+        self._stop = False
+        self.dropped = 0           # pending snapshots replaced before write
+        self.written_steps = 0
+        self._thread = threading.Thread(target=self._run,
+                                        name="hvd_ckpt_writer", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ step path
+    def submit(self, step: int, epoch: int, index: int, shard: bytes,
+               replica: Optional[bytes] = None) -> float:
+        """Hand a committed snapshot to the writer. Never blocks on I/O;
+        returns the seconds the step path spent inside (accounted into
+        ``hvd_checkpoint_stall_seconds``)."""
+        t0 = time.perf_counter()
+        with self._cv:
+            if self._pending is not None:
+                self.dropped += 1
+            self._pending = (step, epoch, index, shard, replica)
+            self._cv.notify()
+        stall = time.perf_counter() - t0
+        instruments.checkpoint_stall_seconds().inc(stall)
+        return stall
+
+    # -------------------------------------------------------- writer thread
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._stop:
+                    self._cv.wait()
+                if self._stop and self._pending is None:
+                    return
+                step, epoch, index, shard, replica = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                t0 = time.perf_counter()
+                nbytes, crc = bundle.write_shard(self.root, step,
+                                                 index, shard)
+                total = nbytes
+                if replica is not None:
+                    rn, _rcrc = bundle.write_replica(self.root, step,
+                                                     replica)
+                    total += rn
+                instruments.checkpoint_bytes().labels(kind="disk").inc(
+                    total)
+                bb = _blackbox.active()
+                if bb is not None:
+                    bb.record(_blackbox.K_CKPT, "snapshot",
+                              "step=%d epoch=%d index=%d nbytes=%d "
+                              "write_s=%.4f" % (step, epoch, index,
+                                                total,
+                                                time.perf_counter() - t0),
+                              self.rank)
+                self.written_steps += 1
+                if self.on_written is not None:
+                    self.on_written(step, epoch, index, nbytes, crc)
+            except Exception:
+                logger.warning("ckpt writer: shard write for step %d "
+                               "failed", step, exc_info=True)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    # ------------------------------------------------------------ lifecycle
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until nothing is pending or in flight (tests, shutdown)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending is not None or self._busy:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+        return True
+
+    def stop(self, drain: bool = True) -> None:
+        if drain:
+            self.drain()
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)

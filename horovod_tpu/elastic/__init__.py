@@ -18,6 +18,14 @@ rank into the elastic ``rank_lost`` path and the machinery in this package
 takes over: epoch bump, barrier release with RANKS_CHANGED, re-rendezvous,
 state re-sync. Transient network blips therefore cost a reconnect instead
 of a full membership reset.
+
+Interplay with checkpointing (docs/checkpoint.md): with ``HOROVOD_CKPT_DIR``
+set, every ``ElasticState.commit()`` doubles as the checkpoint boundary —
+the async bundle writer snapshots this rank's shard off the step path, and
+slots declared via :meth:`ElasticState.mark_sharded` (rank-local ZeRO-1
+state, EF residuals) are journaled to the ring-successor buddy so a
+replacement worker resumes the bit-identical trajectory from an O(shard)
+peer transfer instead of an O(model) broadcast.
 """
 
 from .state import ElasticState, run, run_fn  # noqa: F401

@@ -62,6 +62,20 @@ class ElasticExecutor:
         return float(sum(float(np.abs(r).sum())
                          for r in self._residuals.values()))
 
+    # ---- checkpoint surface (ckpt/manager.py): EF residuals are part of a
+    # rank's shard — dropping them on a restart would silently lose the
+    # gradient mass owed back to the job, so a resumed trajectory could
+    # never be bit-identical with an uninterrupted one
+    def residual_state(self) -> Dict[str, np.ndarray]:
+        """Copy of the EF residual buffers for the checkpoint shard."""
+        return {k: np.array(v, copy=True)
+                for k, v in self._residuals.items()}
+
+    def load_residual_state(self, residuals: Dict[str, np.ndarray]) -> None:
+        """Install restored EF residual buffers (replaces any present)."""
+        self._residuals = {k: np.asarray(v)
+                           for k, v in (residuals or {}).items()}
+
     def execute(self, response: Response,
                 entries_by_rank: Dict[int, List[TensorTableEntry]]):
         rt = response.response_type

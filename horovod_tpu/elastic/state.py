@@ -89,6 +89,9 @@ class ElasticState:
         object.__setattr__(self, "_values", {})
         object.__setattr__(self, "_committed", {})
         object.__setattr__(self, "_reset_count", 0)
+        object.__setattr__(self, "_sharded", set())
+        object.__setattr__(self, "_commit_count", 0)
+        object.__setattr__(self, "_synced", False)
         for k, v in slots.items():
             self._values[k] = v
         # local-only initial snapshot: a restore() before the first commit()
@@ -117,6 +120,21 @@ class ElasticState:
     def slots(self):
         return sorted(self._values)
 
+    def mark_sharded(self, *names: str) -> None:
+        """Declare slots whose value is RANK-LOCAL (a ZeRO-1 optimizer
+        shard, a flat-space partition): ``sync()`` never broadcasts them —
+        each rank keeps its own, and a replacement rank restores its slot
+        from the checkpoint buddy journal in O(shard)
+        (docs/checkpoint.md) instead of an O(model) re-broadcast."""
+        for n in names:
+            if n not in self._values:
+                raise AttributeError(
+                    f"ElasticState has no slot '{n}' to mark sharded")
+            self._sharded.add(n)
+
+    def sharded_slots(self):
+        return sorted(self._sharded)
+
     @property
     def reset_count(self) -> int:
         """How many membership resets this state has synced through."""
@@ -136,6 +154,28 @@ class ElasticState:
         fn = getattr(ctrl, "commit", None)
         if fn is not None:
             fn()
+        self._commit_count += 1
+        self._maybe_checkpoint()
+
+    def _ckpt_step(self) -> int:
+        """The step a checkpoint of this commit is stamped with: the
+        integer ``step`` slot when one exists (the conventional layout),
+        else the running commit count."""
+        step = self._committed.get("step")
+        if isinstance(step, (int, np.integer)):
+            return int(step)
+        return self._commit_count
+
+    def _maybe_checkpoint(self) -> None:
+        import os
+
+        if not os.environ.get("HOROVOD_CKPT_DIR"):
+            return  # subsystem off: commit() behaves exactly as before
+        from .. import ckpt
+
+        mgr = ckpt.ensure_manager()
+        if mgr is not None:
+            mgr.on_state_commit(self, self._ckpt_step())
 
     def restore(self) -> None:
         """Roll every slot back to the last committed snapshot (the partial
@@ -149,7 +189,16 @@ class ElasticState:
         """Re-align all ranks: clear the controller's reset latch, broadcast
         every slot from ``root_rank`` (default: the lowest surviving rank) to
         everyone — joiners receive the committed state, survivors confirm it
-        — then commit the agreed snapshot."""
+        — then commit the agreed snapshot.
+
+        Slots marked via :meth:`mark_sharded` are rank-local and never ride
+        the broadcast: survivors keep their own restored values, and a fresh
+        process (a promoted spare, a whole-job restart) pulls its slot from
+        the checkpoint buddy journal or the latest complete disk bundle
+        before the replicated broadcast runs — O(shard) bytes, not
+        O(model) (docs/checkpoint.md)."""
+        import os
+
         from ..optim.broadcast import broadcast_pytree
 
         ctrl = _controller()
@@ -159,10 +208,21 @@ class ElasticState:
         if root_rank is None:
             members = getattr(ctrl, "members", None)
             root_rank = min(members()) if members is not None else 0
+        sharded = self._sharded & set(self._values)
+        if (sharded and not self._synced
+                and os.environ.get("HOROVOD_CKPT_DIR")):
+            from .. import ckpt
+
+            mgr = ckpt.ensure_manager()
+            if mgr is not None:
+                mgr.restore_sharded_slots(self)
         for key in sorted(self._values):
+            if key in sharded:
+                continue
             self._values[key] = broadcast_pytree(
                 self._values[key], root_rank=root_rank,
                 prefix=f"elastic_sync/{key}")
+        self._synced = True
         self.commit()
 
 

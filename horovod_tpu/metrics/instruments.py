@@ -415,3 +415,30 @@ def serving_kv_tokens():
         "Token slots actually written in the KV cache (live context "
         "payload, vs the block-granular hvd_serving_kv_occupancy).",
         agg="max")
+
+
+def checkpoint_stall_seconds():
+    return get_registry().counter(
+        "hvd_checkpoint_stall_seconds",
+        "Seconds the training step path spent handing snapshots to the "
+        "async checkpoint writer (ckpt/writer.py). The write-behind design "
+        "keeps this ~0; growth means the step path is blocking on "
+        "checkpoint I/O.")
+
+
+def checkpoint_bytes():
+    return get_registry().counter(
+        "hvd_checkpoint_bytes_total",
+        "Checkpoint bytes shipped, by destination: kind=disk (shard + "
+        "replica files landed in HOROVOD_CKPT_DIR) and kind=peer (buddy "
+        "journal payloads to the ring successor).", labels=("kind",))
+
+
+def ckpt_bundle_age_steps():
+    return get_registry().gauge(
+        "hvd_ckpt_bundle_age_steps",
+        "Steps since the last FINALIZED checkpoint bundle (0 right after a "
+        "manifest lands). Sustained age above ~2x HOROVOD_CKPT_INTERVAL "
+        "means shards are being written but bundles never complete — a "
+        "lagging or wedged member (hvddoctor: stale_checkpoint).",
+        agg="max")

@@ -32,7 +32,7 @@ or the one-call helper :func:`horovod_tpu.spmd.make_train_step` with
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
@@ -80,6 +80,21 @@ def ring_chunk(total: int, world: int, block: int) -> int:
     tail."""
     per_rank = -(-total // world)
     return -(-per_rank // block) * block
+
+
+def shard_bounds(total: int, world: int, index: int,
+                 block: int = 1) -> Tuple[int, int]:
+    """Exact ``[lo, hi)`` element bounds of shard ``index`` in a 1/N
+    partition of a ``total``-length flat vector, with ``lo`` aligned to
+    ``block`` boundaries and ``hi`` clamped to ``total`` — the last shard
+    absorbs the ragged tail instead of padding it. With ``block=1`` this
+    is the byte partition the checkpoint bundle uses (ckpt/manager.py):
+    concatenating every shard in slot order reassembles the vector
+    byte-for-byte, no trim step needed. With the quantization block it is
+    the start/stop of the rank's :func:`ring_chunk` region."""
+    per = ring_chunk(total, world, block)
+    lo = min(index * per, total)
+    return lo, min(lo + per, total)
 
 
 def flat_zero1_state(tx, total: int, mesh: Mesh, block: int,

@@ -59,6 +59,21 @@ class ElasticDriver:
         self._procs: Dict[int, RankProcess] = {}  # rank → live process
         self._rank_host: Dict[int, str] = {}
         self._next_rank = 0
+        # hot-spare respawn budget per rank (HOROVOD_ELASTIC_RESPAWN): a
+        # failed worker is relaunched under its ORIGINAL rank id so it
+        # reclaims the same shard slot and restores from its checkpoint
+        # buddy in O(shard) (docs/checkpoint.md). Defaults to 1 when
+        # checkpointing is on and 0 otherwise — without a restore source a
+        # respawn is just the old scale-up with extra steps, and knobs-
+        # unset jobs must behave exactly as before.
+        default_respawn = "1" if os.environ.get("HOROVOD_CKPT_DIR") else "0"
+        try:
+            self.respawn_limit = int(
+                os.environ.get("HOROVOD_ELASTIC_RESPAWN",
+                               default_respawn))
+        except ValueError:
+            self.respawn_limit = 0
+        self._respawns: Dict[int, int] = {}
         self._secret = rendezvous.make_secret()
         self._kv: Optional[rendezvous.KVStoreServer] = None
         self._driver_svc: Optional[DriverService] = None
@@ -83,6 +98,11 @@ class ElasticDriver:
                              self.knob_env)[0]
         env.update(self.extra_env)
         env["HVD_ELASTIC"] = "1"
+        # checkpoint knobs ride through to every worker (a respawned
+        # replacement must see the same bundle dir/buddy config)
+        for k, v in os.environ.items():
+            if k.startswith("HOROVOD_CKPT_"):
+                env.setdefault(k, v)
         if self._driver_svc is not None:
             env["HVD_DRIVER_ADDR"] = self._base_env["driver"]
         out = (f"{self.output_filename}.{rank}"
@@ -113,6 +133,45 @@ class ElasticDriver:
                             local_rank=load.get(h.hostname, 0),
                             local_size=h.slots)
                 load[h.hostname] = load.get(h.hostname, 0) + 1
+
+    def _try_respawn(self, rank: int, failed_host: str) -> bool:
+        """Hot-spare replacement: relaunch a failed worker under its
+        ORIGINAL rank id. The coordinator admits it at the next commit
+        boundary like any joiner, but because the rank (and so its
+        position in the sorted member list) is the same, the replacement
+        reclaims the dead worker's shard slot and restores it from the
+        buddy journal in O(shard) — resuming the job's bit-identical
+        trajectory mid-epoch instead of forcing an O(model) rebuild
+        (ckpt/manager.py, docs/checkpoint.md)."""
+        done = self._respawns.get(rank, 0)
+        if done >= self.respawn_limit:
+            return False
+        self._respawns[rank] = done + 1
+        try:
+            available = self.blacklist.filter(self.discovery.discover())
+        except Exception as exc:
+            logger.warning("host discovery failed during respawn: %s", exc)
+            available = []
+        load = self._host_load()
+        host = None
+        for h in available:
+            if load.get(h.hostname, 0) < h.slots:
+                host = h.hostname
+                break
+        if host is None:
+            # no clean host free: the process died but the machine may be
+            # fine (workload crash, OOM kill) — retry in place
+            host = failed_host
+        logger.warning("respawning rank %d on %s (attempt %d/%d)",
+                       rank, host, self._respawns[rank],
+                       self.respawn_limit)
+        try:
+            self._spawn(rank, host)
+            return True
+        except Exception as exc:
+            logger.error("respawn of rank %d on %s failed: %s",
+                         rank, host, exc)
+            return False
 
     # -------------------------------------------------------------- monitor
     def _merge_reported_failures(self) -> None:
@@ -178,6 +237,8 @@ class ElasticDriver:
                                "continuing with %d workers",
                                rank, host, rc, len(self._procs))
                 self.blacklist.fail(host)
+                if self._try_respawn(rank, host):
+                    continue
                 if len(self._procs) < self.min_np:
                     logger.error(
                         "alive workers (%d) fell below --min-np (%d); "

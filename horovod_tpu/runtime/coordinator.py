@@ -129,6 +129,14 @@ MSG_JOURNAL = 19
 MSG_TBATCH = 23
 MSG_TBATCH_RESP = 24
 MSG_THB = 25
+# async sharded checkpointing (HOROVOD_CKPT_DIR, docs/checkpoint.md):
+# fire-and-forget consistency stamps — MARK announces a rank snapshotted
+# its shard for a step, DONE that the shard file landed on disk; rank 0
+# finalizes the bundle manifest once every member of the SAME step is
+# done. Codecs live in wire.py (wire.MSG_CKPT_*); no frame exists unless
+# the knob is set.
+MSG_CKPT_MARK = wire.MSG_CKPT_MARK
+MSG_CKPT_DONE = wire.MSG_CKPT_DONE
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -316,6 +324,14 @@ class CoordState:
         # escalations the serve thread should report to the elastic driver
         # (host, reason); drained outside the lock
         self._promote_queue: List[Tuple[str, str]] = []
+        # ---- async sharded checkpointing (docs/checkpoint.md): per-step
+        # accumulation of MSG_CKPT_DONE reports; a bundle finalizes only
+        # when every CURRENT member's shard of the same step has landed.
+        # step -> {"epoch": int, "shards": {index: {"nbytes", "crc"}}}
+        self.ckpt_pending: Dict[int, dict] = {}
+        self.ckpt_last_final = -1
+        # set by the rank-0 CkptManager: fn(step, epoch, shards_dict)
+        self.on_ckpt_finalize = None
 
     # ---- client entry: one call per rank per tick
     def exchange(self, rank: int, seq: int, payload: bytes) -> bytes:
@@ -1022,6 +1038,51 @@ class CoordState:
                 f"worker joined: rank(s) {admitted} admitted at commit "
                 "boundary", ranks=admitted)
 
+    # ---- async sharded checkpointing: consistency stamps (fire-and-forget
+    # frames, same interleaving contract as MSG_METRICS)
+    def ckpt_mark(self, rank: int, step: int, epoch: int) -> None:
+        """A member snapshotted its shard for ``step``: open (or refresh)
+        the step's accumulation and surface bundle age. Stamps from a
+        stale epoch are dropped — the sender will re-mark after resync."""
+        with self.cv:
+            if epoch != self.epoch or rank not in self.members:
+                return
+            self.ckpt_pending.setdefault(
+                step, {"epoch": self.epoch, "shards": {}})
+            age = (step - self.ckpt_last_final
+                   if self.ckpt_last_final >= 0 else 0)
+        instruments.ckpt_bundle_age_steps().set(max(0, age))
+
+    def ckpt_done(self, rank: int, step: int, epoch: int, index: int,
+                  nbytes: int, crc: int) -> None:
+        """A member's shard file landed. When every CURRENT member's shard
+        of the same step is in, the bundle finalizes (manifest rename via
+        ``on_ckpt_finalize``) — the only point a bundle becomes
+        restorable."""
+        fire = None
+        with self.cv:
+            if epoch != self.epoch or rank not in self.members:
+                return
+            ent = self.ckpt_pending.setdefault(
+                step, {"epoch": self.epoch, "shards": {}})
+            ent["shards"][index] = {"nbytes": int(nbytes), "crc": int(crc)}
+            if (len(ent["shards"]) >= len(self.members)
+                    and step > self.ckpt_last_final):
+                self.ckpt_last_final = step
+                # older partial steps can never finalize out of order
+                self.ckpt_pending = {s: e for s, e in
+                                     self.ckpt_pending.items() if s > step}
+                fire = (step, ent["epoch"], dict(ent["shards"]))
+        if fire is not None:
+            instruments.ckpt_bundle_age_steps().set(0)
+            cb = self.on_ckpt_finalize
+            if cb is not None:
+                try:
+                    cb(*fire)
+                except Exception:
+                    logger.warning("ckpt: bundle finalize for step %d "
+                                   "failed", fire[0], exc_info=True)
+
     def _reset_locked(self, reason: str, ranks=()) -> None:
         """Bump the membership epoch and drop every piece of state tied to
         the old rank set: pending barriers, negotiated-but-unfetched
@@ -1062,6 +1123,11 @@ class CoordState:
         # EPOCH_SEQ_BASE, so no stale entry could match anyway)
         self.last_resp.clear()
         self.last_data_resp.clear()
+        # checkpoint stamps are epoch-scoped: a bundle mid-flight under the
+        # old member set can never complete (the completeness test is "every
+        # CURRENT member reported"), so pending accumulations are dropped
+        # and the previous complete bundle stays authoritative
+        self.ckpt_pending.clear()
         # straggler counters are meaningless across a membership change
         # (seqs realign, the member set shifts); episode history survives
         # inside the policy for the chronic_straggler doctor signature
@@ -2052,6 +2118,27 @@ class CoordinatorServer:
                         _tracing.store_batch(spans)
                     except Exception:
                         logger.debug("coordinator: bad trace batch from "
+                                     "rank %s", rank, exc_info=True)
+                    continue
+                if mt == MSG_CKPT_MARK:
+                    # fire-and-forget: a member snapshotted its shard
+                    try:
+                        step, epoch, _index = wire.decode_ckpt_mark(payload)
+                        self.state.ckpt_mark(rank, step, epoch)
+                    except Exception:
+                        logger.debug("coordinator: bad ckpt mark from "
+                                     "rank %s", rank, exc_info=True)
+                    continue
+                if mt == MSG_CKPT_DONE:
+                    # fire-and-forget: a member's shard file landed; the
+                    # bundle finalizes here once every member is in
+                    try:
+                        step, epoch, index, nbytes, crc = \
+                            wire.decode_ckpt_done(payload)
+                        self.state.ckpt_done(rank, step, epoch, index,
+                                             nbytes, crc)
+                    except Exception:
+                        logger.debug("coordinator: bad ckpt done from "
                                      "rank %s", rank, exc_info=True)
                     continue
                 if mt == MSG_CLOCK:
@@ -3073,6 +3160,43 @@ class CoordController:
                                 self._rank, payload)
         except (ConnectionError, OSError):
             pass  # the local rank_N.json still exists; only shipping failed
+
+    def send_ckpt_mark(self, step: int, epoch: int, index: int) -> None:
+        """Stamp the checkpoint consistency epoch: fire-and-forget
+        MSG_CKPT_MARK announcing this rank snapshotted its shard for
+        ``step``. Rank 0 owns the state and stamps directly."""
+        if self._rank == 0:
+            if self._state is not None:
+                self._state.ckpt_mark(0, step, epoch)
+            return
+        if self._sock is None:
+            return
+        payload = wire.encode_ckpt_mark(step, epoch, index)
+        try:
+            with self._send_lock:
+                wire.send_frame(self._sock, self._secret, MSG_CKPT_MARK, 0,
+                                self._rank, payload)
+        except (ConnectionError, OSError):
+            pass  # the DONE (or the next mark) will re-stamp
+
+    def send_ckpt_done(self, step: int, epoch: int, index: int,
+                       nbytes: int, crc: int) -> None:
+        """Report this rank's shard file landed (fire-and-forget
+        MSG_CKPT_DONE, sent from the writer thread). The bundle manifest
+        finalizes on rank 0 once every member of the step reported."""
+        if self._rank == 0:
+            if self._state is not None:
+                self._state.ckpt_done(0, step, epoch, index, nbytes, crc)
+            return
+        if self._sock is None:
+            return
+        payload = wire.encode_ckpt_done(step, epoch, index, nbytes, crc)
+        try:
+            with self._send_lock:
+                wire.send_frame(self._sock, self._secret, MSG_CKPT_DONE, 0,
+                                self._rank, payload)
+        except (ConnectionError, OSError):
+            pass  # an unfinalized bundle is pruned later; never fatal
 
     def push_traces(self) -> None:
         """Ship this rank's completed trace spans as a fire-and-forget

@@ -55,6 +55,18 @@ from .coordinator import (MSG_BYE, MSG_JOURNAL, MSG_REPL_HELLO, MSG_SNAPSHOT,
 logger = logging.getLogger("horovod_tpu")
 
 
+def dial_repl(addr, secret: str, rank: int, hello_payload: bytes = b"",
+              timeout: float = 5.0) -> socket.socket:
+    """Open a replication-framed stream: connect and send MSG_REPL_HELLO.
+    The hello payload names the stream's role — empty for a standby
+    coordinator, a subtree tag for a sharded standby, ``push:{index}`` /
+    ``fetch:{index}`` for checkpoint buddy journaling (ckpt/buddy.py)."""
+    sock = socket.create_connection(addr, timeout=timeout)
+    sock.settimeout(0.5)
+    wire.send_frame(sock, secret, MSG_REPL_HELLO, 0, rank, hello_payload)
+    return sock
+
+
 class StandbyCoordinator:
     """Rank 1's warm standby: replicates the primary's durable state and
     promotes itself when the replication stream dies unannounced."""
@@ -102,10 +114,7 @@ class StandbyCoordinator:
 
     # ------------------------------------------------------------ replication
     def _dial(self) -> socket.socket:
-        sock = socket.create_connection(self._addr, timeout=5)
-        sock.settimeout(0.5)
-        wire.send_frame(sock, self._secret, MSG_REPL_HELLO, 0, self._rank)
-        return sock
+        return dial_repl(self._addr, self._secret, self._rank)
 
     def _run(self) -> None:
         sock: Optional[socket.socket] = None

@@ -51,7 +51,8 @@ _FRAME_NAMES = {1: "HELLO", 2: "LIST", 3: "RESP", 4: "BYE", 7: "METRICS",
                 12: "CLOCK_RESP", 13: "BLACKBOX", 14: "BATCH",
                 15: "BATCH_RESP", 16: "BATCH_HB", 17: "REPL_HELLO",
                 18: "SNAPSHOT", 19: "JOURNAL", 20: "SERVE_HELLO",
-                21: "SERVE_SUBMIT", 22: "SERVE_RESULT"}
+                21: "SERVE_SUBMIT", 22: "SERVE_RESULT", 26: "CKPT_MARK",
+                27: "CKPT_DONE"}
 
 
 def _frame_limit() -> int:
@@ -1161,3 +1162,114 @@ def decode_serve_result(buf: bytes):
     error = rd.str()
     latency = rd.f64()
     return request_id, status, tokens, error, latency
+
+
+# --------------------------------------------------------------------------
+# Async sharded checkpointing (MSG_CKPT_MARK / MSG_CKPT_DONE, ids 26/27,
+# docs/checkpoint.md). Both directions are fire-and-forget off the step
+# path: a rank announces the step it is snapshotting with CKPT_MARK, then
+# reports its shard landed on disk with CKPT_DONE; the coordinator stamps
+# the membership epoch on the MARK and finalizes the bundle manifest only
+# when every member shard of the SAME step has reported DONE. Frames are
+# sent only when HOROVOD_CKPT_DIR is set, so knobs-unset jobs keep a
+# byte-identical wire.
+#
+# The buddy-journal stream between shard peers reuses the standby
+# replication framing (MSG_REPL_HELLO / MSG_SNAPSHOT / MSG_JOURNAL frame
+# types) with the shard payloads below; the hello payload distinguishes a
+# pushing owner ("push:{index}") from a fetching replacement
+# ("fetch:{index}").
+# --------------------------------------------------------------------------
+
+MSG_CKPT_MARK = 26
+MSG_CKPT_DONE = 27
+
+
+def _put_bytes(w: Writer, b: bytes) -> None:
+    w.u32(len(b))
+    w.parts.append(bytes(b))
+
+
+def _get_bytes(rd: Reader) -> bytes:
+    n = rd.u32()
+    v = rd.buf[rd.off:rd.off + n]
+    rd.off += n
+    return v
+
+
+def encode_ckpt_mark(step: int, epoch: int, index: int) -> bytes:
+    """A rank began double-buffering its shard for ``step`` under the
+    membership ``epoch`` it observed; ``index`` is its shard slot (its
+    position in the sorted member set)."""
+    w = Writer()
+    w.i64(step)
+    w.i32(epoch)
+    w.i32(index)
+    return w.getvalue()
+
+
+def decode_ckpt_mark(buf: bytes):
+    """Returns (step, epoch, index)."""
+    rd = Reader(buf)
+    return rd.i64(), rd.i32(), rd.i32()
+
+
+def encode_ckpt_done(step: int, epoch: int, index: int, nbytes: int,
+                     crc: int) -> bytes:
+    """The rank's ``step`` shard file landed on disk: ``nbytes`` written,
+    CRC32 ``crc`` — the manifest row the coordinator records."""
+    w = Writer()
+    w.i64(step)
+    w.i32(epoch)
+    w.i32(index)
+    w.i64(nbytes)
+    w.u32(crc & 0xFFFFFFFF)
+    return w.getvalue()
+
+
+def decode_ckpt_done(buf: bytes):
+    """Returns (step, epoch, index, nbytes, crc)."""
+    rd = Reader(buf)
+    return rd.i64(), rd.i32(), rd.i32(), rd.i64(), rd.u32()
+
+
+def encode_shard_snapshot(index: int, step: int, data: bytes) -> bytes:
+    """Buddy-journal full-shard payload (rides MSG_SNAPSHOT): the complete
+    shard bytes for slot ``index`` as of committed ``step``."""
+    w = Writer()
+    w.i32(index)
+    w.i64(step)
+    _put_bytes(w, data)
+    return w.getvalue()
+
+
+def decode_shard_snapshot(buf: bytes):
+    """Returns (index, step, data)."""
+    rd = Reader(buf)
+    return rd.i32(), rd.i64(), _get_bytes(rd)
+
+
+def encode_shard_journal(index: int, step: int, total_len: int,
+                         blocks) -> bytes:
+    """Buddy-journal delta payload (rides MSG_JOURNAL): the byte ranges of
+    slot ``index``'s shard that changed since the last push, as
+    ``(offset, bytes)`` blocks over a shard now ``total_len`` long."""
+    w = Writer()
+    w.i32(index)
+    w.i64(step)
+    w.i64(total_len)
+    w.u32(len(blocks))
+    for off, data in blocks:
+        w.i64(off)
+        _put_bytes(w, data)
+    return w.getvalue()
+
+
+def decode_shard_journal(buf: bytes):
+    """Returns (index, step, total_len, blocks)."""
+    rd = Reader(buf)
+    index = rd.i32()
+    step = rd.i64()
+    total_len = rd.i64()
+    blocks = [(rd.i64(), _get_bytes(rd)) for _ in range(rd.u32())]
+    return index, step, total_len, blocks
