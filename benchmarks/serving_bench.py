@@ -196,11 +196,26 @@ def main(argv=None):
     p99 = float(np.percentile(lats, 99))
     qps = len(lats) / wall
     tok_s = toks / wall
+    # bucketed p99 alongside the exact one: the same estimate Prometheus
+    # consumers (anomaly watch, SLO engine) compute from the histogram
+    # family, so the bench shows the quantization error operators will see
+    from horovod_tpu.metrics import LATENCY_BUCKETS, quantile_from_buckets
+
+    counts = [0] * (len(LATENCY_BUCKETS) + 1)
+    for lat in lats:
+        for i, b in enumerate(LATENCY_BUCKETS):
+            if lat <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    p99_bucketed = quantile_from_buckets(LATENCY_BUCKETS, counts, 0.99)
     print(f"# {len(lats)}/{args.requests} requests in {wall:.2f}s "
           f"({'pod, %d workers' % args.workers if args.workers else 'in-process'})",
           file=sys.stderr)
     print(f"# sustained QPS: {qps:.1f}; tokens/s: {tok_s:.0f}; "
-          f"p50: {p50 * 1e3:.1f}ms; p99: {p99 * 1e3:.1f}ms; lost: {lost}",
+          f"p50: {p50 * 1e3:.1f}ms; p99: {p99 * 1e3:.1f}ms "
+          f"(bucketed: {p99_bucketed * 1e3:.1f}ms); lost: {lost}",
           file=sys.stderr)
     result = {
         "metric": "serving_p99_seconds",

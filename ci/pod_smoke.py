@@ -1302,6 +1302,213 @@ def check_ckpt_kill_restore() -> None:
           f"(sha256 {results[0]['digest'][:12]}…)")
 
 
+def _goodput_chaos_fn():
+    """2-rank elastic job with the goodput ledger, a deliberately
+    unmeetable SLO and the anomaly watch on; the victim hard-kills itself
+    at step 5 and the survivor must come out the other side with nonzero
+    recovery badput, a burning SLO gauge, and an hvdtop snapshot."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import blackbox
+
+    hvd.init()
+    state = hvd.elastic.ElasticState(w=np.array([4.0], np.float32), step=0)
+
+    @hvd.elastic.run_fn
+    def train(state):
+        ctrl = hvd.basics._engine().controller
+        while state.step < 12:
+            if (os.environ.get("HVD_GOODPUT_VICTIM") == "1"
+                    and state.step == 5):
+                os._exit(17)  # hard kill AFTER committing step 5
+            if hvd.rank() == 0 and len(ctrl.members()) < 2:
+                # hold at the commit boundary until the replacement is
+                # admitted — this wait is exactly the wall time the
+                # ledger must attribute, not lose
+                time.sleep(0.1)
+                state.commit()
+                continue
+            g = np.float32(2.0) * (np.asarray(state.w, np.float32) - 1.0)
+            avg = hvd.allreduce(g, name=f"grad{state.step}",
+                                op=hvd.Average)
+            state.w = (np.asarray(state.w, np.float32)
+                       - np.float32(0.05) * np.asarray(avg, np.float32))
+            state.step += 1
+            state.commit()
+        return float(np.asarray(state.w)[0])
+
+    train(state)
+    # let the watch take a few more SLO samples over the settled counters
+    time.sleep(1.5)
+    doc = hvd.metrics()
+    hvdtop = {"rc": None, "out": ""}
+    if hvd.rank() == 0:
+        from horovod_tpu.metrics import server_port
+        port = server_port()
+        if port:
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(hvd.__file__)))
+            r = subprocess.run(
+                [sys.executable, os.path.join(repo, "bin", "hvdtop"),
+                 "--once", "--url", f"http://127.0.0.1:{port}"],
+                capture_output=True, text=True, timeout=30)
+            hvdtop = {"rc": r.returncode, "out": r.stdout}
+    blackbox.dump("goodput chaos postmortem", force=True)
+
+    bad = {}
+    for s in (doc.get("hvd_badput_seconds_total") or {}).get("series") or []:
+        c = (s.get("labels") or {}).get("cause", "?")
+        bad[c] = bad.get(c, 0.0) + float(s.get("value", 0.0))
+    burn = 0.0
+    for s in (doc.get("hvd_slo_burn_rate") or {}).get("series") or []:
+        burn = max(burn, float(s.get("value", 0.0)))
+    return {"badput": bad, "burn": burn, "hvdtop": hvdtop}
+
+
+def check_goodput_chaos() -> None:
+    """Goodput chaos smoke (docs/goodput.md): kill a worker mid-training
+    in a 2-rank elastic job running under an unmeetable HOROVOD_SLO with
+    the anomaly watch on. After the same-rank replacement finishes the
+    job, the survivor's ledger must show nonzero
+    ``hvd_badput_seconds_total{cause="recovery"}``, the SLO burn gauge
+    must be past the fire threshold, ``bin/hvdtop --once`` must render a
+    parseable snapshot off the live endpoint, and ``bin/hvddoctor`` on
+    the blackbox bundle must name the exhausted budget and the dominant
+    badput cause."""
+    import json
+    import pickle
+    import tempfile
+    import time
+
+    import cloudpickle
+
+    from horovod_tpu.run import rendezvous
+
+    bbdir = tempfile.mkdtemp(prefix="hvd_goodput_smoke_bb_")
+    ckptdir = tempfile.mkdtemp(prefix="hvd_goodput_smoke_ckpt_")
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_goodput_chaos_fn, (), {})))
+
+    def spawn(rank, victim):
+        env = dict(os.environ)
+        env.update({
+            "HVD_NUM_PROCS": "2",
+            "HVD_PROCESS_ID": str(rank),
+            "HVD_KV_ADDR": addr,
+            "HVD_SECRET": secret,
+            "HVD_ELASTIC": "1",
+            "HOROVOD_RECONNECT_GRACE": "2",
+            "HOROVOD_CKPT_DIR": ckptdir,
+            "HOROVOD_CKPT_INTERVAL": "1",
+            "HVD_GOODPUT_VICTIM": "1" if victim else "0",
+            # the smoke's SLO is unmeetable by construction (this tiny
+            # job is ~all communication), so the burn gauge must be hot
+            # at dump time and the doctor must have something to name
+            "HOROVOD_SLO": "goodput>=0.99",
+            "HOROVOD_ANOMALY_WATCH": "1",
+            "HOROVOD_ANOMALY_INTERVAL": "0.5",
+            "HOROVOD_METRICS_INTERVAL": "0.5",
+            "HOROVOD_METRICS_PORT": "0" if rank == 0 else "",
+            "HOROVOD_BLACKBOX": "1",
+            "HOROVOD_BLACKBOX_DIR": bbdir,
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "PYTHONPATH": os.pathsep.join(
+                [REPO, os.path.dirname(os.path.abspath(__file__))]),
+        })
+        env.pop("XLA_FLAGS", None)
+        if not env["HOROVOD_METRICS_PORT"]:
+            env.pop("HOROVOD_METRICS_PORT")
+        return subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    procs = [spawn(0, False), spawn(1, True)]
+    replacement = None
+    try:
+        deadline = time.time() + 120
+        while procs[1].poll() is None and time.time() < deadline:
+            time.sleep(0.25)
+        assert procs[1].poll() == 17, (
+            f"victim did not die with its marker code: {procs[1].poll()}")
+        time.sleep(3.0)  # let the reconnect grace declare the rank lost
+        replacement = spawn(1, False)
+
+        blobs = {}
+        deadline = time.time() + 150
+        while time.time() < deadline and len(blobs) < 2:
+            for r in (0, 1):
+                if r not in blobs:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        blobs[r] = blob
+            time.sleep(0.25)
+        assert len(blobs) == 2, (
+            f"job did not finish after the kill; got ranks "
+            f"{sorted(blobs)}, exit codes "
+            f"{[p.poll() for p in procs + [replacement]]}")
+        results = {}
+        for r, blob in blobs.items():
+            ok, payload = pickle.loads(blob)
+            assert ok, f"rank {r} raised:\n{payload}"
+            results[r] = payload
+    finally:
+        for p in procs + ([replacement] if replacement else []):
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
+
+    # every second the kill cost must be on the books as recovery badput
+    bad = results[0]["badput"]
+    assert bad.get("recovery", 0.0) > 0.0, (
+        f"no recovery badput attributed after the kill: {bad}")
+    assert results[0]["burn"] >= 2.0, (
+        f"SLO burn gauge never crossed the fire threshold: {results[0]}")
+
+    top = results[0]["hvdtop"]
+    assert top["rc"] == 0, f"hvdtop --once failed: {top}"
+    assert top["out"].startswith("hvdtop — up="), top["out"][:200]
+    assert "fleet goodput" in top["out"], top["out"][:400]
+    assert "recovery" in top["out"], (
+        f"hvdtop badput stack is missing the recovery cause:\n"
+        f"{top['out'][:600]}")
+
+    # hvddoctor on the bundle: the budget_exhausted detector must name
+    # the exhausted SLO and the dominant badput cause with its ranks
+    for rank in (0, 1):
+        path = os.path.join(bbdir, f"rank_{rank}.json")
+        assert os.path.exists(path), (
+            f"no blackbox dump from rank {rank}; dir has "
+            f"{sorted(os.listdir(bbdir))}")
+    doc = json.load(open(os.path.join(bbdir, "rank_0.json")))
+    assert doc.get("metrics"), "rank 0 dump carries no metrics snapshot"
+    hvddoctor = os.path.join(REPO, "bin", "hvddoctor")
+    d = subprocess.run([sys.executable, hvddoctor, bbdir],
+                       capture_output=True, text=True, timeout=60)
+    assert d.returncode == 0, (
+        f"hvddoctor rejected the bundle:\n{d.stderr[-2000:]}")
+    out = d.stdout
+    assert "error budget burning" in out, (
+        f"doctor did not flag the exhausted budget:\n{out}")
+    assert "dominated by" in out, (
+        f"doctor did not name the dominant badput cause:\n{out}")
+    print("ok: goodput chaos smoke — worker killed at step 5; survivor "
+          f"attributed {bad.get('recovery', 0.0):.2f}s of recovery "
+          f"badput, SLO burn {results[0]['burn']:.0f}x fired, hvdtop "
+          "--once rendered the live snapshot, and hvddoctor named the "
+          "dominant badput cause")
+
+
 def check_tier_rehome() -> None:
     """N-tier control-plane smoke (docs/control-plane.md): a 2-tier tree
     on simulated hosts — 4 fake ranks behind two host-tier
@@ -1439,13 +1646,14 @@ def main():
     check_moe_quantized()
     check_serving_kill()
     check_ckpt_kill_restore()
+    check_goodput_chaos()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
           "+ bucket overlap + blackbox doctor + coordinator failover "
           "+ tier aggregator re-home + straggler adaptive + adaptive wire "
           "+ quantized GSPMD wire + hierarchical collective "
           "+ quantized MoE dispatch + serving worker-kill "
-          "+ checkpoint kill-and-restore valid")
+          "+ checkpoint kill-and-restore + goodput chaos valid")
 
 
 if __name__ == "__main__":

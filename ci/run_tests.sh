@@ -66,6 +66,30 @@ python -m pytest tests/test_tracing.py -q
 stage "doctor: blackbox flight recorder, signatures, hvddoctor, anomaly watch"
 python -m pytest tests/test_blackbox.py -q
 
+stage "goodput: wall-clock attribution ledger, SLO burn alerts, hvdtop"
+python -m pytest tests/test_goodput.py -q
+# acceptance: a real bench run's metrics dump must attribute >= 99% of
+# each rank's wall clock (the ledger's completeness bar, docs/goodput.md)
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    BENCH_IMAGE=32 BENCH_BATCH=2 BENCH_WARMUP=1 BENCH_ROUNDS=1 BENCH_ITERS=2 \
+    python bench.py --metrics-dump /tmp/hvd_ci_goodput.json
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/hvd_ci_goodput.json"))
+walls = {s["labels"]["rank"]: s["value"]
+         for s in doc["hvd_goodput_wall_seconds"]["series"]}
+attributed = {}
+for fam in ("hvd_goodput_seconds_total", "hvd_badput_seconds_total"):
+    for s in doc.get(fam, {}).get("series", []):
+        r = s["labels"]["rank"]
+        attributed[r] = attributed.get(r, 0.0) + s["value"]
+assert walls, "no goodput attribution in the metrics dump"
+for r, wall in walls.items():
+    frac = attributed.get(r, 0.0) / wall if wall else 0.0
+    print(f"rank {r}: {frac:.1%} of {wall:.2f}s attributed")
+    assert frac >= 0.99, f"rank {r} attribution {frac:.1%} < 99%"
+EOF
+
 stage "restart: async sharded checkpointing + peer-redundant recovery"
 python -m pytest tests/test_ckpt.py -q -m "not integration"
 # the write-behind contract is the gate: per-commit stall must stay ~0

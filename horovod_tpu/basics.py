@@ -289,8 +289,13 @@ def shutdown() -> None:
         if _state.engine is not None:
             _state.engine.shutdown()
         _state = _GlobalState()
-        from .metrics import clear_reports, stop_server
+        from .metrics import clear_reports, instruments, stop_server
+        from .goodput import ledger as _goodput_ledger
 
+        # final-flush the attribution ledger and mark the process down
+        # before the endpoint disappears
+        _goodput_ledger.detach()
+        instruments.up().set(0.0)
         stop_server()
         clear_reports()
         # engine shutdown already pushed/drained the final span batches;

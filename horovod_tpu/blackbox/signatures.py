@@ -45,6 +45,9 @@ BITWIDTH_THRASH_FLIPS = 4
 ALGO_THRASH_FLIPS = 4
 #: exclusion episodes for one rank past which it is chronic, not noise
 CHRONIC_STRAGGLER_EPISODES = 3
+#: final fast-window SLO burn rate past which the error budget is being
+#: spent too fast to last the horizon (matches slo.FAST_BURN_THRESHOLD)
+SLO_BURN_EXHAUSTED = 2.0
 
 
 def make_signature(sig_id: str, severity: str, summary: str,
@@ -504,6 +507,58 @@ def detect_stale_checkpoint(bundle) -> List[dict]:
     return sigs
 
 
+def detect_budget_exhausted(bundle) -> List[dict]:
+    """SLO error budget burning at an unsustainable rate at dump time:
+    read the final ``hvd_slo_burn_rate{slo}`` gauges, and when one is at
+    or past the fire threshold, NAME the dominant badput cause (the
+    largest ``hvd_badput_seconds_total{cause}`` bucket, idle excluded
+    unless it is all there is) and the ranks driving it — the doctor's
+    answer to "the SLO alert fired, now what do I fix?"."""
+    burns = {}     # slo -> max burn across ranks' dumps
+    by_cause = {}  # cause -> total seconds
+    by_rank = {}   # (cause, rank) -> seconds
+    for doc in bundle.values():
+        metrics = doc.get("metrics") or {}
+        for series in (metrics.get("hvd_slo_burn_rate") or {}).get(
+                "series") or []:
+            slo = (series.get("labels") or {}).get("slo", "?")
+            v = float(series.get("value", 0.0) or 0.0)
+            burns[slo] = max(burns.get(slo, 0.0), v)
+        for series in (metrics.get("hvd_badput_seconds_total") or {}).get(
+                "series") or []:
+            labels = series.get("labels") or {}
+            cause = labels.get("cause", "?")
+            v = float(series.get("value", 0.0) or 0.0)
+            by_cause[cause] = by_cause.get(cause, 0.0) + v
+            key = (cause, labels.get("rank", "?"))
+            by_rank[key] = by_rank.get(key, 0.0) + v
+    hot = {s: b for s, b in burns.items() if b >= SLO_BURN_EXHAUSTED}
+    if not hot:
+        return []
+    named = {c: v for c, v in by_cause.items()
+             if c != "idle" and v > 0} or by_cause
+    sigs = []
+    for slo in sorted(hot):
+        burn = hot[slo]
+        if named:
+            cause = max(named, key=named.get)
+            ranks = sorted(
+                (r for (c, r) in by_rank if c == cause),
+                key=lambda r: -by_rank[(cause, r)])[:4]
+            detail = (", dominated by %s (%.1fs, rank(s) %s)"
+                      % (cause, named[cause], ranks))
+        else:
+            cause, ranks, detail = None, [], ""
+        sigs.append(make_signature(
+            "budget_exhausted", SEV_WARNING,
+            "SLO %s error budget burning %.1fx faster than sustainable "
+            "at dump time%s" % (slo, burn, detail),
+            slo=slo, burn_rate=burn, dominant_cause=cause,
+            driving_ranks=ranks,
+            badput_seconds={c: round(v, 3) for c, v in by_cause.items()}))
+    return sigs
+
+
 #: every event-based detector the doctor runs, in reporting order
 DETECTORS = (
     detect_collective_deadlock,
@@ -520,6 +575,7 @@ DETECTORS = (
     detect_bitwidth_thrash,
     detect_algorithm_thrash,
     detect_stale_checkpoint,
+    detect_budget_exhausted,
 )
 
 

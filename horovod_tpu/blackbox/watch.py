@@ -88,7 +88,7 @@ class AnomalyWatch:
     snapshot dict, so tests drive it synchronously without the thread."""
 
     def __init__(self, interval=None, window=None, factor=None,
-                 min_samples=None):
+                 min_samples=None, slo_engine=None):
         self.interval = (interval if interval is not None
                          else _env_float("HOROVOD_ANOMALY_INTERVAL", 5.0))
         window = (int(window) if window is not None
@@ -102,6 +102,11 @@ class AnomalyWatch:
             for name, floor in SIGNALS}
         self._active = {name: False for name, _ in SIGNALS}
         self._ckpt_active = False
+        if slo_engine is None:
+            from ..goodput.slo import SLOEngine
+
+            slo_engine = SLOEngine.from_env()
+        self._slo = slo_engine
         self._prev = {}          # cumulative-counter memory between samples
         self._samples = 0
         self._signatures = []    # most recent detections (healthz surface)
@@ -172,18 +177,10 @@ class AnomalyWatch:
         if (prev is None or len(prev) != len(counts)
                 or sum(counts) < sum(prev)):  # first sample / reset
             return None
+        from ..metrics import quantile_from_buckets
+
         delta = [max(0.0, a - b) for a, b in zip(counts, prev)]
-        total = sum(delta)
-        if total <= 0:
-            return None
-        acc = 0.0
-        for i, d in enumerate(delta):
-            acc += d
-            if acc >= 0.99 * total:
-                # overflow slot: report past the largest finite bound
-                return (buckets[i] if i < len(buckets)
-                        else buckets[-1] * 2.0 if buckets else None)
-        return buckets[-1] * 2.0 if buckets else None
+        return quantile_from_buckets(buckets, delta, 0.99)
 
     # ------------------------------------------------------------ decision
     def observe_snapshot(self, snapshot) -> list:
@@ -223,8 +220,44 @@ class AnomalyWatch:
                 instruments.anomaly_active().labels(signal=name).set(
                     1 if anomalous else 0)
         fired.extend(self._check_ckpt_age(snapshot))
+        fired.extend(self._check_slo(snapshot))
         if fired:
             self._signatures = (self._signatures + fired)[-16:]
+        return fired
+
+    def _check_slo(self, snapshot) -> list:
+        """Multi-window burn-rate evaluation of the declarative HOROVOD_SLO
+        objectives (docs/goodput.md): the SLO engine turns each sample into
+        per-objective bad-fractions; fire/clear edges become signatures and
+        ``hvd_anomaly_active{signal="slo:<name>"}`` transitions here."""
+        from ..metrics import instruments
+
+        if self._slo is None:
+            return []
+        fired = []
+        for ev in self._slo.observe(snapshot):
+            signal = "slo:%s" % ev["slo"]
+            if ev["event"] == "fire":
+                sig = make_signature(
+                    "slo_burn_rate", SEV_WARNING,
+                    "SLO %s burning error budget %.1fx too fast "
+                    "(slow window %.1fx, objective %s%s%g) — see "
+                    "hvddoctor budget_exhausted for the dominant cause"
+                    % (ev["slo"], ev["burn_fast"], ev["burn_slow"],
+                       ev["slo"], ev.get("op", ""), ev["bound"]),
+                    slo=ev["slo"], burn_fast=ev["burn_fast"],
+                    burn_slow=ev["burn_slow"], bound=ev["bound"],
+                    related="budget_exhausted")
+                fired.append(sig)
+                logger.warning("anomaly watch: %s", sig["summary"])
+                _record(K_ANOMALY, signal, sig["summary"])
+                instruments.anomaly_active().labels(signal=signal).set(1)
+            else:
+                logger.info("anomaly watch: SLO %s burn recovered "
+                            "(%.2fx)", ev["slo"], ev["burn_fast"])
+                _record(K_ANOMALY, signal,
+                        "slo %s burn recovered" % ev["slo"])
+                instruments.anomaly_active().labels(signal=signal).set(0)
         return fired
 
     def _check_ckpt_age(self, snapshot) -> list:
@@ -266,11 +299,14 @@ class AnomalyWatch:
 
     def state(self) -> dict:
         """Healthz surface: which signals are currently anomalous."""
-        return {"running": self._thread is not None
-                and self._thread.is_alive(),
-                "samples": self._samples,
-                "active": {k: v for k, v in self._active.items() if v},
-                "recent": [s["summary"] for s in self._signatures[-4:]]}
+        doc = {"running": self._thread is not None
+               and self._thread.is_alive(),
+               "samples": self._samples,
+               "active": {k: v for k, v in self._active.items() if v},
+               "recent": [s["summary"] for s in self._signatures[-4:]]}
+        if self._slo is not None:
+            doc["slo"] = self._slo.state()
+        return doc
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:

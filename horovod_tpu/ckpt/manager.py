@@ -203,6 +203,17 @@ class CkptManager:
         and fully async. Plain DP: every rank already holds the full
         replica (a lost rank costs nothing unique), so both the
         byte-partition disk shard and the buddy push are interval-gated."""
+        from ..goodput import ledger as _goodput
+
+        led = _goodput.active()
+        span = led.begin("checkpoint") if led is not None else None
+        try:
+            return self._on_state_commit(state, step)
+        finally:
+            if led is not None:
+                led.end(span)
+
+    def _on_state_commit(self, state, step: int) -> bool:
         members, epoch = self._membership()
         if self.rank not in members:
             return False
@@ -369,6 +380,17 @@ class CkptManager:
         sharded slots and the executor's EF residuals. Peer first
         (O(shard), no disk); the latest complete disk bundle second.
         Returns True when a shard was restored."""
+        from ..goodput import ledger as _goodput
+
+        led = _goodput.active()
+        span = led.begin("checkpoint") if led is not None else None
+        try:
+            return self._restore_sharded_slots(state)
+        finally:
+            if led is not None:
+                led.end(span)
+
+    def _restore_sharded_slots(self, state) -> bool:
         sharded = sorted(getattr(state, "_sharded", ()) or ())
         if not sharded:
             return False

@@ -92,6 +92,8 @@ class ElasticState:
         object.__setattr__(self, "_sharded", set())
         object.__setattr__(self, "_commit_count", 0)
         object.__setattr__(self, "_synced", False)
+        object.__setattr__(self, "_in_recovery", False)
+        object.__setattr__(self, "_last_commit_t", None)
         for k, v in slots.items():
             self._values[k] = v
         # local-only initial snapshot: a restore() before the first commit()
@@ -155,6 +157,9 @@ class ElasticState:
         if fn is not None:
             fn()
         self._commit_count += 1
+        import time as _time
+
+        self._last_commit_t = _time.monotonic()
         self._maybe_checkpoint()
 
     def _ckpt_step(self) -> int:
@@ -199,31 +204,64 @@ class ElasticState:
         O(model) (docs/checkpoint.md)."""
         import os
 
+        from ..goodput import ledger as _goodput
         from ..optim.broadcast import broadcast_pytree
 
-        ctrl = _controller()
-        resume = getattr(ctrl, "resume", None)
-        if resume is not None:
-            resume()
-        if root_rank is None:
-            members = getattr(ctrl, "members", None)
-            root_rank = min(members()) if members is not None else 0
-        sharded = self._sharded & set(self._values)
-        if (sharded and not self._synced
-                and os.environ.get("HOROVOD_CKPT_DIR")):
-            from .. import ckpt
+        led = _goodput.active()
+        # a sync after a membership reset is recovery time; the ordinary
+        # first sync of a stable job is a (short) stall
+        span = None
+        if led is not None:
+            span = led.begin(
+                "recovery" if self._in_recovery else "stall")
+        try:
+            ctrl = _controller()
+            resume = getattr(ctrl, "resume", None)
+            if resume is not None:
+                resume()
+            if root_rank is None:
+                members = getattr(ctrl, "members", None)
+                root_rank = min(members()) if members is not None else 0
+            sharded = self._sharded & set(self._values)
+            if (sharded and not self._synced
+                    and os.environ.get("HOROVOD_CKPT_DIR")):
+                from .. import ckpt
 
-            mgr = ckpt.ensure_manager()
-            if mgr is not None:
-                mgr.restore_sharded_slots(self)
-        for key in sorted(self._values):
-            if key in sharded:
-                continue
-            self._values[key] = broadcast_pytree(
-                self._values[key], root_rank=root_rank,
-                prefix=f"elastic_sync/{key}")
-        self._synced = True
-        self.commit()
+                mgr = ckpt.ensure_manager()
+                if mgr is not None:
+                    mgr.restore_sharded_slots(self)
+            for key in sorted(self._values):
+                if key in sharded:
+                    continue
+                self._values[key] = broadcast_pytree(
+                    self._values[key], root_rank=root_rank,
+                    prefix=f"elastic_sync/{key}")
+            self._synced = True
+            self.commit()
+            self._in_recovery = False
+        finally:
+            if led is not None:
+                led.end(span)
+
+
+def _note_lost_work(state) -> None:
+    """Charge the work discarded by a reset to the goodput ledger: the wall
+    time since the last commit is exactly the partial progress restore()
+    throws away (lost-steps x step-time without needing a step clock). The
+    entry is *synthetic* — counter-only, outside the rank's wall-clock
+    budget — because those seconds were already attributed live as compute/
+    comm while they happened (docs/goodput.md)."""
+    import time as _time
+
+    from ..goodput import ledger as _goodput
+
+    led = _goodput.active()
+    t = state._last_commit_t
+    if led is None or t is None:
+        return
+    lost = _time.monotonic() - t
+    if lost > 0:
+        led.add("recovery", lost, synthetic=True)
 
 
 def run_fn(func):
@@ -245,9 +283,11 @@ def run_fn(func):
                 return func(state, *args, **kwargs)
             except RanksChangedError as exc:
                 state._reset_count += 1
+                state._in_recovery = True
                 logger.warning(
                     "elastic reset #%d (%s): restoring last commit and "
                     "re-syncing", state.reset_count, exc)
+                _note_lost_work(state)
                 state.restore()
 
     return wrapper

@@ -1,0 +1,24 @@
+"""Goodput: wall-clock attribution, SLO burn rates, the hvdtop console.
+
+Three pieces (docs/goodput.md):
+
+* :mod:`.ledger` — the per-rank time-attribution ledger classifying every
+  wall-clock second into compute / exposed_comm / stall / checkpoint /
+  recovery / excluded / idle, exported as rank-labeled counters that ride
+  the existing MSG_METRICS shipping and cross-rank merge.
+* :mod:`.slo` — declarative objectives (``HOROVOD_SLO``) with error
+  budgets and multi-window burn-rate evaluation, run by the anomaly
+  watch; burn feeds ``hvd_slo_burn_rate{slo}`` and the hvddoctor
+  ``budget_exhausted`` signature.
+* :mod:`.console` — ``bin/hvdtop``, the live console over /metrics.
+"""
+
+from .ledger import (BADPUT_CAUSES, COMPUTE, STATES, GoodputLedger, active,
+                     attach, detach, enabled, reset_for_tests)
+from .slo import Objective, SLOEngine, parse_slos
+
+__all__ = [
+    "BADPUT_CAUSES", "COMPUTE", "STATES", "GoodputLedger", "active",
+    "attach", "detach", "enabled", "reset_for_tests",
+    "Objective", "SLOEngine", "parse_slos",
+]

@@ -1,0 +1,186 @@
+"""hvdtop — a live console over /metrics + /healthz (docs/goodput.md).
+
+Scrapes the Prometheus endpoint the job already serves
+(``HOROVOD_METRICS_PORT``) and renders fleet goodput, the badput stack,
+a per-rank state strip, active SLO burn rates, and the anomaly-watch /
+liveness state.  Pure-renderer design: ``render(samples, health)`` is a
+function from parsed scrape output to a string, so tests and ``--once``
+(CI / pipes) share the exact code path the live loop draws with.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+from ..metrics import parse_prometheus
+
+#: display order + one-glyph code for the per-rank strip
+STATE_GLYPHS = (("compute", "C"), ("exposed_comm", "x"), ("stall", "S"),
+                ("checkpoint", "k"), ("recovery", "R"), ("excluded", "E"),
+                ("idle", "."))
+
+
+def scrape(url, timeout=10):
+    """(samples, health) from a running job's endpoint base URL."""
+    body = urllib.request.urlopen(url + "/metrics", timeout=timeout) \
+        .read().decode()
+    samples = parse_prometheus(body)
+    try:
+        health = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=timeout).read().decode())
+    except Exception:
+        health = {}
+    return samples, health
+
+
+def _labeled(samples, name):
+    """[(labels_dict, value)] for one sample family."""
+    out = []
+    for key, value in (samples.get(name) or {}).items():
+        out.append((dict(key), value))
+    return out
+
+
+def _bar(frac, width=30):
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "-" * (width - n)
+
+
+def _per_rank_states(samples):
+    """rank -> {state: seconds} from the goodput/badput counters."""
+    ranks = {}
+    for labels, value in _labeled(samples, "hvd_goodput_seconds_total"):
+        r = labels.get("rank", "?")
+        ranks.setdefault(r, {})["compute"] = \
+            ranks.get(r, {}).get("compute", 0.0) + value
+    for labels, value in _labeled(samples, "hvd_badput_seconds_total"):
+        r = labels.get("rank", "?")
+        cause = labels.get("cause", "idle")
+        ranks.setdefault(r, {})[cause] = \
+            ranks.get(r, {}).get(cause, 0.0) + value
+    return ranks
+
+
+def render(samples, health=None, width=72):
+    """One snapshot as plain text.  Raises nothing on partial data — a
+    job without the goodput family still renders the liveness header."""
+    health = health or {}
+    lines = []
+    now = time.time()
+    up = sum(v for _, v in _labeled(samples, "hvd_up"))
+    stamp = max((v for _, v in _labeled(samples,
+                                        "hvd_snapshot_unix_seconds")),
+                default=None)
+    age = (now - stamp) if stamp else None
+    head = "hvdtop — up=%s" % (int(up) if up else 0)
+    if age is not None:
+        head += "  snapshot age %.1fs%s" % (
+            age, "  [WEDGED?]" if age > 60 else "")
+    status = health.get("status")
+    if status:
+        head += "  health=%s" % status
+    lines.append(head)
+
+    ranks = _per_rank_states(samples)
+    total = {s: 0.0 for s, _ in STATE_GLYPHS}
+    for states in ranks.values():
+        for s, v in states.items():
+            total[s] = total.get(s, 0.0) + v
+    wall = sum(total.values())
+    if wall > 0:
+        goodput = total.get("compute", 0.0) / wall
+        lines.append("")
+        lines.append("fleet goodput %5.1f%%  [%s]  (%.1fs attributed, "
+                     "%d ranks)" % (100.0 * goodput, _bar(goodput),
+                                    wall, len(ranks)))
+        lines.append("badput stack:")
+        for state, _ in STATE_GLYPHS:
+            if state == "compute":
+                continue
+            frac = total.get(state, 0.0) / wall
+            lines.append("  %-12s %5.1f%%  [%s]  %.2fs"
+                         % (state, 100.0 * frac, _bar(frac),
+                            total.get(state, 0.0)))
+        lines.append("per-rank (dominant state / goodput%):")
+        for r in sorted(ranks, key=lambda x: (len(x), x)):
+            states = ranks[r]
+            rw = sum(states.values())
+            dom = max(states, key=states.get) if states else "idle"
+            glyph = dict(STATE_GLYPHS).get(dom, "?")
+            ratio = states.get("compute", 0.0) / rw if rw > 0 else 0.0
+            lines.append("  rank %-4s %s %-12s %5.1f%%  [%s]"
+                         % (r, glyph, dom, 100.0 * ratio, _bar(ratio)))
+    else:
+        lines.append("")
+        lines.append("no goodput attribution yet (hvd_goodput_seconds_"
+                     "total absent — ledger off or first flush pending)")
+
+    burns = _labeled(samples, "hvd_slo_burn_rate")
+    if burns:
+        lines.append("SLO burn (fast window; 1.0 = at budget):")
+        for labels, value in sorted(burns,
+                                    key=lambda kv: kv[0].get("slo", "")):
+            mark = "  ALERT" if value >= 2.0 else ""
+            lines.append("  %-12s burn %6.2f%s"
+                         % (labels.get("slo", "?"), value, mark))
+
+    anomalies = [(labels.get("signal", "?"), v) for labels, v
+                 in _labeled(samples, "hvd_anomaly_active") if v > 0]
+    if anomalies:
+        lines.append("active anomalies: "
+                     + ", ".join(sorted(s for s, _ in anomalies)))
+    watch = health.get("anomaly_watch") or {}
+    for summary in (watch.get("recent") or [])[-4:]:
+        lines.append("  recent: %s" % str(summary)[: width - 10])
+    slo = watch.get("slo") or {}
+    for name in slo.get("alerting") or []:
+        lines.append("  slo alerting: %s" % name)
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hvdtop",
+        description="live goodput console for a running horovod_tpu job")
+    ap.add_argument("--url", default=None,
+                    help="endpoint base URL (default http://127.0.0.1:PORT)")
+    ap.add_argument("--port", type=int, default=9400,
+                    help="metrics port when --url is not given")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds in live mode")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit (CI / pipes)")
+    args = ap.parse_args(argv)
+    url = args.url or f"http://127.0.0.1:{args.port}"
+    url = url.rstrip("/")
+    try:
+        while True:
+            try:
+                samples, health = scrape(url)
+            except Exception as exc:
+                if args.once:
+                    print(f"hvdtop: cannot scrape {url}: {exc}",
+                          file=sys.stderr)
+                    return 1
+                sys.stdout.write(f"\x1b[2J\x1b[Hhvdtop: waiting for {url} "
+                                 f"({exc})\n")
+                sys.stdout.flush()
+                time.sleep(args.interval)
+                continue
+            text = render(samples, health)
+            if args.once:
+                sys.stdout.write(text)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + text)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

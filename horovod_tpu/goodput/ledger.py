@@ -1,0 +1,290 @@
+"""The goodput ledger: attribute every wall-clock second (docs/goodput.md).
+
+One ledger per process classifies this rank's wall time since attach into
+an exhaustive, non-overlapping state set:
+
+* ``compute``      — inside the optimizer update (useful work);
+* ``exposed_comm`` — blocked in ``synchronize()`` on a collective that
+  completed (communication not hidden behind compute, the PR 6 signal);
+* ``stall``        — blocked on a collective that FAILED the enforced
+  watchdog, or re-synchronizing elastic state;
+* ``checkpoint``   — checkpoint commit stall + shard restore (PR 17);
+* ``recovery``     — elastic rebuild after a membership change: restore,
+  re-sync, plus the synthetic lost-steps x recent-step-time estimate;
+* ``excluded``     — straggler-policy exclusion episodes (PR 12);
+* ``idle``         — everything else (computed residually at flush).
+
+Accounting is span-based with nesting: an inner span's time is subtracted
+from its enclosing span, so ``synchronize()`` inside the optimizer update
+lands in ``exposed_comm``, not ``compute``.  Open spans are sliced at
+every flush so the running state is always attributed up to "now" —
+which keeps every exported total monotone (they feed counters).
+
+The ledger writes rank-labeled counters in the process registry
+(``hvd_goodput_seconds_total{rank}`` / ``hvd_badput_seconds_total{cause,
+rank}``) so attribution ships to rank 0 on the existing MSG_METRICS
+cadence and merges across ranks for free.  Foreign-rank attributions
+(rank 0 observing another rank's exclusion episode) carry that rank's
+label but never count toward this process's own wall budget.
+
+Zero-overhead discipline: ``active()`` is a single ``None`` check when
+the ledger is off (``HOROVOD_GOODPUT=0``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..metrics import instruments
+
+#: The exhaustive state set, in display order.
+COMPUTE = "compute"
+BADPUT_CAUSES = ("exposed_comm", "stall", "checkpoint", "recovery",
+                 "excluded", "idle")
+STATES = (COMPUTE,) + BADPUT_CAUSES
+
+
+class _Span:
+    """One open attribution interval on some thread's span stack."""
+
+    __slots__ = ("state", "start", "inner", "sliced", "tid")
+
+    def __init__(self, state, start, tid):
+        self.state = state
+        self.start = start
+        self.inner = 0.0   # wall time covered by already-closed children
+        self.sliced = 0.0  # net time already attributed by flush slicing
+        self.tid = tid
+
+
+class _SpanCtx:
+    """``with ledger.span("checkpoint"): ...`` convenience wrapper."""
+
+    __slots__ = ("_ledger", "_state", "_span")
+
+    def __init__(self, ledger, state):
+        self._ledger = ledger
+        self._state = state
+        self._span = None
+
+    def __enter__(self):
+        self._span = self._ledger.begin(self._state)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        self._ledger.end(self._span)
+        return False
+
+
+class GoodputLedger:
+    def __init__(self, rank=0, clock=time.monotonic):
+        self._rank = int(rank)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0 = clock()
+        self._acc = {s: 0.0 for s in STATES}      # self wall attribution
+        self._foreign = {}                         # (cause, rank) -> secs
+        self._ticked = {}                          # counter high-water marks
+        self._stacks = {}                          # thread id -> [_Span]
+        self._excl_start = {}                      # rank -> episode start
+        self._last = {"wall": 0.0, "ratio": 1.0,
+                      "states": {s: 0.0 for s in STATES}}
+        self._stopped = False
+
+    @property
+    def rank(self):
+        return self._rank
+
+    def set_rank(self, rank):
+        self._rank = int(rank)
+
+    # -- span accounting ---------------------------------------------------
+    def begin(self, state):
+        if state not in STATES:
+            raise ValueError(f"unknown goodput state {state!r}")
+        tid = threading.get_ident()
+        sp = _Span(state, self._clock(), tid)
+        with self._lock:
+            self._stacks.setdefault(tid, []).append(sp)
+        return sp
+
+    def end(self, span, state=None):
+        """Close a span; ``state`` overrides the one it opened with (the
+        synchronize() hook decides stall-vs-exposed_comm on the way out)."""
+        if span is None:
+            return
+        if state is not None:
+            span.state = state
+        now = self._clock()
+        with self._lock:
+            stack = self._stacks.get(span.tid, [])
+            if span in stack:
+                # close any children left open by a non-local exit
+                while stack and stack[-1] is not span:
+                    self._close_locked(stack.pop(), now)
+                stack.pop()
+                self._close_locked(span, now)
+            if not stack:
+                self._stacks.pop(span.tid, None)
+
+    def _close_locked(self, span, now):
+        dt = now - span.start
+        net = max(0.0, dt - span.inner - span.sliced)
+        self._acc[span.state] += net
+        tid_stack = self._stacks.get(span.tid)
+        if tid_stack:
+            tid_stack[-1].inner += dt
+
+    def span(self, state):
+        return _SpanCtx(self, state)
+
+    # -- direct attribution ------------------------------------------------
+    def add(self, cause, seconds, rank=None, synthetic=False):
+        """Attribute ``seconds`` to ``cause`` directly.
+
+        ``rank`` other than our own records a foreign-rank observation
+        (counter only — never part of this process's wall budget), as does
+        ``synthetic=True`` (estimated time, e.g. lost-steps x step-time:
+        it overlaps real wall time and must not double-count)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            if rank is not None and int(rank) != self._rank:
+                key = (cause, int(rank))
+                self._foreign[key] = self._foreign.get(key, 0.0) + seconds
+            elif synthetic:
+                key = (cause, self._rank)
+                self._foreign[key] = self._foreign.get(key, 0.0) + seconds
+            else:
+                self._acc[cause] += seconds
+
+    def note_excluded(self, rank, excluded):
+        """Straggler-policy episode edge (rank 0 observes): start or close
+        an exclusion timer for ``rank``; open episodes slice at flush."""
+        now = self._clock()
+        with self._lock:
+            if excluded:
+                self._excl_start.setdefault(int(rank), now)
+            else:
+                start = self._excl_start.pop(int(rank), None)
+                if start is not None and now > start:
+                    key = ("excluded", int(rank))
+                    self._foreign[key] = (self._foreign.get(key, 0.0)
+                                          + (now - start))
+
+    # -- flush -------------------------------------------------------------
+    def flush(self):
+        """Slice open spans, recompute idle, and tick the delta of every
+        total into the registry counters.  Called on the engine metrics
+        cadence, lazily from ``metrics.local_snapshot()``, and at stop."""
+        now = self._clock()
+        with self._lock:
+            # attribute each thread's RUNNING state up to now
+            for stack in self._stacks.values():
+                if not stack:
+                    continue
+                top = stack[-1]
+                cur = max(0.0, (now - top.start) - top.inner - top.sliced)
+                if cur > 0:
+                    self._acc[top.state] += cur
+                    top.sliced += cur
+            # slice open exclusion episodes
+            for rank in list(self._excl_start):
+                start = self._excl_start[rank]
+                if now > start:
+                    key = ("excluded", int(rank))
+                    self._foreign[key] = (self._foreign.get(key, 0.0)
+                                          + (now - start))
+                    self._excl_start[rank] = now
+            wall = max(1e-9, now - self._t0)
+            attributed = sum(v for s, v in self._acc.items() if s != "idle")
+            self._acc["idle"] = max(self._acc["idle"], wall - attributed)
+            ratio = min(1.0, self._acc[COMPUTE] / wall)
+            self._last = {"wall": wall, "ratio": ratio,
+                          "states": dict(self._acc)}
+            ticks = []
+            me = str(self._rank)
+            for state, total in self._acc.items():
+                delta = total - self._ticked.get(state, 0.0)
+                if delta > 1e-9:
+                    ticks.append((state, me, delta))
+                    self._ticked[state] = total
+            for (cause, rank), total in self._foreign.items():
+                key = (cause, int(rank))
+                delta = total - self._ticked.get(key, 0.0)
+                if delta > 1e-9:
+                    ticks.append((cause, str(rank), delta))
+                    self._ticked[key] = total
+        # registry writes outside our lock (they take their own); touch the
+        # families first so scrapes render them before any work happens
+        instruments.goodput_seconds().labels(rank=me).inc(0.0)
+        instruments.badput_seconds().labels(cause="idle", rank=me).inc(0.0)
+        for state, rank, delta in ticks:
+            if state == COMPUTE:
+                instruments.goodput_seconds().labels(rank=rank).inc(delta)
+            else:
+                instruments.badput_seconds().labels(
+                    cause=state, rank=rank).inc(delta)
+        instruments.goodput_ratio().labels(rank=me).set(self._last["ratio"])
+        instruments.goodput_wall_seconds().labels(rank=me).set(
+            self._last["wall"])
+        return self._last
+
+    def summary(self):
+        """Last-flushed attribution: ``{"wall", "ratio", "states"}``."""
+        return self.flush()
+
+    def stop(self):
+        """Final flush; further spans are still accepted (harmless)."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.flush()
+
+
+# -- process singleton -------------------------------------------------------
+
+_LEDGER = None
+_LEDGER_LOCK = threading.Lock()
+
+
+def enabled():
+    return os.environ.get("HOROVOD_GOODPUT", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def active():
+    """The attached ledger, or None — the hot-path fast check."""
+    return _LEDGER
+
+
+def attach(rank=0):
+    """Create (or update the rank of) the process ledger; None when
+    HOROVOD_GOODPUT=0.  Idempotent — the engine calls it at init."""
+    global _LEDGER
+    if not enabled():
+        return None
+    with _LEDGER_LOCK:
+        if _LEDGER is None:
+            _LEDGER = GoodputLedger(rank=rank)
+        else:
+            _LEDGER.set_rank(rank)
+        return _LEDGER
+
+
+def detach():
+    """Final-flush and drop the ledger (shutdown / tests)."""
+    global _LEDGER
+    with _LEDGER_LOCK:
+        led, _LEDGER = _LEDGER, None
+    if led is not None:
+        led.stop()
+
+
+def reset_for_tests():
+    global _LEDGER
+    with _LEDGER_LOCK:
+        _LEDGER = None

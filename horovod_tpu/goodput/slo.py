@@ -1,0 +1,235 @@
+"""Declarative SLOs with error budgets and multi-window burn-rate alerts.
+
+Grammar (``HOROVOD_SLO``): a comma-separated list of objectives,
+
+    HOROVOD_SLO=goodput>=0.9,step_p99<=0.5,serving_p99<=0.25
+
+* ``goodput >= R``      — fraction of fleet wall-clock spent computing
+  (from the goodput ledger counters); the error budget is ``1 - R``.
+* ``step_p99 <= S``     — training-step latency bound in seconds, judged
+  per interval from ``hvd_allreduce_latency_seconds`` bucket deltas; the
+  budget is the implied 1% of observations allowed over the bound.
+* ``serving_p99 <= S``  — same, over ``hvd_serving_request_latency_
+  seconds{stage="total"}``.
+
+Each observation interval (the anomaly-watch cadence) produces a
+*bad fraction* in [0, 1] per objective — the share of that interval's
+budget currency (wall seconds, or step/request count) that violated the
+objective.  The burn rate is ``bad_fraction / allowed_fraction``: burning
+at exactly 1.0 exhausts the budget precisely at the SLO horizon.  SRE
+multi-window evaluation: an alert fires only when BOTH the fast window
+(default 6 samples) and the slow window (36 samples) burn above their
+thresholds — fast-only spikes and slow-only drifts stay quiet — and
+clears when the fast window recovers.  ``hvd_slo_burn_rate{slo}`` always
+carries the fast-window burn so dashboards see the pre-alert trend.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import re
+
+from ..metrics import instruments, quantile_from_buckets
+
+logger = logging.getLogger("horovod_tpu.goodput.slo")
+
+#: fast window must burn this much (x budget rate) to fire...
+FAST_BURN_THRESHOLD = 2.0
+#: ...while the slow window confirms at least budget-rate burn.
+SLOW_BURN_THRESHOLD = 1.0
+FAST_WINDOW = 6
+SLOW_WINDOW = 36
+MIN_SAMPLES = 3
+
+_OBJ_RE = re.compile(r"^\s*([a-z0-9_]+)\s*(>=|<=)\s*([0-9.eE+-]+)\s*$")
+
+KNOWN = ("goodput", "step_p99", "serving_p99")
+
+
+class Objective:
+    __slots__ = ("name", "op", "bound", "allowed")
+
+    def __init__(self, name, op, bound):
+        self.name = name
+        self.op = op
+        self.bound = float(bound)
+        # the error budget: fraction of the currency allowed to be bad
+        if name == "goodput":
+            self.allowed = max(1e-9, 1.0 - self.bound)
+        else:  # p99 bounds allow 1% of observations over the line
+            self.allowed = 0.01
+
+    def __repr__(self):
+        return f"{self.name}{self.op}{self.bound:g}"
+
+
+def parse_slos(spec):
+    """Parse the HOROVOD_SLO grammar; unknown or malformed objectives are
+    skipped with a warning (an env typo must not kill the job)."""
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = _OBJ_RE.match(part)
+        if not m or m.group(1) not in KNOWN:
+            logger.warning("HOROVOD_SLO: ignoring malformed objective %r "
+                           "(known: %s)", part, ", ".join(KNOWN))
+            continue
+        name, op, bound = m.groups()
+        if (name == "goodput") != (op == ">="):
+            logger.warning("HOROVOD_SLO: ignoring %r (goodput takes >=, "
+                           "latency objectives take <=)", part)
+            continue
+        out.append(Objective(name, op, bound))
+    return out
+
+
+def _series(snapshot, name):
+    return (snapshot.get(name) or {}).get("series") or []
+
+
+def _counter_total(snapshot, name, causes=None):
+    total = 0.0
+    for s in _series(snapshot, name):
+        if causes is not None and s.get("labels", {}).get("cause") \
+                not in causes:
+            continue
+        total += float(s.get("value", 0.0) or 0.0)
+    return total
+
+
+def _hist_counts(snapshot, name, stage=None):
+    """(bounds, summed per-bucket counts) for a histogram family."""
+    entry = snapshot.get(name) or {}
+    bounds = list(entry.get("buckets") or [])
+    counts = []
+    for s in entry.get("series") or []:
+        if stage is not None and s.get("labels", {}).get("stage") != stage:
+            continue
+        c = s.get("counts") or []
+        if len(c) > len(counts):
+            counts += [0] * (len(c) - len(counts))
+        for i, v in enumerate(c):
+            counts[i] += v
+    return bounds, counts
+
+
+class SLOEngine:
+    """Feed me merged snapshots on a fixed cadence; I keep the windows."""
+
+    def __init__(self, objectives, fast_window=FAST_WINDOW,
+                 slow_window=SLOW_WINDOW, min_samples=MIN_SAMPLES,
+                 fast_burn=FAST_BURN_THRESHOLD,
+                 slow_burn=SLOW_BURN_THRESHOLD):
+        self.objectives = list(objectives)
+        self._fast = int(fast_window)
+        self._slow = int(slow_window)
+        self._min = int(min_samples)
+        self._fast_thresh = float(fast_burn)
+        self._slow_thresh = float(slow_burn)
+        self._frac = {o.name: collections.deque(maxlen=self._slow)
+                      for o in self.objectives}
+        self._prev = {}
+        self._alerting = {}
+
+    @classmethod
+    def from_env(cls, **kw):
+        spec = os.environ.get("HOROVOD_SLO", "").strip()
+        if not spec:
+            return None
+        objectives = parse_slos(spec)
+        return cls(objectives, **kw) if objectives else None
+
+    # -- per-objective interval bad-fractions ------------------------------
+    def _bad_fraction(self, obj, snapshot):
+        """The interval's bad share of the objective's currency, or None
+        when the interval carried no currency (no wall time / no steps)."""
+        if obj.name == "goodput":
+            good = _counter_total(snapshot, "hvd_goodput_seconds_total")
+            bad = _counter_total(snapshot, "hvd_badput_seconds_total")
+            key = ("goodput", "totals")
+            pg, pb = self._prev.get(key, (good, bad))
+            self._prev[key] = (good, bad)
+            dg, db = good - pg, bad - pb
+            if dg < 0 or db < 0:  # registry reset
+                return None
+            if dg + db <= 0:
+                return None
+            return db / (dg + db)
+        family, stage = (("hvd_serving_request_latency_seconds", "total")
+                         if obj.name == "serving_p99"
+                         else ("hvd_allreduce_latency_seconds", None))
+        bounds, counts = _hist_counts(snapshot, family, stage=stage)
+        if not bounds or not counts:
+            return None
+        key = (obj.name, "counts")
+        prev = self._prev.get(key)
+        self._prev[key] = counts
+        if prev is None or len(prev) != len(counts) \
+                or sum(counts) < sum(prev):  # first sample / reset
+            return None
+        delta = [c - p for c, p in zip(counts, prev)]
+        total = sum(delta)
+        if total <= 0:
+            return None
+        over = sum(d for i, d in enumerate(delta)
+                   if i >= len(bounds) or bounds[i] > obj.bound)
+        return over / total
+
+    # -- the cadence entry point -------------------------------------------
+    def observe(self, snapshot):
+        """Returns a list of edge events:
+        ``{"slo", "event": "fire"|"clear", "burn_fast", "burn_slow",
+        "bound", "interval_p99"?}``."""
+        events = []
+        for obj in self.objectives:
+            frac = self._bad_fraction(obj, snapshot)
+            window = self._frac[obj.name]
+            if frac is not None:
+                window.append(frac)
+            if len(window) < self._min:
+                continue
+            fast = list(window)[-self._fast:]
+            burn_fast = (sum(fast) / len(fast)) / obj.allowed
+            burn_slow = (sum(window) / len(window)) / obj.allowed
+            instruments.slo_burn_rate().labels(slo=obj.name).set(burn_fast)
+            firing = (burn_fast >= self._fast_thresh
+                      and burn_slow >= self._slow_thresh)
+            was = self._alerting.get(obj.name, False)
+            if firing and not was:
+                ev = {"slo": obj.name, "event": "fire",
+                      "burn_fast": burn_fast, "burn_slow": burn_slow,
+                      "op": obj.op, "bound": obj.bound}
+                p99 = self._interval_p99(obj, snapshot)
+                if p99 is not None:
+                    ev["interval_p99"] = p99
+                events.append(ev)
+                self._alerting[obj.name] = True
+            elif was and burn_fast < self._fast_thresh:
+                events.append({"slo": obj.name, "event": "clear",
+                               "burn_fast": burn_fast,
+                               "burn_slow": burn_slow, "op": obj.op,
+                               "bound": obj.bound})
+                self._alerting[obj.name] = False
+        return events
+
+    def _interval_p99(self, obj, snapshot):
+        """Evidence only: the latest cumulative p99 estimate via the shared
+        bucket-quantile helper."""
+        if obj.name == "goodput":
+            return None
+        family, stage = (("hvd_serving_request_latency_seconds", "total")
+                         if obj.name == "serving_p99"
+                         else ("hvd_allreduce_latency_seconds", None))
+        bounds, counts = _hist_counts(snapshot, family, stage=stage)
+        if not bounds or not counts:
+            return None
+        return quantile_from_buckets(bounds, counts, 0.99)
+
+    def state(self):
+        return {"objectives": [repr(o) for o in self.objectives],
+                "alerting": sorted(k for k, v in self._alerting.items()
+                                   if v)}

@@ -19,15 +19,19 @@ from __future__ import annotations
 import os
 import threading
 
-from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       exponential_buckets, get_registry, merge_snapshots,
-                       parse_prometheus, render_prometheus, reset_registry)
+from .registry import (Counter, Gauge, Histogram, LATENCY_BUCKETS,
+                       MetricsRegistry, exponential_buckets, get_registry,
+                       merge_snapshots, parse_prometheus,
+                       quantile_from_buckets, render_prometheus,
+                       reset_registry)
 from . import instruments
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "instruments",
+    "Counter", "Gauge", "Histogram", "LATENCY_BUCKETS", "MetricsRegistry",
+    "instruments",
     "exponential_buckets", "get_registry", "merge_snapshots",
-    "parse_prometheus", "render_prometheus", "reset_registry",
+    "parse_prometheus", "quantile_from_buckets", "render_prometheus",
+    "reset_registry",
     "local_snapshot", "store_report", "drop_report", "readmit_report",
     "clear_reports", "aggregate", "metrics_text", "metrics",
     "maybe_start_server", "stop_server", "server_port",
@@ -48,7 +52,16 @@ _server_lock = threading.Lock()
 
 
 def local_snapshot() -> dict:
-    """This process's registry as a plain dict (wire- and merge-ready)."""
+    """This process's registry as a plain dict (wire- and merge-ready).
+    Flushes the goodput ledger first (lazy import: goodput imports from
+    this package) so snapshots always carry up-to-date attribution."""
+    try:
+        from ..goodput import ledger as _ledger
+        led = _ledger.active()
+        if led is not None:
+            led.flush()
+    except Exception:
+        pass
     return get_registry().snapshot()
 
 
@@ -134,6 +147,18 @@ def health_summary() -> dict:
     control-plane view (last-negotiation age, heartbeat ledger, members)
     and the live anomaly-watch state."""
     doc = {"status": "ok", "reporting_ranks": report_ranks()}
+    up = get_registry().get("hvd_snapshot_unix_seconds")
+    if up is not None:
+        vals = up.snapshot_values().values()
+        if vals:
+            doc["snapshot_unix_seconds"] = max(vals)
+    try:
+        from ..goodput import ledger as _ledger
+        led = _ledger.active()
+        if led is not None:
+            doc["goodput"] = led.summary()
+    except Exception:
+        pass
     src = _health_source
     if src is not None:
         try:

@@ -442,3 +442,68 @@ def ckpt_bundle_age_steps():
         "means shards are being written but bundles never complete — a "
         "lagging or wedged member (hvddoctor: stale_checkpoint).",
         agg="max")
+
+
+# --------------------------------------------------------------- goodput
+# The time-attribution ledger (goodput/, docs/goodput.md). Counters carry
+# a rank label so per-rank attribution survives the cross-rank merge
+# (counters sum, but label sets stay disjoint per rank).
+
+def goodput_seconds():
+    return get_registry().counter(
+        "hvd_goodput_seconds_total",
+        "Wall-clock seconds attributed to useful compute by the goodput "
+        "ledger, per rank (goodput/ledger.py; docs/goodput.md).",
+        labels=("rank",))
+
+
+def badput_seconds():
+    return get_registry().counter(
+        "hvd_badput_seconds_total",
+        "Wall-clock seconds NOT spent computing, by cause (exposed_comm / "
+        "stall / checkpoint / recovery / excluded / idle) and rank — the "
+        "goodput ledger's badput breakdown (docs/goodput.md).",
+        labels=("cause", "rank"))
+
+
+def goodput_ratio():
+    return get_registry().gauge(
+        "hvd_goodput_ratio",
+        "Fraction of this rank's wall-clock attributed to compute since "
+        "init (merge takes the min: the fleet is only as good as its "
+        "worst rank; the fleet-weighted ratio derives from the seconds "
+        "counters).", labels=("rank",), agg="min")
+
+
+def goodput_wall_seconds():
+    return get_registry().gauge(
+        "hvd_goodput_wall_seconds",
+        "Wall-clock seconds the goodput ledger has been attributing on "
+        "each rank (the completeness denominator: the per-rank state sums "
+        "should cover >= 99% of this).", labels=("rank",), agg="max")
+
+
+def slo_burn_rate():
+    return get_registry().gauge(
+        "hvd_slo_burn_rate",
+        "Error-budget burn rate per declared SLO (HOROVOD_SLO): the "
+        "fast-window bad-fraction divided by the objective's allowance. "
+        "1.0 = burning exactly the budget; sustained >1 exhausts it "
+        "(goodput/slo.py; docs/goodput.md).", labels=("slo",), agg="max")
+
+
+def up():
+    return get_registry().gauge(
+        "hvd_up",
+        "1 while the engine loop is alive (set at init, refreshed every "
+        "metrics push, 0 at shutdown). Scrape alongside "
+        "hvd_snapshot_unix_seconds to tell a wedged-but-listening rank "
+        "from a healthy one.", agg="min")
+
+
+def snapshot_unix_seconds():
+    return get_registry().gauge(
+        "hvd_snapshot_unix_seconds",
+        "Unix time the engine loop last refreshed this registry (NOT the "
+        "scrape time — a stale value under a live /metrics endpoint means "
+        "the process is wedged).", agg="max")

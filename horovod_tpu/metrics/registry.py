@@ -62,6 +62,29 @@ def exponential_buckets(start: float, factor: float, count: int):
 LATENCY_BUCKETS = exponential_buckets(50e-6, 2.0, 20)
 
 
+def quantile_from_buckets(buckets, counts, q):
+    """Estimate the q-quantile from per-bucket (non-cumulative) counts.
+
+    ``buckets`` are the upper bounds (no +Inf); ``counts`` has one extra
+    trailing slot for the implicit +Inf overflow bucket, matching the
+    Histogram snapshot layout.  Returns the upper bound of the bucket the
+    quantile falls in, ``2 * buckets[-1]`` when it lands in the overflow
+    bucket, or ``None`` when there are no observations.  Shared by the
+    anomaly watch (serving p99), the SLO engine, hvdtop and the serving
+    bench so every consumer agrees on the estimate.
+    """
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    acc = 0
+    for i, b in enumerate(buckets):
+        acc += counts[i] if i < len(counts) else 0
+        if acc >= target:
+            return b
+    return buckets[-1] * 2.0 if buckets else None
+
+
 class _Child:
     """One label-set instance of a metric."""
 
@@ -450,7 +473,7 @@ def parse_prometheus(text: str) -> dict:
                 k, _, v = part.partition("=")
                 if not v.startswith('"') or not v.endswith('"'):
                     raise ValueError(f"bad label in line: {raw!r}")
-                labels.append((k.strip(), v[1:-1]))
+                labels.append((k.strip(), _unescape_label(v[1:-1])))
             key = tuple(sorted(labels))
         else:
             name, _, val_str = line.partition(" ")
@@ -464,6 +487,30 @@ def parse_prometheus(text: str) -> dict:
             raise ValueError(f"bad value in line: {raw!r}")
         out.setdefault(name.strip(), {})[key] = value
     return out
+
+
+def _unescape_label(s: str) -> str:
+    """Inverse of the ``_fmt_labels`` escaping.  Walks escape sequences
+    left to right — chained ``str.replace`` would corrupt ``\\\\n`` (an
+    escaped backslash followed by 'n') into a newline."""
+    if "\\" not in s:
+        return s
+    out, i, n = [], 0, len(s)
+    while i < n:
+        ch = s[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = s[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
 
 
 def _split_labels(s: str):

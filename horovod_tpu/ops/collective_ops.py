@@ -248,19 +248,37 @@ def synchronize(handle: int):
 
     The blocked wall time here is communication the step could NOT hide
     behind compute — it accumulates into hvd_exposed_comm_seconds and, when
-    tracing is on, becomes a WAIT span (docs/tracing.md)."""
+    tracing is on, becomes a WAIT span (docs/tracing.md).  The goodput
+    ledger classifies the same interval by outcome: a completed collective
+    is ``exposed_comm``, a watchdog failure is ``stall``, a membership
+    change is ``recovery`` (docs/goodput.md)."""
     import time
 
     from .. import tracing as _tracing
+    from ..exceptions import CollectiveTimeoutError, RanksChangedError
+    from ..goodput import ledger as _goodput
     from ..metrics import instruments
 
     tr = _tracing.active()
     t0u = _tracing.clock.trace_us() if tr is not None else 0
+    led = _goodput.active()
+    sp = led.begin("exposed_comm") if led is not None else None
     t0 = time.perf_counter()
     try:
-        return basics._engine().handles.synchronize(handle)
+        result = basics._engine().handles.synchronize(handle)
+    except CollectiveTimeoutError:
+        if sp is not None:
+            sp.state = "stall"
+        raise
+    except RanksChangedError:
+        if sp is not None:
+            sp.state = "recovery"
+        raise
     finally:
         dt = time.perf_counter() - t0
+        if led is not None:
+            led.end(sp)
         instruments.exposed_comm_seconds().inc(dt)
         if tr is not None:
             tr.add_wait(basics.rank(), t0u, t0u + int(dt * 1e6))
+    return result

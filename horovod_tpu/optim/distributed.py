@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from .. import basics
 from .. import tracing as _tracing
 from ..basics import Adasum, Average, Sum
+from ..goodput import ledger as _goodput
 from ..ops import collective_ops as ops
 from ..ops import compression as _compression
 from ..ops.compression import Compression
@@ -398,9 +399,15 @@ class DistributedOptimizer(_GradAccumulation):
         step_span = (tr.begin_block(_tracing.K_STEP, basics.rank(), "STEP",
                                     _tracing.clock.trace_us())
                      if tr is not None else None)
+        # goodput: the communicating update is the "useful work" span;
+        # nested synchronize()/ckpt spans subtract themselves from it
+        led = _goodput.active()
+        gp_span = led.begin("compute") if led is not None else None
         try:
             return self._communicating_update(grads, state, params)
         finally:
+            if led is not None:
+                led.end(gp_span)
             if tr is not None:
                 tr.end_block(step_span, _tracing.clock.trace_us())
 

@@ -744,6 +744,9 @@ class CoordState:
         row.setdefault(rank, time.monotonic())
         if len(row) < len(self.members):
             return
+        from ..goodput import ledger as _goodput
+
+        led = _goodput.active()
         events = pol.observe_round(self._deposit_t.pop(seq))
         for r in events["excluded"]:
             host = self.rank_hosts.get(r, "?")
@@ -754,12 +757,16 @@ class CoordState:
             _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
                              "excluded host=%s episode=%d"
                              % (host, pol.episodes.get(r, 0)))
+            if led is not None:
+                led.note_excluded(r, True)
         for r in events["readmitted"]:
             logger.info("straggler policy: re-admitting rank %d (host %s)",
                         r, self.rank_hosts.get(r, "?"))
             _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
                              "readmitted host=%s"
                              % self.rank_hosts.get(r, "?"))
+            if led is not None:
+                led.note_excluded(r, False)
         if events["excluded"] or events["readmitted"]:
             instruments.excluded_rank().set(
                 max(pol.excluded) if pol.excluded else -1)
@@ -2799,9 +2806,12 @@ class CoordController:
         blackbox-recorded) only on transitions that involve THIS rank, so a
         straggler host's own log says when it was parked and when it came
         back — the first place an operator looks."""
+        from ..goodput import ledger as _goodput
+
         new = frozenset(excluded or ())
         if new == self._excluded:
             return
+        led = _goodput.active()
         if self._rank in new and self._rank not in self._excluded:
             logger.warning(
                 "rank %d excluded from collectives by straggler policy "
@@ -2809,10 +2819,14 @@ class CoordController:
                 self._rank)
             _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % self._rank,
                              "excluded self", rank=self._rank)
+            if led is not None:
+                led.note_excluded(self._rank, True)
         elif self._rank in self._excluded and self._rank not in new:
             logger.info("rank %d re-admitted to collectives", self._rank)
             _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % self._rank,
                              "readmitted self", rank=self._rank)
+            if led is not None:
+                led.note_excluded(self._rank, False)
         self._excluded = new
 
     def excluded_ranks(self) -> frozenset:

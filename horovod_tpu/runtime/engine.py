@@ -73,6 +73,7 @@ from typing import Dict, List, Optional
 from ..exceptions import (CollectiveTimeoutError, DuplicateNameError,
                           HorovodInternalError, RanksChangedError,
                           ShutdownError)
+from ..goodput import ledger as _goodput
 from ..metrics import instruments
 from .. import blackbox as _blackbox
 from .. import faultinject
@@ -256,6 +257,12 @@ class Engine:
         # per-rank data-plane fault point (slow@rank / flaky_slow@rank):
         # fires once per engine tick, modelling a chronically slow worker
         self._faults = faultinject.for_rank(state.rank0)
+        # goodput ledger (docs/goodput.md): wall-clock attribution starts
+        # at engine construction; liveness stamps let scrapers tell a
+        # wedged-but-listening rank from a healthy one
+        _goodput.attach(state.rank0)
+        instruments.up().set(1.0)
+        instruments.snapshot_unix_seconds().set(time.time())
 
     # ------------------------------------------------------------------ API
     def start(self) -> None:
@@ -386,6 +393,14 @@ class Engine:
                 now = time.monotonic()
                 if now >= self._metrics_next_push:
                     self._metrics_next_push = now + self._metrics_interval
+                    # flush the goodput ledger and restamp liveness BEFORE
+                    # the push so the shipped snapshot carries attribution
+                    # current to this tick
+                    led = _goodput.active()
+                    if led is not None:
+                        led.flush()
+                    instruments.up().set(1.0)
+                    instruments.snapshot_unix_seconds().set(time.time())
                     push = getattr(self.controller, "push_metrics", None)
                     if push is not None:
                         push()

@@ -253,6 +253,9 @@ class PyController:
         on its transitions (runs under self._lock). The same events the
         coordinated controller records, so hvddoctor's chronic_straggler
         signature works identically against both planes."""
+        from ..goodput import ledger as _goodput
+
+        led = _goodput.active()
         pol = self._straggler
         events = pol.observe_round(row)
         for r in events["excluded"]:
@@ -262,10 +265,14 @@ class PyController:
                 r, pol.patience)
             _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
                              "excluded episode=%d" % pol.episodes.get(r, 0))
+            if led is not None:
+                led.note_excluded(r, True)
         for r in events["readmitted"]:
             logger.info("straggler policy: re-admitting rank %d", r)
             _blackbox.record(_blackbox.K_EXCLUDED, "rank_%d" % r,
                              "readmitted")
+            if led is not None:
+                led.note_excluded(r, False)
         if events["excluded"] or events["readmitted"]:
             instruments.excluded_rank().set(
                 max(pol.excluded) if pol.excluded else -1)

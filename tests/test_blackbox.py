@@ -302,6 +302,35 @@ class TestSignatures:
         assert len(out) == 1 and out[0]["evidence"]["flaps"] == 2
         assert not sigs.detect_heartbeat_flap(_bundle({0: evs[:2]}))
 
+    def test_budget_exhausted_names_dominant_cause_and_ranks(self):
+        b = _bundle({0: [], 1: []})
+        b[0]["metrics"] = {
+            "hvd_slo_burn_rate": {"series": [
+                {"labels": {"slo": "goodput"}, "value": 6.0},
+                {"labels": {"slo": "step_p99"}, "value": 0.5}]},
+            "hvd_badput_seconds_total": {"series": [
+                {"labels": {"cause": "recovery", "rank": "1"},
+                 "value": 40.0},
+                {"labels": {"cause": "stall", "rank": "0"}, "value": 5.0},
+                {"labels": {"cause": "idle", "rank": "0"},
+                 "value": 500.0}]}}
+        out = sigs.detect_budget_exhausted(b)
+        assert len(out) == 1  # step_p99 burns below threshold: no signature
+        ev = out[0]["evidence"]
+        assert out[0]["id"] == "budget_exhausted"
+        assert ev["slo"] == "goodput"
+        # idle is excluded from the naming when an actionable cause exists
+        assert ev["dominant_cause"] == "recovery"
+        assert ev["driving_ranks"][0] == "1"
+        assert "recovery" in out[0]["summary"]
+
+    def test_budget_exhausted_quiet_without_burn(self):
+        b = _bundle({0: []})
+        b[0]["metrics"] = {"hvd_slo_burn_rate": {"series": [
+            {"labels": {"slo": "goodput"}, "value": 1.2}]}}
+        assert sigs.detect_budget_exhausted(b) == []
+        assert sigs.detect_budget_exhausted(_bundle({0: []})) == []
+
     def test_sorted_critical_first(self):
         events = [_ev(blackbox.K_RECONNECT, "rank_1", "r", rank=1, t=i)
                   for i in range(3)]  # warning-grade storm...
@@ -368,6 +397,43 @@ class TestAnomalyWatch:
         assert "step_seconds" in w.state()["active"]
         w.observe_snapshot(_lat_snapshot(5.7, 8))  # back to 0.1 s
         assert w.state()["active"] == {}
+
+    def test_slo_burn_fires_and_clears(self):
+        from horovod_tpu.goodput.slo import Objective, SLOEngine
+
+        eng = SLOEngine([Objective("goodput", ">=", 0.9)],
+                        fast_window=3, slow_window=6, min_samples=2)
+        w = AnomalyWatch(interval=1.0, slo_engine=eng)
+
+        def snap(good, bad):
+            return {"hvd_goodput_seconds_total": {
+                        "kind": "counter", "series": [
+                            {"labels": {"rank": "0"}, "value": good}]},
+                    "hvd_badput_seconds_total": {
+                        "kind": "counter", "series": [
+                            {"labels": {"cause": "recovery", "rank": "0"},
+                             "value": bad}]}}
+
+        fired = []
+        good = bad = 0.0
+        for _ in range(4):  # half of every interval is badput
+            good += 1.0
+            bad += 1.0
+            fired += w.observe_snapshot(snap(good, bad))
+        assert [s["id"] for s in fired] == ["slo_burn_rate"]
+        assert fired[0]["evidence"]["slo"] == "goodput"
+        assert "budget_exhausted" in fired[0]["summary"]
+        assert w.state()["slo"]["alerting"] == ["goodput"]
+        for _ in range(6):  # recovery: clean intervals clear the alert
+            good += 10.0
+            w.observe_snapshot(snap(good, bad))
+        assert w.state()["slo"]["alerting"] == []
+
+    def test_watch_without_slo_env_has_no_engine(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_SLO", raising=False)
+        w = AnomalyWatch(interval=1.0)
+        assert w._slo is None
+        assert "slo" not in w.state()
 
     def test_watch_lifecycle_and_state(self, monkeypatch):
         assert watch.watch_state() is None
@@ -493,6 +559,24 @@ class TestHealth:
         readmit_report(1)  # elastic re-admission
         store_report(1, snap)
         assert report_ranks() == [1]
+
+    def test_dropped_rank_goodput_counters_stay_out_of_aggregate(self):
+        from horovod_tpu.metrics import aggregate
+
+        snap = {"hvd_badput_seconds_total": {
+            "kind": "counter", "help": "", "series": [
+                {"labels": {"cause": "stall", "rank": "1"},
+                 "value": 12.0}]}}
+        store_report(1, snap)
+        merged = aggregate()
+        assert any(s["labels"].get("rank") == "1"
+                   for s in merged["hvd_badput_seconds_total"]["series"])
+        drop_report(1)
+        store_report(1, snap)  # stale ledger report racing the death
+        merged = aggregate()
+        assert not any(s["labels"].get("rank") == "1" for s in merged.get(
+            "hvd_badput_seconds_total", {}).get("series", [])), \
+            "dead rank's goodput attribution resurrected in the fleet view"
 
 
 # ------------------------------------------------------------- engine path

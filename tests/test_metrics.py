@@ -87,6 +87,23 @@ class TestRegistry:
         assert s["count"] == 5
         assert s["sum"] == pytest.approx(56.05)
 
+    def test_merge_empty_snapshot_list(self):
+        assert merge_snapshots([]) == {}
+
+    def test_merge_gauge_agg_conflict_first_snapshot_wins(self):
+        # two ranks disagree on a gauge's agg mode (version skew during a
+        # rolling restart): the first snapshot's mode governs the merge
+        # instead of crashing or flip-flopping per input order
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.gauge("skewed", agg="max").set(3.0)
+        b.gauge("skewed", agg="min").set(9.0)
+        merged = merge_snapshots([a.snapshot(), b.snapshot()])
+        assert merged["skewed"]["agg"] == "max"
+        assert merged["skewed"]["series"][0]["value"] == 9.0
+        merged = merge_snapshots([b.snapshot(), a.snapshot()])
+        assert merged["skewed"]["agg"] == "min"
+        assert merged["skewed"]["series"][0]["value"] == 3.0
+
     def test_counters_and_histograms_sum_in_merge(self):
         snaps = []
         for _ in range(2):
@@ -130,6 +147,21 @@ class TestExposition:
         assert buckets[(("le", "+Inf"),)] == 3
         assert samples["hvd_lat_seconds_count"][()] == 3
 
+    def test_label_escaping_roundtrip(self):
+        # render escapes backslash/quote/newline; the parser must invert
+        # them exactly — including the adversarial r'\\n' corner (an
+        # escaped backslash followed by a literal n, NOT a newline)
+        values = ['plain', 'quo"te', 'back\\slash', 'new\nline',
+                  'back\\slash\nand newline', '\\n', '\\\\n', 'tail\\']
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", "escape probe", labels=("v",))
+        for i, v in enumerate(values):
+            c.labels(v=v).inc(i + 1)
+        text = render_prometheus(reg.snapshot())
+        samples = parse_prometheus(text)
+        for i, v in enumerate(values):
+            assert samples["esc_total"][(("v", v),)] == i + 1, repr(v)
+
     def test_parser_is_strict(self):
         with pytest.raises(ValueError):
             parse_prometheus("foo bar baz")  # unparsable value
@@ -164,6 +196,29 @@ class TestExposition:
             clear_reports()
 
 
+# ------------------------------------------------------- bucket quantiles
+class TestQuantileFromBuckets:
+    def test_basic_walk(self):
+        from horovod_tpu.metrics import quantile_from_buckets
+
+        buckets = [0.1, 0.5, 1.0]
+        # 10 obs: 5 in <=0.1, 4 in <=0.5, 1 in <=1.0
+        assert quantile_from_buckets(buckets, [5, 4, 1], 0.5) == 0.1
+        assert quantile_from_buckets(buckets, [5, 4, 1], 0.99) == 1.0
+
+    def test_overflow_reports_past_largest_bound(self):
+        from horovod_tpu.metrics import quantile_from_buckets
+
+        # all mass in the implicit +Inf slot
+        assert quantile_from_buckets([0.1, 1.0], [0, 0, 7], 0.5) == 2.0
+
+    def test_empty_inputs(self):
+        from horovod_tpu.metrics import quantile_from_buckets
+
+        assert quantile_from_buckets([0.1], [0], 0.99) is None
+        assert quantile_from_buckets([], [], 0.99) is None
+
+
 # ----------------------------------------------------------------- endpoint
 class TestEndpoint:
     def test_http_server_smoke(self):
@@ -195,6 +250,41 @@ class TestEndpoint:
         finally:
             stop_server()
         assert server_port() is None
+
+    def test_liveness_stamps_on_metrics_and_healthz(self, monkeypatch):
+        # hvd_up + hvd_snapshot_unix_seconds distinguish a wedged-but-
+        # listening job (stale stamp) from a live one: the ENGINE loop
+        # stamps them, the endpoint only serves — so a dead engine behind
+        # a live HTTP thread shows an aging snapshot, not a fresh one
+        from horovod_tpu.metrics import (get_registry, health_summary,
+                                         reset_registry)
+
+        stop_server()
+        reset_registry()
+        monkeypatch.setenv("HOROVOD_METRICS_PORT", "0")
+        instruments.up().set(1.0)
+        stamped = time.time() - 42.0
+        instruments.snapshot_unix_seconds().set(stamped)
+        try:
+            srv = maybe_start_server()
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            ).read().decode()
+            samples = parse_prometheus(body)
+            assert samples["hvd_up"][()] == 1.0
+            assert samples["hvd_snapshot_unix_seconds"][()] == \
+                pytest.approx(stamped, abs=1.0)
+            doc = health_summary()
+            assert doc["snapshot_unix_seconds"] == \
+                pytest.approx(stamped, abs=1.0)
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ).read().decode())
+            assert health["snapshot_unix_seconds"] == \
+                pytest.approx(stamped, abs=1.0)
+        finally:
+            stop_server()
+            reset_registry()
 
 
 # ------------------------------------------------------------- live API
