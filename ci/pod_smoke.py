@@ -613,6 +613,186 @@ def check_coordinator_failover() -> None:
           "coordinator failover")
 
 
+def _split_brain_smoke_fn():
+    """2-rank elastic job for the split-brain drill (docs/fault-tolerance.md):
+    the lease plane is on and a ``partition@net`` cut isolates rank 0 (with
+    the coordinator) from rank 1 (with the standby) mid-training. Rank 0
+    must self-fence before the TTL expires, rank 1's standby must take over
+    by acquiring the lease, and after the heal the deposed primary's FENCED
+    answer must be rejected by the promoted side's fence guard. The
+    gradient is identical on every rank, so averaging over any member set
+    is bit-exact and the survivor's final parameters are closed-form."""
+    import os
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import blackbox
+    from horovod_tpu.metrics import instruments
+
+    hvd.init()
+    rank = hvd.rank()
+    state = hvd.elastic.ElasticState(w=np.array([4.0], np.float32), step=0)
+
+    @hvd.elastic.run_fn
+    def train(state):
+        while state.step < 12:
+            time.sleep(0.7)  # pace the run so the cut lands mid-training
+            w = np.asarray(state.w, np.float32)
+            g = (w - np.float32(1.0)).astype(np.float32)
+            avg = hvd.allreduce(g, name=f"grad{state.step}", op=hvd.Average)
+            state.w = (w - np.float32(0.1)
+                       * np.asarray(avg, np.float32)).astype(np.float32)
+            state.step += 1
+            state.commit()
+        return np.asarray(state.w, np.float32)
+
+    try:
+        w = train(state)
+        fenced_seen = 0
+        deadline = time.monotonic() + 25
+        while time.monotonic() < deadline:
+            fenced_seen = int(instruments.frames_fenced().value)
+            if fenced_seen:
+                break
+            time.sleep(0.25)
+        blackbox.dump("split-brain smoke postmortem", force=True)
+        return ("done", int(state.step), w.tobytes().hex(), fenced_seen)
+    except Exception as exc:  # the fenced side of the cut lands here
+        if rank == 0:
+            # stay alive past the heal so the fenced server can answer the
+            # promoted standby's redial with its FENCED frame
+            time.sleep(12.0)
+        blackbox.dump("split-brain smoke postmortem", force=True)
+        return ("fenced", repr(exc), int(state.step))
+
+
+def check_split_brain() -> None:
+    """Partition-tolerance smoke (docs/fault-tolerance.md): cut a 2-process
+    lease-enabled job in half mid-training. The old coordinator must
+    self-fence before the lease TTL, the standby must promote by acquiring
+    the lease, the survivor must finish with the closed-form parameters,
+    and the merged blackbox history must satisfy the jepsen-lite checker:
+    single-writer leadership, exactly-once step application, and at least
+    one fenced-frame rejection — while ``bin/hvddoctor`` stays clean of
+    the split_brain signature."""
+    import json
+    import pickle
+    import tempfile
+    import time
+
+    import cloudpickle
+    import numpy as np
+
+    from horovod_tpu.faultinject import jepsen
+    from horovod_tpu.run import rendezvous
+
+    bbdir = tempfile.mkdtemp(prefix="hvd_splitbrain_smoke_")
+    secret = rendezvous.make_secret()
+    kv = rendezvous.KVStoreServer(secret).start()
+    addr = f"127.0.0.1:{kv.port}"
+    client = rendezvous.KVStoreClient(addr, secret)
+    client.put("runfunc", "fn",
+               cloudpickle.dumps((_split_brain_smoke_fn, (), {})))
+
+    procs = []
+    results = {}
+    try:
+        for r in range(2):
+            env = dict(os.environ)
+            env.update({
+                "HVD_NUM_PROCS": "2",
+                "HVD_PROCESS_ID": str(r),
+                "HVD_KV_ADDR": addr,
+                "HVD_SECRET": secret,
+                "HVD_ELASTIC": "1",
+                "HOROVOD_STANDBY_COORD": "1",
+                "HOROVOD_LEASE_TTL": "1.2",
+                "HOROVOD_LEASE_RENEW": "0.25",
+                "HOROVOD_RECONNECT_GRACE": "20",
+                "HOROVOD_BLACKBOX": "1",
+                "HOROVOD_BLACKBOX_DIR": bbdir,
+                # cut ranks {0} | {1} 8s in, heal 6s later
+                "HOROVOD_FAULT_SPEC": "partition@net:0|1:6:8",
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                # the smoke fn unpickles by reference to this module
+                "PYTHONPATH": os.pathsep.join(
+                    [REPO, os.path.dirname(os.path.abspath(__file__))]),
+            })
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+        deadline = time.time() + 180
+        while time.time() < deadline and len(results) < 2:
+            for r in range(2):
+                if r not in results:
+                    blob = client.get("result", str(r))
+                    if blob is not None:
+                        ok, payload = pickle.loads(blob)
+                        assert ok, f"rank {r} harness raised:\n{payload}"
+                        results[r] = payload
+            time.sleep(0.25)
+        assert len(results) == 2, (
+            "the partitioned job did not finish; got ranks "
+            f"{sorted(results)}, exit codes {[p.poll() for p in procs]}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        kv.stop()
+
+    assert results[0][0] == "fenced", (
+        f"rank 0 was cut from the KV and must self-fence: {results[0]}")
+    outcome, steps, w_hex, fenced_seen = results[1]
+    assert outcome == "done" and steps == 12, (
+        f"the survivor did not finish all 12 steps: {results[1]}")
+    assert fenced_seen > 0, (
+        "no fenced-frame rejection observed on the promoted side "
+        "(hvd_frames_fenced_total stayed 0)")
+    # identical gradients make the survivor's parameters closed-form:
+    # replay the same float32 recurrence locally
+    w = np.array([4.0], np.float32)
+    for _ in range(12):
+        g = (w - np.float32(1.0)).astype(np.float32)
+        w = (w - np.float32(0.1) * g).astype(np.float32)
+    assert w_hex == w.tobytes().hex(), (
+        f"survivor parameters diverged: {w_hex} != {w.tobytes().hex()}")
+
+    bundle = {}
+    for rank in (0, 1):
+        path = os.path.join(bbdir, f"rank_{rank}.json")
+        assert os.path.exists(path), (
+            f"no blackbox dump from rank {rank}; dir has "
+            f"{sorted(os.listdir(bbdir))}")
+        with open(path) as f:
+            bundle[rank] = json.load(f)
+    verdict = jepsen.check_history(bundle)
+    assert verdict["single_writer"], (
+        f"leadership overlapped: {verdict['violations']}")
+    assert verdict["exactly_once"], (
+        f"steps were double-applied: {verdict['violations']}")
+    assert verdict["fenced_frames"] > 0, (
+        "the merged history records no fenced-frame rejection")
+
+    hvddoctor = os.path.join(REPO, "bin", "hvddoctor")
+    d = subprocess.run([sys.executable, hvddoctor, bbdir],
+                       capture_output=True, text=True, timeout=60)
+    assert d.returncode == 0, (
+        f"hvddoctor rejected the bundle:\n{d.stderr[-2000:]}")
+    assert "split_brain" not in d.stdout, (
+        "hvddoctor diagnosed a split brain on a fenced (clean) history:\n"
+        f"{d.stdout[-3000:]}")
+    print("ok: split-brain smoke — partition isolated the coordinator, it "
+          "self-fenced before the lease TTL, the standby promoted by "
+          "acquiring the lease, the deposed primary's post-heal frame was "
+          f"rejected ({fenced_seen} fenced), and the jepsen-lite checker "
+          "proved single-writer leadership with exactly-once steps")
+
+
 def _straggler_smoke_fn():
     """2-rank elastic job for the straggler smoke: every rank times its
     steps past a warmup window (long enough for the policy to exclude the
@@ -1627,6 +1807,18 @@ def check_tier_rehome() -> None:
 
 
 def main():
+    if len(sys.argv) > 1:
+        # run only the named checks: `python ci/pod_smoke.py check_split_brain`
+        # lets a CI stage (or a human) re-run one smoke without the full
+        # pod-day sweep
+        for name in sys.argv[1:]:
+            fn = globals().get(name)
+            assert name.startswith("check_") and callable(fn), (
+                f"unknown smoke check {name!r}; available: "
+                + ", ".join(sorted(n for n in globals()
+                                   if n.startswith("check_"))))
+            fn()
+        return
     cmds = pod_day_commands() + elastic_commands()
     for cmd in cmds:
         check_command(cmd)
@@ -1638,6 +1830,7 @@ def main():
     check_bucket_overlap()
     check_blackbox_doctor()
     check_coordinator_failover()
+    check_split_brain()
     check_tier_rehome()
     check_straggler_adaptive()
     check_adaptive_wire()
@@ -1650,6 +1843,7 @@ def main():
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
           "+ chaos reconnect + nan skip-step + trace capture "
           "+ bucket overlap + blackbox doctor + coordinator failover "
+          "+ split-brain partition drill "
           "+ tier aggregator re-home + straggler adaptive + adaptive wire "
           "+ quantized GSPMD wire + hierarchical collective "
           "+ quantized MoE dispatch + serving worker-kill "

@@ -60,6 +60,17 @@ python benchmarks/coord_bench.py --mode tier --ranks 1024,10240,102400 \
     --rounds 15 --warmup 3 --p99-gate 5.0 \
     --history /tmp/hvd_ci_coord_hist.jsonl --check-regression
 
+stage "chaos: partition-tolerant fenced leadership (lease, wire epochs, jepsen)"
+python -m pytest tests/test_fencing.py -q -m "not integration"
+# the split-brain drill: cut a 2-process job in half mid-training, assert
+# the old coordinator self-fences before the lease TTL, the standby takes
+# over by acquiring the lease, the healed deposed primary's frames are
+# rejected by fencing epoch, and the jepsen-lite checker proves
+# single-writer leadership + exactly-once step application
+python -m pytest -q \
+    "tests/test_fencing.py::test_partition_failover_fenced_bit_identical"
+python ci/pod_smoke.py check_split_brain
+
 stage "tracing: clock, spans, merge, hvdprof critical-path report"
 python -m pytest tests/test_tracing.py -q
 

@@ -31,9 +31,10 @@ import time
 
 from .recorder import (  # noqa: F401  (re-exported for callers)
     K_ALGO, K_ANOMALY, K_BITWIDTH, K_CKPT, K_COLLECTIVE, K_EPOCH, K_ERROR,
-    K_EXCLUDED, K_FAILOVER, K_FAULT, K_FRAME_RX, K_FRAME_TX, K_HEARTBEAT,
-    K_METRICS, K_RANK_LOST, K_RECONNECT, K_SIGNAL, K_STALL, K_TIMEOUT,
-    K_VERDICT, Event, FlightRecorder, allocation_count, ring_capacity,
+    K_EXCLUDED, K_FAILOVER, K_FAULT, K_FENCE, K_FRAME_RX, K_FRAME_TX,
+    K_HEARTBEAT, K_METRICS, K_RANK_LOST, K_RECONNECT, K_SIGNAL, K_STALL,
+    K_TIMEOUT, K_VERDICT, Event, FlightRecorder, allocation_count,
+    ring_capacity,
 )
 
 logger = logging.getLogger("horovod_tpu")

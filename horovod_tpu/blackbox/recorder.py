@@ -47,6 +47,9 @@ K_EXCLUDED = "excluded"        # straggler policy excluded/readmitted/
                                # escalated a rank (detail names the host)
 K_CKPT = "checkpoint"          # checkpoint lifecycle: shard snapshot
                                # landed, bundle finalized, peer restore
+K_FENCE = "fence"              # fenced-leadership event: lease acquired /
+                               # renewed, a coordinator self-fenced, or a
+                               # stale-epoch frame was rejected
 
 DEFAULT_EVENTS = 4096
 

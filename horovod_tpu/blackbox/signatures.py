@@ -312,6 +312,27 @@ def detect_coordinator_failover(bundle) -> List[dict]:
     return sigs
 
 
+def detect_split_brain(bundle) -> List[dict]:
+    """Fenced-leadership safety violation: the jepsen-lite history checker
+    (faultinject/jepsen.py) found two coordinators whose attested
+    leadership intervals — reconstructed from K_FENCE lease events —
+    overlap in time, an epoch with two holders, or an epoch regression.
+    By design this must NEVER fire: the lease CAS plus self-fencing
+    guarantees a single writer per instant, so any match is a bug in the
+    fencing machinery itself, not an operational hiccup."""
+    from ..faultinject import jepsen  # lazy: keeps import order acyclic
+
+    verdict = jepsen.check_history(bundle)
+    if verdict["single_writer"]:
+        return []
+    return [make_signature(
+        "split_brain", SEV_CRITICAL,
+        "split-brain leadership: %s" % "; ".join(verdict["violations"]),
+        violations=verdict["violations"],
+        intervals=verdict["intervals"],
+        fenced_frames=verdict["fenced_frames"])]
+
+
 def detect_bitwidth_thrash(bundle) -> List[dict]:
     """An adaptive-wire bucket whose bitwidth selector keeps flipping
     (many K_BITWIDTH decision changes for one bucket name) is thrashing:
@@ -566,6 +587,7 @@ DETECTORS = (
     detect_nan_first,
     detect_dead_worker,
     detect_coordinator_failover,
+    detect_split_brain,
     detect_straggler,
     detect_chronic_straggler,
     detect_latency_regression,

@@ -25,11 +25,12 @@ import os
 import threading
 from typing import Dict, Optional, Tuple
 
-from .injector import FaultSocket, Injector
+from .injector import FaultSocket, Injector, Partition
 from .spec import FaultRule, parse_spec
 
-__all__ = ["FaultRule", "FaultSocket", "Injector", "parse_spec", "for_rank",
-           "shared_for_rank", "reset_shared"]
+__all__ = ["FaultRule", "FaultSocket", "Injector", "Partition", "parse_spec",
+           "for_rank", "shared_for_rank", "reset_shared",
+           "partition_for_rank"]
 
 ENV_VAR = "HOROVOD_FAULT_SPEC"
 
@@ -65,6 +66,15 @@ def shared_for_rank(rank: int) -> Optional[Injector]:
             inj = Injector(parse_spec(text), rank)
             _shared[key] = inj
     return inj if inj.active() else None
+
+
+def partition_for_rank(rank: int) -> Optional[Partition]:
+    """This rank's active :class:`Partition` rule, if any — used by KV-side
+    callers (the leadership lease) that must observe the cut without owning
+    a wrapped socket. Shares the process-cached injector so the partition
+    clock matches what the sockets see."""
+    inj = shared_for_rank(rank)
+    return None if inj is None else inj.partition
 
 
 def reset_shared() -> None:

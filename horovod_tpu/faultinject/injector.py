@@ -19,6 +19,58 @@ from .spec import FaultRule
 
 logger = logging.getLogger("horovod_tpu")
 
+# Clock origin for partition activation/heal windows: per-process monotonic,
+# anchored at module import so every Injector built in this process (fresh
+# for_rank() instances included) sees the same partition schedule.
+_PART_T0 = time.monotonic()
+
+
+class Partition:
+    """One active ``partition@net:A|B`` rule: answers "does a frame from
+    rank a to rank b cross the cut right now?" and "has this rank lost the
+    rendezvous KV?". Deterministic: activation and heal are fixed offsets
+    from process start on the local monotonic clock."""
+
+    def __init__(self, rule: FaultRule):
+        self._a, self._b = rule.groups
+        self._start = _PART_T0 + rule.start
+        # seconds == 0 means the partition never heals
+        self._heal = self._start + rule.seconds if rule.seconds else None
+        self._logged = False
+
+    def active(self) -> bool:
+        now = time.monotonic()
+        return now >= self._start and (self._heal is None or now < self._heal)
+
+    def blocks(self, sender: Optional[int], peer: Optional[int]) -> bool:
+        """Whether a frame from ``sender`` to ``peer`` crosses the cut.
+        Unknown peers (None) are never blocked — the caller has no basis to
+        attribute the connection to either side."""
+        if sender is None or peer is None or sender == peer:
+            return False
+        cross = ((sender in self._a and peer in self._b) or
+                 (sender in self._b and peer in self._a))
+        return cross and self.active()
+
+    def blocks_kv(self, rank: int) -> bool:
+        """The first group is the minority side: while the partition is
+        active it cannot reach the rendezvous KV either (the KV rides with
+        the launcher, on the second group's side of the cut)."""
+        return rank in self._a and self.active()
+
+    def note_blocked(self, sender: int, peer: int) -> None:
+        if self._logged:
+            return
+        self._logged = True
+        logger.warning(
+            "faultinject: network partition active — dropping frames "
+            "between rank %s and rank %s (and all other cross-group pairs)",
+            sender, peer)
+        from .. import blackbox
+        blackbox.record(blackbox.K_FAULT, "net",
+                        "partition blocking rank %d <-> rank %d"
+                        % (sender, peer), rank=sender)
+
 
 class Injector:
     """Executes a parsed fault plan for one rank."""
@@ -29,6 +81,9 @@ class Injector:
         self._hits = {}  # id(rule) -> hit count
         self._lock = threading.Lock()
         self._drop_cb: Optional[Callable[[], None]] = None
+        parts = [r for r in self._rules if r.kind == "partition"]
+        self.partition: Optional[Partition] = (
+            Partition(parts[0]) if parts else None)
 
     def active(self) -> bool:
         return bool(self._rules)
@@ -97,8 +152,25 @@ class FaultSocket:
     def __init__(self, sock, injector: Injector):
         self._sock = sock
         self._inj = injector
+        self._peer: Optional[int] = None
+
+    def set_peer(self, rank: Optional[int]) -> None:
+        """Tell the wrapper which rank sits on the other end, so partition
+        rules can decide whether this connection crosses the cut. None =
+        unknown (never partitioned)."""
+        self._peer = rank
 
     def sendall(self, data: bytes) -> None:
+        part = self._inj.partition
+        if part is not None and part.blocks(self._inj.rank, self._peer):
+            # the frame is dropped AND the socket severed: the sender sees
+            # the loss as a peer reset, driving the reconnect machinery
+            # instead of an unbounded recv() hang
+            part.note_blocked(self._inj.rank, self._peer)
+            self._close_quietly()
+            raise ConnectionError(
+                "faultinject: network partition between rank %s and rank %s"
+                % (self._inj.rank, self._peer))
         for kind, seconds in self._inj.actions_for("frame"):
             if kind == "delay":
                 time.sleep(seconds)

@@ -1,0 +1,153 @@
+"""Jepsen-lite history checker for fenced coordinator leadership.
+
+Replays the merged blackbox event logs of a finished (chaos) run and
+asserts the two safety properties the lease design promises
+(runtime/lease.py, docs/fault-tolerance.md):
+
+* **Single-writer leadership** — at no instant do two coordinators both
+  attest that they may serve. Leadership intervals are reconstructed from
+  ``K_FENCE`` events: a coordinator holds leadership from its
+  ``lease_acquired`` record until its ``self_fenced`` record — or, when it
+  never fenced (crashed outright, or the run ended while it led), until
+  its LAST successful ``lease_renewed``. The no-fence clip is
+  conservative by construction: past the final renewal nothing attests
+  leadership, and any REAL overlap inside that tail would have produced
+  its own evidence (the deposing acquirer's ``lease_acquired`` plus the
+  loser's eventual ``self_fenced`` or rejected frames).
+* **Exactly-once step application** — no rank applied the same training
+  step twice: the duplicate a zombie coordinator causes by re-running a
+  negotiation the new leader also ran. Step logs are supplied by the
+  harness (each rank's ordered list of applied step ids); the blackbox
+  does not record per-step events.
+
+Timestamps are the flight recorder's wall clock, so the checker is meant
+for single-host chaos runs (CI, ``partition@net`` specs) where every rank
+shares a clock; cross-host use would need the trace-clock offsets.
+
+The ``split_brain`` doctor signature (blackbox/signatures.py) is a thin
+wrapper over :func:`check_history`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Dict, Iterable, List, Optional
+
+#: matches the K_FENCE details written by runtime/lease.py
+_LEASE_RE = re.compile(
+    r"^(lease_acquired|lease_renewed|self_fenced) epoch=(\d+)")
+
+_K_FENCE = "fence"  # literal of blackbox.K_FENCE (no import: keeps this
+#                     module cycle-free under blackbox.signatures)
+
+
+def _iter_events(bundle: Dict[int, dict]):
+    for rank in sorted(bundle):
+        for ev in bundle[rank].get("events") or []:
+            yield rank, ev
+
+
+def leadership_intervals(bundle: Dict[int, dict]) -> List[dict]:
+    """Attested leadership spans, one per (rank, epoch), sorted by start:
+    ``{"rank", "epoch", "start", "end", "fenced"}`` with ``fenced`` True
+    when the span ended in an explicit ``self_fenced`` record."""
+    # (rank, epoch) -> [first_attest_t, last_attest_t, self_fenced_t|None]
+    spans: Dict[tuple, list] = {}
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != _K_FENCE:
+            continue
+        m = _LEASE_RE.match(ev.get("detail") or "")
+        if not m:
+            continue  # fenced_frame rejections are evidence, not tenure
+        what, epoch = m.group(1), int(m.group(2))
+        rank = int(ev.get("rank", src))
+        t = float(ev.get("t") or 0.0)
+        s = spans.setdefault((rank, epoch), [t, t, None])
+        if what == "self_fenced":
+            s[2] = t if s[2] is None else min(s[2], t)
+        else:
+            s[0] = min(s[0], t)
+            s[1] = max(s[1], t)
+    out = []
+    for (rank, epoch), (t0, t1, fenced_t) in spans.items():
+        end = fenced_t if fenced_t is not None else t1
+        out.append({"rank": rank, "epoch": epoch, "start": t0,
+                    "end": max(t0, end), "fenced": fenced_t is not None})
+    out.sort(key=lambda iv: (iv["start"], iv["epoch"]))
+    return out
+
+
+def fenced_frame_count(bundle: Dict[int, dict]) -> int:
+    """How many stamped frames from deposed epochs were rejected anywhere
+    in the job — the wire-level evidence that fencing actually bit."""
+    n = 0
+    for _, ev in _iter_events(bundle):
+        if (ev.get("kind") == _K_FENCE
+                and (ev.get("detail") or "").startswith("fenced_frame")):
+            n += 1
+    return n
+
+
+def check_history(bundle: Dict[int, dict],
+                  step_logs: Optional[Dict[int, Iterable]] = None) -> dict:
+    """Run every safety check; returns a verdict dict:
+
+    ``single_writer``/``exactly_once`` booleans, the human-readable
+    ``violations`` list (empty = clean history), the reconstructed
+    ``intervals``, and the job-wide ``fenced_frames`` rejection count."""
+    intervals = leadership_intervals(bundle)
+    violations: List[str] = []
+
+    # single writer: no two distinct holders' spans may overlap in time
+    for a, b in itertools.combinations(intervals, 2):
+        if (a["rank"], a["epoch"]) == (b["rank"], b["epoch"]):
+            continue
+        lo = max(a["start"], b["start"])
+        hi = min(a["end"], b["end"])
+        if lo < hi:
+            violations.append(
+                "split-brain: rank %d (epoch %d) and rank %d (epoch %d) "
+                "both attested leadership for %.3fs (t=%.3f..%.3f)"
+                % (a["rank"], a["epoch"], b["rank"], b["epoch"],
+                   hi - lo, lo, hi))
+
+    # an epoch names exactly one holder (the CAS hands it to one winner)
+    holder: Dict[int, int] = {}
+    for iv in intervals:
+        prev = holder.setdefault(iv["epoch"], iv["rank"])
+        if prev != iv["rank"]:
+            violations.append(
+                "epoch %d attested by two holders: rank %d and rank %d"
+                % (iv["epoch"], prev, iv["rank"]))
+
+    # epochs only move forward: a later acquisition under a lower epoch
+    # means a deposed coordinator re-won leadership it had already lost
+    high = 0
+    for iv in intervals:
+        if iv["epoch"] < high:
+            violations.append(
+                "epoch regression: rank %d acquired epoch %d at t=%.3f "
+                "after epoch %d was already held"
+                % (iv["rank"], iv["epoch"], iv["start"], high))
+        high = max(high, iv["epoch"])
+    single_writer = not violations
+
+    # exactly-once: no step id repeats within one rank's applied log
+    step_violations: List[str] = []
+    for rank in sorted(step_logs or {}):
+        seen = set()
+        for step in step_logs[rank]:
+            if step in seen:
+                step_violations.append(
+                    "duplicate apply: rank %s applied step %r twice"
+                    % (rank, step))
+            seen.add(step)
+
+    return {
+        "single_writer": single_writer,
+        "exactly_once": not step_violations,
+        "violations": violations + step_violations,
+        "intervals": intervals,
+        "fenced_frames": fenced_frame_count(bundle),
+    }

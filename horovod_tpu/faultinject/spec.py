@@ -40,6 +40,21 @@ A spec is a semicolon-separated list of rules, each of the form::
       so ``flaky_slow@rank:500:0.3#2`` slows ~30% of rank 2's steps and
       replays IDENTICALLY run to run (no RNG; the straggler policy's
       patience/hysteresis is tested against exactly this flapping)
+    - ``partition``  network partition at point ``net``:
+      ``partition@net:A|B[:heal_after[:start_after]]`` with ``A``/``B``
+      comma-separated rank groups (e.g. ``partition@net:0|1,2:6:2``).
+      While active, every control-plane frame crossing the group boundary
+      is dropped and the sending socket severed (a cut wire observed as a
+      peer reset — silent blackholing would require receive timeouts the
+      control plane deliberately does not have), in BOTH directions; the
+      FIRST group additionally loses the rendezvous KV (the KV rides with
+      the launcher on the second group's side of the cut, so the minority
+      coordinator cannot renew its leadership lease —
+      docs/fault-tolerance.md). The partition activates ``start_after``
+      seconds after process start (default 0) and heals deterministically
+      ``heal_after`` seconds later (omitted or 0 = never heals). Clocks
+      are per-process monotonic from module import, so co-started ranks
+      observe near-identical windows.
 * ``point`` — a named injection site. Frame-granular kinds fire inside the
   wrapped socket at point ``frame`` (one hit per sent frame); ``tick``,
   ``exchange``, ``connect`` and ``heartbeat`` are explicit hooks in
@@ -67,7 +82,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 KINDS = ("conn_drop", "delay", "corrupt", "truncate", "partial",
-         "nan", "desync", "hang", "die", "slow", "flaky_slow")
+         "nan", "desync", "hang", "die", "slow", "flaky_slow", "partition")
 
 # kinds applied to outgoing frames by the FaultSocket wrapper (as opposed to
 # the named fire() hooks in controller code)
@@ -85,17 +100,20 @@ _MS_KINDS = ("slow", "flaky_slow")
 class FaultRule:
     """One parsed rule; hit counting lives in the Injector."""
 
-    __slots__ = ("kind", "point", "nth", "seconds", "ranks", "prob")
+    __slots__ = ("kind", "point", "nth", "seconds", "ranks", "prob",
+                 "groups", "start")
 
     def __init__(self, kind: str, point: str, nth: Optional[int],
                  seconds: float, ranks: Optional[Sequence[int]],
-                 prob: float = 1.0):
+                 prob: float = 1.0, groups=None, start: float = 0.0):
         self.kind = kind
         self.point = point
         self.nth = nth            # 1-based hit index; None = every hit
-        self.seconds = seconds    # only meaningful for delay/hang
+        self.seconds = seconds    # delay/hang sleep; partition heal_after
         self.ranks = None if ranks is None else frozenset(ranks)
         self.prob = prob          # flaky_slow firing probability, else 1.0
+        self.groups = groups      # partition only: (frozenset A, frozenset B)
+        self.start = start        # partition only: activation delay seconds
 
     def applies_to(self, rank: int) -> bool:
         return self.ranks is None or rank in self.ranks
@@ -106,6 +124,14 @@ class FaultRule:
             extra = f":{self.seconds * 1000.0:g}"
         if self.kind == "flaky_slow":
             extra += f":{self.prob:g}"
+        if self.kind == "partition":
+            a, b = self.groups
+            extra = (":" + ",".join(str(r) for r in sorted(a)) + "|" +
+                     ",".join(str(r) for r in sorted(b)))
+            if self.seconds or self.start:
+                extra += f":{self.seconds:g}"
+            if self.start:
+                extra += f":{self.start:g}"
         nth = f":{self.nth}" if self.nth is not None else ""
         ranks = ("" if self.ranks is None
                  else "#" + ",".join(str(r) for r in sorted(self.ranks)))
@@ -142,6 +168,31 @@ def parse_spec(text: str) -> List[FaultRule]:
                 f"HOROVOD_FAULT_SPEC: rule {raw!r} names no point")
         args = parts[1:]
         prob = 1.0
+        if kind == "partition":
+            if point != "net":
+                raise ValueError(
+                    f"HOROVOD_FAULT_SPEC: partition fires at point 'net', "
+                    f"not {point!r} (rule {raw!r})")
+            if not args:
+                raise ValueError(
+                    f"HOROVOD_FAULT_SPEC: partition rule {raw!r} names no "
+                    f"rank groups (expected partition@net:A|B)")
+            gtext, _, btext = args[0].partition("|")
+            try:
+                ga = frozenset(int(r) for r in gtext.split(",") if r.strip())
+                gb = frozenset(int(r) for r in btext.split(",") if r.strip())
+                heal = float(args[1]) if len(args) > 1 else 0.0
+                start = float(args[2]) if len(args) > 2 else 0.0
+                if not ga or not gb or ga & gb or heal < 0 or start < 0:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"HOROVOD_FAULT_SPEC: bad partition rule {raw!r} "
+                    f"(expected partition@net:A|B[:heal_after[:start_after]] "
+                    f"with disjoint non-empty comma rank groups)")
+            rules.append(FaultRule(kind, point, None, heal, ranks,
+                                   groups=(ga, gb), start=start))
+            continue
         try:
             if kind == "flaky_slow":
                 if len(args) < 2:

@@ -328,6 +328,30 @@ def coord_failovers():
         "(HOROVOD_STANDBY_COORD; docs/control-plane.md).")
 
 
+def fencing_epoch():
+    return get_registry().gauge(
+        "hvd_fencing_epoch",
+        "Highest coordinator fencing epoch this process has observed "
+        "(0 until lease-based leadership is enabled or seen; "
+        "HOROVOD_LEASE_TTL; docs/fault-tolerance.md).", agg="max")
+
+
+def lease_renewals():
+    return get_registry().counter(
+        "hvd_lease_renewals_total",
+        "Successful coordinator-lease renewals by the active leader "
+        "(HOROVOD_LEASE_TTL/HOROVOD_LEASE_RENEW; a stalling rate here "
+        "predicts a self-fence; docs/fault-tolerance.md).")
+
+
+def frames_fenced():
+    return get_registry().counter(
+        "hvd_frames_fenced_total",
+        "Control frames rejected for carrying a stale fencing epoch — a "
+        "deposed-but-still-running coordinator's traffic being ignored "
+        "(docs/fault-tolerance.md).")
+
+
 def epoch_coalesced_joins():
     return get_registry().counter(
         "hvd_epoch_coalesced_joins_total",

@@ -66,7 +66,26 @@ class KVStoreServer:
                     self.send_response(400)
                     self.end_headers()
                     return
+                # compare-and-swap: X-HVD-If-Match carries the expected
+                # current value hex-encoded, or "absent" for "key must not
+                # exist yet". 412 on mismatch — the whole check-and-write is
+                # atomic under the store lock, which is what closes the
+                # lost-update race two blind writers would have.
+                expect = self.headers.get("X-HVD-If-Match")
                 with lock:
+                    if expect is not None:
+                        cur = store.get(key)
+                        if expect == "absent":
+                            ok = cur is None
+                        else:
+                            try:
+                                ok = cur == bytes.fromhex(expect)
+                            except ValueError:
+                                ok = False
+                        if not ok:
+                            self.send_response(412)
+                            self.end_headers()
+                            return
                     store[key] = body
                 self.send_response(200)
                 self.end_headers()
@@ -141,6 +160,27 @@ class KVStoreClient:
             f"{self._base}/{scope}/{key}", data=value, method="PUT",
             headers={"X-HVD-Sig": _sign(self._secret, value)})
         self._open(req).read()
+
+    def put_if(self, scope: str, key: str, value: bytes,
+               expected: Optional[bytes]) -> bool:
+        """Compare-and-swap: write ``value`` only if the key's current value
+        equals ``expected`` (``None`` = key must not exist). Returns whether
+        the swap won; ``False`` means another writer got there first."""
+        headers = {
+            "X-HVD-Sig": _sign(self._secret, value),
+            "X-HVD-If-Match":
+                "absent" if expected is None else expected.hex(),
+        }
+        req = urllib.request.Request(
+            f"{self._base}/{scope}/{key}", data=value, method="PUT",
+            headers=headers)
+        try:
+            self._open(req).read()
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 412:
+                return False
+            raise
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         try:

@@ -137,6 +137,13 @@ MSG_THB = 25
 # the knob is set.
 MSG_CKPT_MARK = wire.MSG_CKPT_MARK
 MSG_CKPT_DONE = wire.MSG_CKPT_DONE
+# Fenced-leadership plane (HOROVOD_LEASE_TTL, docs/fault-tolerance.md): a
+# coordinator that lost (or could not renew) the leadership lease answers
+# every frame with FENCED — stamped with its last-held fencing epoch — and
+# closes the connection. Workers treat it as a lost connection and redial
+# (finding the promoted standby via the failover probe); a receiver that
+# already follows a higher epoch rejects the frame outright.
+MSG_FENCED = 28
 
 # After a membership reset every surviving controller realigns its tick
 # counter to epoch * EPOCH_SEQ_BASE so the survivors' next exchanges land on
@@ -145,6 +152,12 @@ EPOCH_SEQ_BASE = 1 << 20
 
 _FUSABLE = (int(RequestType.ALLREDUCE), int(RequestType.ADASUM),
             int(RequestType.ALLGATHER))
+
+
+class CoordinatorFencedError(ConnectionError):
+    """This coordinator lost its leadership lease: it must not serve.
+    Subclasses ConnectionError so worker-facing handlers treat a fenced
+    exchange like a dead one (reconnect and find the promoted standby)."""
 
 
 # ---------------------------------------------------------------- coordinator
@@ -212,6 +225,12 @@ class CoordState:
         self.last_joined = -1
         self.bye = False
         self.shutdown_reason = ""
+        # fenced leadership (HOROVOD_LEASE_TTL, docs/fault-tolerance.md): a
+        # coordinator that lost its lease parks here — every exchange
+        # raises, every barrier wait releases, and the server answers
+        # MSG_FENCED until the process winds down
+        self.fenced = False
+        self.fence_reason = ""
         # response cache: name -> id (LRU-ordered; least recently touched
         # first) and id -> {rank: that rank's last full ReqMeta}. Per-rank
         # metas keep ragged allgathers cacheable (each rank's dim0 differs);
@@ -245,7 +264,9 @@ class CoordState:
         # ranks currently observed silent, for flight-recorder flap events
         # only (the metric ledger above keeps its own accounting)
         self._hb_silent: set = set()
-        # wall time of the last completed negotiation (/healthz freshness)
+        # monotonic time of the last completed negotiation (/healthz
+        # freshness age) — monotonic like every other liveness clock here,
+        # so an NTP step/slew cannot misreport the stall age
         self.last_negotiation = 0.0
         self.warned: set = set()
         # ---- elastic membership (docs/elastic.md). Non-elastic jobs keep
@@ -333,11 +354,34 @@ class CoordState:
         # set by the rank-0 CkptManager: fn(step, epoch, shards_dict)
         self.on_ckpt_finalize = None
 
+    def fence(self, reason: str) -> None:
+        """Park this coordinator: it lost (or could not renew) its
+        leadership lease, so serving ANY response from here on could
+        double-apply a step the promoted standby also applies. Every
+        blocked barrier wait releases with :class:`CoordinatorFencedError`
+        and every future exchange raises it immediately."""
+        with self.cv:
+            if self.fenced:
+                return
+            self.fenced = True
+            self.fence_reason = reason
+            self.cv.notify_all()
+        logger.error("coordinator: FENCED — %s; parking the exchange "
+                     "(workers will redial and follow the promoted "
+                     "standby; this process serves no further steps)",
+                     reason)
+
+    def _fence_check_locked(self) -> None:
+        if self.fenced:
+            raise CoordinatorFencedError(
+                "coordinator fenced: %s" % self.fence_reason)
+
     # ---- client entry: one call per rank per tick
     def exchange(self, rank: int, seq: int, payload: bytes) -> bytes:
         with self.cv:
             self.frames_in += 1
             self._flush_lost_locked()
+            self._fence_check_locked()
             if self.bye:
                 return self._shutdown_bytes()
             last = self.last_resp.get(rank)
@@ -382,6 +426,7 @@ class CoordState:
             instruments.coord_batch_ranks().labels(tier="host").observe(
                 len(entries))
             self._flush_lost_locked()
+            self._fence_check_locked()
             for rank, seq, payload in entries:
                 if self.bye:
                     replies.append((rank, seq, self._shutdown_bytes()))
@@ -446,6 +491,7 @@ class CoordState:
             instruments.coord_batch_ranks().labels(tier=str(tier)).observe(
                 sum(wire.runs_count(g[2]) for g in groups))
             self._flush_lost_locked()
+            self._fence_check_locked()
             shard = self.shards.setdefault(subtree, {})
             if groups:
                 # register the subtree's coverage (groups of one seq are
@@ -570,6 +616,7 @@ class CoordState:
         """A re-shipped group racing its original handler thread (still
         blocked in the barrier): wait for the shard entry it will write."""
         while True:
+            self._fence_check_locked()
             if self.bye:
                 return self._shutdown_bytes()
             cached = shard.get(seq)
@@ -587,6 +634,7 @@ class CoordState:
         """Barrier wait for a grouped deposit covering ``n`` ranks: all of
         them fetch in one count bump."""
         while seq not in self.resps:
+            self._fence_check_locked()
             if self.bye:
                 return self._shutdown_bytes()
             if self.elastic and self.epoch != entry_epoch:
@@ -676,6 +724,7 @@ class CoordState:
         cached response, shutdown bytes, or None if the original vanished
         without producing a result (caller re-enters normally)."""
         while True:
+            self._fence_check_locked()
             if self.bye:
                 return self._shutdown_bytes()
             last = self.last_resp.get(rank)
@@ -805,6 +854,7 @@ class CoordState:
 
     def _await_join_locked(self, rank: int) -> bytes:
         while rank not in self.members:
+            self._fence_check_locked()
             if self.bye:
                 self.pending_joins.discard(rank)
                 return self._shutdown_bytes()
@@ -818,6 +868,7 @@ class CoordState:
 
     def _await_locked(self, rank: int, seq: int, entry_epoch: int) -> bytes:
         while seq not in self.resps:
+            self._fence_check_locked()
             if self.bye:
                 return self._shutdown_bytes()
             if self.elastic and self.epoch != entry_epoch:
@@ -1257,6 +1308,7 @@ class CoordState:
          raw) = wire.decode_data_request(payload)
         key = (epoch, dseq)
         with self.cv:
+            self._fence_check_locked()
             if self.bye:
                 return self._data_error_locked()
             last = self.last_data_resp.get(rank)
@@ -1267,6 +1319,7 @@ class CoordState:
                 return last[1]
             if self.inflight_data.get(rank) == key:
                 while True:
+                    self._fence_check_locked()
                     if self.bye:
                         return self._data_error_locked()
                     last = self.last_data_resp.get(rank)
@@ -1308,6 +1361,7 @@ class CoordState:
         agg["parts"][rank] = (op, root, dtype, shape, raw)
         self._maybe_combine_locked(agg)
         while agg["result"] is None:
+            self._fence_check_locked()
             if self.bye:
                 return self._data_error_locked()
             if self.epoch != epoch:
@@ -1447,7 +1501,7 @@ class CoordState:
 
     def _negotiate(self, per_rank, seq: int = -1) -> bytes:
         flags = 0
-        self.last_negotiation = time.time()
+        self.last_negotiation = time.monotonic()
         if self.on_negotiate is not None:
             # fault hook (die@coordinator / slow@coordinator): runs under
             # self.cv by design — a brownout here stalls every rank, which
@@ -1915,7 +1969,7 @@ class CoordState:
         """Control-plane liveness snapshot for the /healthz endpoint
         (docs/observability.md)."""
         with self.cv:
-            age = (round(time.time() - self.last_negotiation, 3)
+            age = (round(time.monotonic() - self.last_negotiation, 3)
                    if self.last_negotiation else None)
             return {
                 "world_size": self.world,
@@ -1924,6 +1978,8 @@ class CoordState:
                 "elastic": self.elastic,
                 "shutting_down": self.bye,
                 "shutdown_reason": self.shutdown_reason,
+                "fenced": self.fenced,
+                "fence_reason": self.fence_reason,
                 "last_negotiation_age_s": age,
                 "disconnected": {str(r): why for r, (_, why)
                                  in self.disconnected.items()},
@@ -1941,13 +1997,21 @@ class CoordState:
 class CoordinatorServer:
     """TCP front-end for :class:`CoordState`; one handler thread per worker."""
 
-    def __init__(self, state: CoordState, secret: str, host: str = "0.0.0.0"):
+    def __init__(self, state: CoordState, secret: str, host: str = "0.0.0.0",
+                 local_rank: int = 0):
         self.state = state
         self.secret = secret
         self._stop = threading.Event()
-        # coordinator-side fault injection (rank 0 hosts the server);
-        # die@coordinator / slow@coordinator fire per negotiation round
-        self._faults = faultinject.for_rank(0)
+        # fencing epoch this coordinator HOLDS (its lease epoch); stamped on
+        # every outgoing frame. 0 = lease-based leadership off, which keeps
+        # every frame byte-identical to the pre-fencing wire format. A
+        # fenced coordinator keeps stamping its last-held epoch, which is
+        # exactly what lets receivers following a newer one reject it.
+        self.fence_epoch = 0
+        # coordinator-side fault injection in the hosting process (rank 0,
+        # or the standby's rank after a promotion); die@coordinator /
+        # slow@coordinator fire per negotiation round
+        self._faults = faultinject.for_rank(local_rank)
         if self._faults is not None:
             state.on_negotiate = self._negotiation_fault
         # per-rank connection generation: a serve thread that loses its
@@ -2042,6 +2106,7 @@ class CoordinatorServer:
     def _serve(self, conn) -> None:
         rank = -1
         gen = 0
+        seq = 0
         # ranks whose frames ride this connection as a host batch: all of
         # them are disconnected together if the connection dies, and any
         # that vanish from the batched heartbeat died locally at the host
@@ -2054,8 +2119,19 @@ class CoordinatorServer:
         # writes to a sub-coordinator connection need serializing
         send_lock = threading.Lock()
         try:
-            mt, _, rank, payload = wire.recv_frame(conn, self.secret,
-                                                   self._stop)
+            mt, seq0, rank, payload = wire.recv_frame(conn, self.secret,
+                                                      self._stop)
+            set_peer = getattr(conn, "set_peer", None)
+            if set_peer is not None:
+                # partition rules need to know which rank sits on the other
+                # end of this accepted connection
+                set_peer(rank)
+            if self.state.fenced:
+                # a fenced coordinator answers every dial — including the
+                # promoted standby's replication redial — with FENCED
+                # stamped with its last-held epoch, then hangs up
+                self._send_fenced(conn, seq0)
+                return
             if mt == MSG_REPL_HELLO:
                 self._serve_repl(conn, rank,
                                  payload.decode("utf-8", "replace")
@@ -2080,6 +2156,9 @@ class CoordinatorServer:
             while True:
                 mt, seq, rank, payload = wire.recv_frame(conn, self.secret,
                                                          self._stop)
+                if self.state.fenced:
+                    self._send_fenced(conn, seq)
+                    return
                 self.state.mark_alive(rank)
                 if mt == MSG_BYE:
                     self.state.set_bye()
@@ -2090,7 +2169,7 @@ class CoordinatorServer:
                 if mt == MSG_DATA:
                     data = self.state.data_exchange(rank, payload)
                     wire.send_frame(conn, self.secret, MSG_DATA_RESP, seq, 0,
-                                    data)
+                                    data, fence=self.fence_epoch)
                     continue
                 if mt == MSG_METRICS:
                     # fire-and-forget: store the rank's snapshot for the
@@ -2157,7 +2236,7 @@ class CoordinatorServer:
                     reply = wire.encode_clock_reply(
                         _tracing.clock.trace_us(), _tracing.ensure_trace_id())
                     wire.send_frame(conn, self.secret, MSG_CLOCK_RESP, seq,
-                                    0, reply)
+                                    0, reply, fence=self.fence_epoch)
                     continue
                 if mt == MSG_BATCH:
                     # one host's aggregated round: answer from a handler
@@ -2213,9 +2292,16 @@ class CoordinatorServer:
                 if mt != MSG_LIST:
                     raise ConnectionError(f"unexpected message type {mt}")
                 data = self.state.exchange(rank, seq, payload)
-                wire.send_frame(conn, self.secret, MSG_RESP, seq, 0, data)
+                wire.send_frame(conn, self.secret, MSG_RESP, seq, 0, data,
+                                fence=self.fence_epoch)
         except ShutdownError:
             pass
+        except CoordinatorFencedError:
+            # the state fenced while this thread was blocked in an
+            # exchange/barrier: answer FENCED (best effort) and hang up
+            # without opening a reconnect-grace window — a fenced
+            # coordinator must not mutate liveness state either
+            self._send_fenced(conn, seq)
         except (ConnectionError, OSError) as exc:
             if self._stop.is_set() or rank < 0:
                 return
@@ -2249,7 +2335,8 @@ class CoordinatorServer:
                 with send_lock:
                     wire.send_frame(conn, self.secret, MSG_BATCH_RESP,
                                     frame_seq, 0,
-                                    wire.encode_batched_entries(replies))
+                                    wire.encode_batched_entries(replies),
+                                    fence=self.fence_epoch)
             for rank, seq, payload in deferred:
                 # prospective joiners: their admission wait spans member
                 # commit rounds, so each gets its own thread and ships as
@@ -2258,6 +2345,9 @@ class CoordinatorServer:
                     target=self._handle_deferred,
                     args=(conn, rank, seq, payload, send_lock),
                     name="hvd_coord_join", daemon=True).start()
+        except CoordinatorFencedError:
+            with send_lock:
+                self._send_fenced(conn, frame_seq)
         except (ConnectionError, OSError, ShutdownError):
             pass  # the serve thread owns connection-loss reporting
 
@@ -2270,7 +2360,8 @@ class CoordinatorServer:
                 with send_lock:
                     wire.send_frame(conn, self.secret, MSG_TBATCH_RESP,
                                     frame_seq, 0,
-                                    wire.encode_tier_batch_resp(replies))
+                                    wire.encode_tier_batch_resp(replies),
+                                    fence=self.fence_epoch)
             for rank, seq, payload in deferred:
                 # prospective joiners drop out of the grouped path: their
                 # admission wait spans member commit rounds, so each ships
@@ -2279,6 +2370,9 @@ class CoordinatorServer:
                     target=self._handle_deferred,
                     args=(conn, rank, seq, payload, send_lock),
                     name="hvd_coord_join", daemon=True).start()
+        except CoordinatorFencedError:
+            with send_lock:
+                self._send_fenced(conn, frame_seq)
         except (ConnectionError, OSError, ShutdownError):
             pass  # the serve thread owns connection-loss reporting
 
@@ -2289,7 +2383,11 @@ class CoordinatorServer:
             with send_lock:
                 wire.send_frame(
                     conn, self.secret, MSG_BATCH_RESP, 0, 0,
-                    wire.encode_batched_entries([(rank, seq, data)]))
+                    wire.encode_batched_entries([(rank, seq, data)]),
+                    fence=self.fence_epoch)
+        except CoordinatorFencedError:
+            with send_lock:
+                self._send_fenced(conn, seq)
         except (ConnectionError, OSError, ShutdownError):
             pass
 
@@ -2313,20 +2411,41 @@ class CoordinatorServer:
                     " (subtree %s)" % subtree if subtree else "")
         try:
             while not self._stop.is_set():
+                if self.state.fenced:
+                    # the stream's truth ends here: a fenced coordinator
+                    # must not keep feeding a standby state it no longer
+                    # owns. FENCED (not BYE) so the standby knows why.
+                    self._send_fenced(conn, 0)
+                    return
                 try:
                     mt, payload = q.get(timeout=0.5)
                 except _queue.Empty:
                     if self.state.bye:
                         break
                     continue
-                wire.send_frame(conn, self.secret, mt, 0, 0, payload)
+                wire.send_frame(conn, self.secret, mt, 0, 0, payload,
+                                fence=self.fence_epoch)
                 instruments.standby_journal_lag().labels(
                     tier=lag_tier).set(q.qsize())
-            wire.send_frame(conn, self.secret, MSG_BYE, 0, 0)
+            wire.send_frame(conn, self.secret, MSG_BYE, 0, 0,
+                            fence=self.fence_epoch)
         except (ConnectionError, OSError):
             pass
         finally:
             self.state.detach_journal(q)
+
+    def _send_fenced(self, conn, seq: int) -> None:
+        """Answer a frame from a fenced coordinator: MSG_FENCED stamped with
+        the LAST-HELD epoch (receivers following a newer one reject it —
+        ticking hvd_frames_fenced_total — and everyone else treats it as a
+        dead connection and redials toward the promoted standby)."""
+        try:
+            wire.send_frame(
+                conn, self.secret, MSG_FENCED, seq, 0,
+                self.state.fence_reason.encode("utf-8", "replace")[:512],
+                fence=self.fence_epoch)
+        except (ConnectionError, OSError):
+            pass
 
     def stop(self) -> None:
         self._stop.set()
@@ -2476,6 +2595,12 @@ class CoordController:
         self._score_epoch: Optional[float] = None
         # ---- fault tolerance (docs/fault-tolerance.md)
         self._faults = faultinject.for_rank(self_rank)
+        # ---- fenced leadership (runtime/lease.py): the guard tracks the
+        # highest fencing epoch this worker has observed and rejects frames
+        # from deposed coordinators; the lease handle exists on rank 0 only
+        # (and only with HOROVOD_LEASE_TTL set)
+        self._guard = wire.FenceGuard(rank=self_rank)
+        self._lease = None
         self._last_acked = -1  # highest seq whose response fully arrived
         self._reconnect_attempts = int(
             _env_float("HOROVOD_RECONNECT_ATTEMPTS", 8))
@@ -2561,6 +2686,15 @@ class CoordController:
             bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
             self._server: Optional[CoordinatorServer] = CoordinatorServer(
                 self._state, self._secret, host=bind)
+            from . import lease as _lease
+            if _lease.lease_enabled():
+                # take the lease BEFORE publishing the address: the first
+                # frame any worker receives is already epoch-stamped
+                self._lease = _lease.LeaseManager(gen, 0)
+                ep = self._lease.acquire_initial()
+                self._server.fence_epoch = ep
+                self._guard.observe(ep)
+                self._lease.start_renewing(self._fence_primary)
             _publish(gen, f"{advertise}:{self._server.port}", self._secret)
             self._sock: Optional[socket.socket] = None
             self._addr = "in-process"
@@ -2618,6 +2752,8 @@ class CoordController:
             self._sock.settimeout(0.5)
             if self._faults is not None:
                 self._sock = self._faults.wrap(self._sock)
+                # partition attribution: this socket talks to rank 0
+                self._sock.set_peer(0)
             wire.send_frame(self._sock, self._secret, MSG_HELLO, 0,
                             self_rank)
             # trace clock handshake before the heartbeat thread exists: the
@@ -2868,10 +3004,21 @@ class CoordController:
                 assert sock is not None
                 with self._send_lock:
                     wire.send_frame(sock, self._secret, msg_type, frame_seq,
-                                    self._rank, payload)
+                                    self._rank, payload,
+                                    fence=self._guard.epoch)
                 while True:
                     mt, rseq, _, data = wire.recv_frame(sock, self._secret,
-                                                        self._stop)
+                                                        self._stop,
+                                                        guard=self._guard)
+                    if mt == MSG_FENCED:
+                        # the peer lost its leadership lease: treat like a
+                        # connection loss so the reconnect path (and its
+                        # failover probing) finds the new leader
+                        raise ConnectionError(
+                            "coordinator at %s is fenced (%s)" % (
+                                self._addr,
+                                data.decode("utf-8", "replace")
+                                or "lost leadership lease"))
                     if mt == resp_type and rseq == frame_seq:
                         return data
             except (ConnectionError, OSError) as exc:
@@ -2905,7 +3052,8 @@ class CoordController:
                     if self._bye_sent or self._sock is None:
                         return
                     wire.send_frame(self._sock, self._secret, MSG_HEARTBEAT,
-                                    0, self._rank)
+                                    0, self._rank,
+                                    fence=self._guard.epoch)
             except (ConnectionError, OSError):
                 pass
 
@@ -2937,9 +3085,14 @@ class CoordController:
                 sock.settimeout(0.5)
                 if self._faults is not None:
                     sock = self._faults.wrap(sock)
+                    # after a followed failover the peer is a promoted
+                    # standby, not rank 0 — leave it unattributed so the
+                    # partition rule cannot misfire on the new pair
+                    sock.set_peer(0 if self._fo == 0 else None)
                 wire.send_frame(sock, self._secret, MSG_RESUME, 0,
                                 self._rank,
-                                wire.encode_resume(self._last_acked))
+                                wire.encode_resume(self._last_acked),
+                                fence=self._guard.epoch)
             except (ConnectionError, OSError) as exc:
                 last = exc
                 continue
@@ -3056,6 +3209,15 @@ class CoordController:
                           c["stall_warning_s"], c["stall_shutdown_s"],
                           tuner=None, elastic=True)
 
+    def _fence_primary(self, reason: str) -> None:
+        """Lease renewal-thread callback on rank 0: the lease was lost
+        (deposed) or unrenewable past the fence deadline — park the
+        exchange NOW so no frame from this stale leader is ever obeyed.
+        The server keeps answering with MSG_FENCED so late dials learn
+        why (runtime/lease.py self-records the blackbox event)."""
+        if self._state is not None:
+            self._state.fence(reason)
+
     def _probe_failover(self) -> None:
         """A dead primary may have left a promoted standby behind: look for
         the next failover address with a short timeout and, if published,
@@ -3066,6 +3228,12 @@ class CoordController:
         except Exception:
             return  # nothing promoted (yet); keep redialing the old address
         self._fo += 1
+        from . import lease as _lease
+        if _lease.lease_enabled():
+            # the promoted standby bumped the fencing epoch when it took
+            # the lease; learn it here so frames from the deposed primary
+            # are rejected from the very first exchange with the new leader
+            self._guard.observe(_lease.read_lease_epoch(self._gen))
         host, port = addr.rsplit(":", 1)
         if not self._hier:
             # hierarchical workers stay pinned to their LOCAL
@@ -3120,10 +3288,17 @@ class CoordController:
                         self._direct_sock = sock
                 with self._direct_send_lock:
                     wire.send_frame(sock, self._secret0, msg_type,
-                                    frame_seq, self._rank, payload)
+                                    frame_seq, self._rank, payload,
+                                    fence=self._guard.epoch)
                 while True:
                     mt, rseq, _, data = wire.recv_frame(
-                        sock, self._secret0, self._stop)
+                        sock, self._secret0, self._stop, guard=self._guard)
+                    if mt == MSG_FENCED:
+                        raise ConnectionError(
+                            "coordinator at %s:%s is fenced (%s)" % (
+                                self._host0, self._port0,
+                                data.decode("utf-8", "replace")
+                                or "lost leadership lease"))
                     if mt == resp_type and rseq == frame_seq:
                         return data
             except (ConnectionError, OSError) as exc:
@@ -3398,6 +3573,8 @@ class CoordController:
             pass
         if self._standby_coord is not None:
             self._standby_coord.stop()
+        if self._lease is not None:
+            self._lease.stop()
         self._send_bye()
         self._stop.set()
         with self._lock:
@@ -3426,12 +3603,24 @@ class CoordController:
         for agg in self._tier_aggs:
             agg.stop()
         if self._server is not None:
-            # set_bye already ran (via _send_bye), so any rank still blocked
-            # in an exchange has been released with a shutdown response;
-            # stragglers that connect later see a reset and treat it as
-            # shutdown. Stopping here frees the port and accept thread so
-            # shutdown()+init() cycles don't leak.
-            self._server.stop()
+            if self._state is not None and self._state.fenced:
+                # a fenced coordinator keeps its listener up for the rest of
+                # the process lifetime: peers partitioned away from it must,
+                # after the heal, receive an explicit FENCED stamped with the
+                # deposed epoch — a refused connection is indistinguishable
+                # from a crash and would leave them probing forever. The
+                # accept loop is a daemon thread; the port dies with the
+                # process, and a fence is terminal for this generation so no
+                # shutdown()+init() cycle ever reuses this server.
+                logger.info("coordinator: fenced — leaving the FENCED "
+                            "responder up until process exit")
+            else:
+                # set_bye already ran (via _send_bye), so any rank still
+                # blocked in an exchange has been released with a shutdown
+                # response; stragglers that connect later see a reset and
+                # treat it as shutdown. Stopping here frees the port and
+                # accept thread so shutdown()+init() cycles don't leak.
+                self._server.stop()
         self._timeline.close()
         if self._state is not None and self._state.tuner is not None:
             self._state.tuner.close()

@@ -57,10 +57,11 @@ from ..metrics import instruments
 from ..utils.env import env_float as _env_float
 from . import wire
 from .coordinator import (MSG_BATCH, MSG_BATCH_HB, MSG_BATCH_RESP,
-                          MSG_BLACKBOX, MSG_BYE, MSG_HEARTBEAT, MSG_HELLO,
-                          MSG_LIST, MSG_METRICS, MSG_RESP, MSG_RESUME,
-                          MSG_TBATCH, MSG_TBATCH_RESP, MSG_THB, MSG_TRACE,
-                          _backoff_schedule, _publish_key, _resolve_key)
+                          MSG_BLACKBOX, MSG_BYE, MSG_FENCED, MSG_HEARTBEAT,
+                          MSG_HELLO, MSG_LIST, MSG_METRICS, MSG_RESP,
+                          MSG_RESUME, MSG_TBATCH, MSG_TBATCH_RESP, MSG_THB,
+                          MSG_TRACE, _backoff_schedule, _publish_key,
+                          _resolve_key)
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -398,6 +399,10 @@ class SubCoordinator:
             self.gagg = None
         self._bseq = 0
         self._up_send_lock = threading.Lock()
+        # fenced leadership: track the highest fencing epoch seen on the
+        # upstream stream and reject frames from deposed coordinators
+        # (runtime/lease.py; epoch 0 = lease off, wire unchanged)
+        self._guard = wire.FenceGuard(rank=leader_rank)
         self._up = self._dial_upstream(MSG_HELLO)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -424,7 +429,7 @@ class SubCoordinator:
         payload = (wire.encode_resume(-1) if hello_type == MSG_RESUME
                    else b"")
         wire.send_frame(sock, self._secret, hello_type, 0, self._leader,
-                        payload)
+                        payload, fence=self._guard.epoch)
         return sock
 
     def _next_bseq(self) -> int:
@@ -449,7 +454,8 @@ class SubCoordinator:
         try:
             with self._up_send_lock:
                 wire.send_frame(self._up, self._secret, MSG_BATCH,
-                                self._next_bseq(), self._leader, payload)
+                                self._next_bseq(), self._leader, payload,
+                                fence=self._guard.epoch)
         except (ConnectionError, OSError):
             pass
 
@@ -463,7 +469,8 @@ class SubCoordinator:
         try:
             with self._up_send_lock:
                 wire.send_frame(self._up, self._secret, MSG_TBATCH,
-                                self._next_bseq(), self._leader, payload)
+                                self._next_bseq(), self._leader, payload,
+                                fence=self._guard.epoch)
         except (ConnectionError, OSError):
             pass
 
@@ -471,7 +478,8 @@ class SubCoordinator:
         """Fire-and-forget relay of telemetry/BYE frames, rank preserved."""
         try:
             with self._up_send_lock:
-                wire.send_frame(self._up, self._secret, mt, 0, rank, payload)
+                wire.send_frame(self._up, self._secret, mt, 0, rank, payload,
+                                fence=self._guard.epoch)
         except (ConnectionError, OSError):
             pass
 
@@ -479,7 +487,16 @@ class SubCoordinator:
         while not self._stop.is_set():
             try:
                 mt, _, _, payload = wire.recv_frame(self._up, self._secret,
-                                                    self._stop)
+                                                    self._stop,
+                                                    guard=self._guard)
+                if mt == MSG_FENCED:
+                    # the upstream coordinator lost its leadership lease:
+                    # treat like a dead upstream — reconnect probes the
+                    # failover keys for the new leader
+                    raise ConnectionError(
+                        "upstream coordinator fenced (%s)"
+                        % (payload.decode("utf-8", "replace")
+                           or "lost leadership lease"))
             except ShutdownError:
                 return
             except (ConnectionError, OSError) as exc:
@@ -633,7 +650,8 @@ class SubCoordinator:
             try:
                 with self._up_send_lock:
                     wire.send_frame(self._up, self._secret, mt, 0,
-                                    self._leader, payload)
+                                    self._leader, payload,
+                                    fence=self._guard.epoch)
             except (ConnectionError, OSError):
                 pass  # recv loop owns reconnect
 

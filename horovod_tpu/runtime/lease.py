@@ -1,0 +1,237 @@
+"""Lease-based fenced coordinator leadership (docs/fault-tolerance.md).
+
+The crash-only failover from the warm-standby plane cannot distinguish a
+*dead* rank 0 from a *partitioned* one: both look like a lost replication
+stream, and promoting on the latter yields two live coordinators. This
+module closes that hole with a TTL lease in the rendezvous KV:
+
+* The active coordinator holds ``lease.{gen}`` (value
+  ``"{fence_epoch}:{owner_rank}:{renewal_count}"``) and compare-and-swap
+  renews it every ``HOROVOD_LEASE_RENEW`` seconds, bumping the count.
+* A holder that cannot renew **self-fences** — stops serving and parks its
+  exchange — once ``FENCE_FRACTION * TTL`` has elapsed since its last
+  successful renewal, strictly before the TTL.
+* A standby promotes only by *acquiring* the lease: it requires the value
+  to sit unchanged for a full TTL measured on its **own monotonic clock**
+  (observed stasis — no cross-host clock comparison anywhere), then CAS-es
+  in ``epoch+1`` with itself as owner. The CAS means exactly one of any
+  number of racing acquirers wins.
+
+TTL arithmetic: the holder fences at ``last_renewal + FENCE_FRACTION*TTL``
+on its clock; an acquirer moves at ``last_observed_change + TTL`` on its
+clock, and the observed change happened *after* the holder's renewal was
+written. With FENCE_FRACTION < 1 the fence strictly precedes any takeover,
+so no instant has two serving coordinators — the invariant the jepsen-lite
+checker (`faultinject/jepsen.py`) replays blackbox logs to verify.
+
+The lease is explicitly opt-in (``HOROVOD_LEASE_TTL`` set) and requires the
+launcher KV (``HVD_KV_ADDR``): the jax.distributed fallback KV has no CAS.
+With the knob unset nothing here runs and the wire stays byte-identical to
+the pre-fencing format (fencing epoch 0 is never stamped).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from .. import blackbox as _blackbox
+from .. import faultinject
+from ..metrics import instruments
+
+logger = logging.getLogger("horovod_tpu")
+
+LEASE_SCOPE = "hvdcoord"
+
+# A holder self-fences this fraction of the TTL after its last successful
+# renewal — strictly before any acquirer (who waits a full TTL) can move.
+FENCE_FRACTION = 0.75
+
+
+def lease_enabled() -> bool:
+    return bool(os.environ.get("HOROVOD_LEASE_TTL")) and bool(
+        os.environ.get("HVD_KV_ADDR"))
+
+
+def lease_ttl() -> float:
+    v = os.environ.get("HOROVOD_LEASE_TTL")
+    return float(v) if v else 10.0
+
+
+def lease_renew_interval() -> float:
+    v = os.environ.get("HOROVOD_LEASE_RENEW")
+    return float(v) if v else lease_ttl() / 4.0
+
+
+def _parse_value(raw: Optional[bytes]) -> Optional[Tuple[int, int, int]]:
+    """(fence_epoch, owner_rank, renewal_count), or None for absent/garbage."""
+    if raw is None:
+        return None
+    try:
+        epoch, owner, count = raw.decode().split(":")
+        return int(epoch), int(owner), int(count)
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def read_lease_epoch(gen: int) -> int:
+    """Best-effort read of the current fencing epoch — used by workers on
+    failover probes to seed their FenceGuard. 0 when no lease exists."""
+    kv_addr = os.environ.get("HVD_KV_ADDR")
+    if not kv_addr:
+        return 0
+    try:
+        from ..run.rendezvous import KVStoreClient
+
+        client = KVStoreClient(kv_addr, os.environ.get("HVD_SECRET", ""),
+                               timeout=2.0)
+        parsed = _parse_value(client.get(LEASE_SCOPE, f"lease.{gen}"))
+        return parsed[0] if parsed else 0
+    except (ConnectionError, OSError):
+        return 0
+
+
+class LeaseManager:
+    """One rank's handle on the leadership lease for one init generation.
+
+    Holder side: :meth:`acquire_initial` / :meth:`acquire_over` +
+    :meth:`start_renewing`. Acquirer side: :meth:`read` polled by the
+    standby's lease watcher, which calls :meth:`acquire_over` once it has
+    observed a full TTL of stasis.
+    """
+
+    def __init__(self, gen: int, rank: int):
+        from ..run.rendezvous import KVStoreClient
+
+        self._key = f"lease.{gen}"
+        self._rank = rank
+        self._client = KVStoreClient(
+            os.environ["HVD_KV_ADDR"], os.environ.get("HVD_SECRET", ""),
+            timeout=2.0)
+        self.ttl = lease_ttl()
+        self.renew_interval = min(lease_renew_interval(), self.ttl / 2.0)
+        self._epoch = 0
+        self._count = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def _check_partition(self) -> None:
+        part = faultinject.partition_for_rank(self._rank)
+        if part is not None and part.blocks_kv(self._rank):
+            raise ConnectionError(
+                "faultinject: rendezvous KV unreachable from rank %d "
+                "(network partition)" % self._rank)
+
+    def _value(self) -> bytes:
+        return f"{self._epoch}:{self._rank}:{self._count}".encode()
+
+    def read(self) -> Optional[bytes]:
+        """Raw lease value (None = absent). Raises ConnectionError when the
+        KV is unreachable — the caller must NOT treat that as stasis."""
+        self._check_partition()
+        return self._client.get(LEASE_SCOPE, self._key)
+
+    def acquire_initial(self) -> int:
+        """Rank 0 at startup: take epoch 1 via an absent-CAS. A leftover
+        value (coordinator restart inside one generation) is superseded by
+        CAS-ing epoch+1 over whatever is there."""
+        self._check_partition()
+        self._epoch, self._count = 1, 0
+        if self._client.put_if(LEASE_SCOPE, self._key, self._value(), None):
+            self._record("lease_acquired epoch=%d" % self._epoch)
+            return self._epoch
+        for _ in range(3):
+            cur = self._client.get(LEASE_SCOPE, self._key)
+            parsed = _parse_value(cur)
+            self._epoch = (parsed[0] + 1) if parsed else 1
+            self._count = 0
+            if self._client.put_if(LEASE_SCOPE, self._key, self._value(),
+                                   cur):
+                self._record("lease_acquired epoch=%d" % self._epoch)
+                return self._epoch
+        raise ConnectionError(
+            "could not acquire the leadership lease %s: the key kept "
+            "moving under CAS (another live coordinator?)" % self._key)
+
+    def acquire_over(self, observed: Optional[bytes]) -> Optional[int]:
+        """Standby takeover: CAS ``observed`` (the stale value it watched
+        for a full TTL) to epoch+1 owned by this rank. None = lost the race
+        to another acquirer or a revived holder; raises on KV loss."""
+        self._check_partition()
+        parsed = _parse_value(observed)
+        new_epoch = (parsed[0] + 1) if parsed else 1
+        old_epoch, old_count = self._epoch, self._count
+        self._epoch, self._count = new_epoch, 0
+        if self._client.put_if(LEASE_SCOPE, self._key, self._value(),
+                               observed):
+            self._record("lease_acquired epoch=%d" % new_epoch)
+            return new_epoch
+        self._epoch, self._count = old_epoch, old_count
+        return None
+
+    def start_renewing(self, on_fence: Callable[[str], None]) -> None:
+        """Run the holder's renewal loop on a daemon thread. ``on_fence`` is
+        invoked exactly once — from the renewal thread — if the lease is
+        lost (CAS superseded) or unrenewable past the fence deadline."""
+        self._thread = threading.Thread(
+            target=self._renew_loop, args=(on_fence,),
+            name="hvd_lease_renew", daemon=True)
+        self._thread.start()
+
+    def _renew_loop(self, on_fence: Callable[[str], None]) -> None:
+        last_ok = time.monotonic()
+        fence_after = self.ttl * FENCE_FRACTION
+        while not self._stop.wait(self.renew_interval):
+            try:
+                # the KV client rides a plain socket, not the wrapped
+                # control plane: the partition cut must be asked explicitly
+                # or an injected outage would never reach the renewal path
+                self._check_partition()
+                expected = self._value()
+                self._count += 1
+                if self._client.put_if(LEASE_SCOPE, self._key, self._value(),
+                                       expected):
+                    last_ok = time.monotonic()
+                    instruments.lease_renewals().inc()
+                    self._record("lease_renewed epoch=%d count=%d"
+                                 % (self._epoch, self._count))
+                    continue
+                # CAS mismatch: somebody else moved the lease — this
+                # coordinator is deposed, fence NOW regardless of deadline
+                self._count -= 1
+                self._record("self_fenced epoch=%d reason=deposed"
+                             % self._epoch)
+                on_fence("leadership lease %s superseded (deposed)"
+                         % self._key)
+                return
+            except (ConnectionError, OSError) as exc:
+                self._count -= 1
+                logger.warning(
+                    "lease: renewal of %s failed (%s); fencing in %.1fs "
+                    "unless the KV comes back", self._key, exc,
+                    max(0.0, fence_after - (time.monotonic() - last_ok)))
+            if time.monotonic() - last_ok >= fence_after:
+                self._record("self_fenced epoch=%d reason=renewal_timeout"
+                             % self._epoch)
+                on_fence(
+                    "could not renew leadership lease %s for %.1fs "
+                    "(%.0f%% of the %.1fs TTL)"
+                    % (self._key, time.monotonic() - last_ok,
+                       FENCE_FRACTION * 100, self.ttl))
+                return
+
+    def _record(self, detail: str) -> None:
+        _blackbox.record(_blackbox.K_FENCE, "rank_%d" % self._rank, detail,
+                         rank=self._rank)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

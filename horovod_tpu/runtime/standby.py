@@ -43,27 +43,39 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 from typing import Callable, List, Optional
 
 from ..metrics import instruments
 from .. import blackbox as _blackbox
+from .. import faultinject
 from ..exceptions import ShutdownError
+from . import lease as _lease_mod
 from . import wire
-from .coordinator import (MSG_BYE, MSG_JOURNAL, MSG_REPL_HELLO, MSG_SNAPSHOT,
-                          CoordinatorServer, _advertise_host, _publish_key)
+from .coordinator import (MSG_BYE, MSG_FENCED, MSG_JOURNAL, MSG_REPL_HELLO,
+                          MSG_SNAPSHOT, CoordinatorServer, _advertise_host,
+                          _publish_key)
 
 logger = logging.getLogger("horovod_tpu")
 
 
 def dial_repl(addr, secret: str, rank: int, hello_payload: bytes = b"",
-              timeout: float = 5.0) -> socket.socket:
+              timeout: float = 5.0, faults=None, peer: Optional[int] = None,
+              fence: int = 0) -> socket.socket:
     """Open a replication-framed stream: connect and send MSG_REPL_HELLO.
     The hello payload names the stream's role — empty for a standby
     coordinator, a subtree tag for a sharded standby, ``push:{index}`` /
-    ``fetch:{index}`` for checkpoint buddy journaling (ckpt/buddy.py)."""
+    ``fetch:{index}`` for checkpoint buddy journaling (ckpt/buddy.py).
+    ``faults``/``peer`` wrap the socket for fault injection attributed to
+    the given remote rank (partition rules); ``fence`` stamps the hello
+    with the dialer's fencing epoch."""
     sock = socket.create_connection(addr, timeout=timeout)
     sock.settimeout(0.5)
-    wire.send_frame(sock, secret, MSG_REPL_HELLO, 0, rank, hello_payload)
+    if faults is not None:
+        sock = faults.wrap(sock)
+        sock.set_peer(peer)
+    wire.send_frame(sock, secret, MSG_REPL_HELLO, 0, rank, hello_payload,
+                    fence=fence)
     return sock
 
 
@@ -92,6 +104,14 @@ class StandbyCoordinator:
         self._next_cache_id = 0
         self.promoted = False
         self.server: Optional[CoordinatorServer] = None
+        # fenced leadership (runtime/lease.py): with the lease enabled the
+        # standby NEVER promotes on stream loss alone — only by acquiring
+        # the lease after observing a full TTL of stasis on its own clock
+        self._faults = faultinject.for_rank(rank)
+        self._guard = wire.FenceGuard(rank=rank)
+        self._lease = (_lease_mod.LeaseManager(gen, rank)
+                       if _lease_mod.lease_enabled() else None)
+        self._lease_watching = False
         self._thread = threading.Thread(
             target=self._run, name="hvd_standby", daemon=True)
 
@@ -102,6 +122,8 @@ class StandbyCoordinator:
         """Intentional stand-down (worker shutdown/interrupt): never treat
         the teardown that follows as a dead primary."""
         self._stop.set()
+        if self._lease is not None:
+            self._lease.stop()
         with self._lock:
             server = self.server
         if server is not None:
@@ -114,7 +136,9 @@ class StandbyCoordinator:
 
     # ------------------------------------------------------------ replication
     def _dial(self) -> socket.socket:
-        return dial_repl(self._addr, self._secret, self._rank)
+        return dial_repl(self._addr, self._secret, self._rank,
+                         faults=self._faults, peer=0,
+                         fence=self._guard.epoch)
 
     def _run(self) -> None:
         sock: Optional[socket.socket] = None
@@ -133,12 +157,30 @@ class StandbyCoordinator:
             while not self._stop.is_set():
                 try:
                     mt, _, _, payload = wire.recv_frame(sock, self._secret,
-                                                        self._stop)
+                                                        self._stop,
+                                                        guard=self._guard)
                 except ShutdownError:
+                    return
+                except wire.FenceError as exc:
+                    # a frame stamped with a deposed epoch: once this
+                    # standby holds the lease, that is the old primary
+                    # confirming it fenced — the stream is done for good
+                    logger.info("standby: deposed primary's frame rejected "
+                                "(%s); replication stream closed", exc)
                     return
                 except (ConnectionError, OSError) as exc:
                     if self._stop.is_set():
                         return
+                    if self._lease is not None:
+                        # lease mode: promotion belongs to the lease watcher
+                        # alone; keep redialing through the outage so a
+                        # revived (or healed) primary finds us again — and
+                        # so a fenced one can tell us it fenced
+                        redialed = self._redial_lease()
+                        if redialed is None:
+                            return
+                        sock = redialed
+                        continue
                     redialed = self._redial()
                     if redialed is not None:
                         sock = redialed
@@ -154,9 +196,25 @@ class StandbyCoordinator:
                     self._have_snapshot = True
                     instruments.standby_journal_lag().labels(
                         tier="root").set(0)
+                    if self._lease is not None and not self._lease_watching:
+                        self._lease_watching = True
+                        threading.Thread(target=self._lease_watch,
+                                         name="hvd_lease_watch",
+                                         daemon=True).start()
                 elif mt == MSG_JOURNAL:
                     (self._jseq, self._epoch, self._members,
                      _reason) = wire.decode_coord_journal(payload)
+                elif mt == MSG_FENCED:
+                    # the primary self-fenced but we do not hold the lease
+                    # (yet): the watcher decides promotion; keep redialing
+                    logger.warning(
+                        "standby: primary reports itself fenced (%s); "
+                        "awaiting lease takeover",
+                        payload.decode("utf-8", "replace") or "no reason")
+                    redialed = self._redial_lease()
+                    if redialed is None:
+                        return
+                    sock = redialed
                 elif mt == MSG_BYE:
                     # clean coordinator end: stand down, never promote
                     logger.info("standby: primary said BYE; standing down")
@@ -179,8 +237,68 @@ class StandbyCoordinator:
                 continue
         return None
 
+    def _redial_lease(self) -> Optional[socket.socket]:
+        """Lease-mode redial: patient (a partition can outlast any sane
+        blip window) but bounded — the lease watcher owns promotion, this
+        loop only keeps a path open for the primary's BYE or FENCED."""
+        for _ in range(120):
+            if self._stop.wait(0.5):
+                return None
+            try:
+                return self._dial()
+            except (ConnectionError, OSError):
+                continue
+        return None
+
+    # ---------------------------------------------------------- lease watcher
+    def _lease_watch(self) -> None:
+        """Observed-stasis takeover: poll the lease key and promote only
+        after it sat UNCHANGED for a full TTL measured on this process's
+        monotonic clock, and only by winning the CAS (runtime/lease.py).
+        KV unreachability is never evidence of stasis — renewals may be
+        happening where we cannot see them — so it resets the clock."""
+        assert self._lease is not None
+        poll = min(self._lease.renew_interval, 0.25)
+        ttl = self._lease.ttl
+        last_val: Optional[bytes] = None
+        last_change = time.monotonic()
+        while not self._stop.wait(poll):
+            if self.promoted:
+                return
+            try:
+                val = self._lease.read()
+            except (ConnectionError, OSError):
+                last_change = time.monotonic()
+                continue
+            if val != last_val:
+                last_val = val
+                last_change = time.monotonic()
+                continue
+            stasis = time.monotonic() - last_change
+            if stasis < ttl:
+                continue
+            if not (self._have_snapshot and self._should_promote()):
+                continue
+            try:
+                epoch = self._lease.acquire_over(val)
+            except (ConnectionError, OSError):
+                last_change = time.monotonic()
+                continue
+            if epoch is None:
+                # lost the CAS race (another acquirer, or the holder came
+                # back): restart observation from the new value
+                last_val = None
+                last_change = time.monotonic()
+                continue
+            self._guard.observe(epoch)
+            self._promote(
+                RuntimeError("leadership lease expired: %.1fs of observed "
+                             "stasis (TTL %.1fs)" % (stasis, ttl)),
+                fence_epoch=epoch)
+            return
+
     # -------------------------------------------------------------- promotion
-    def _promote(self, why: Exception) -> None:
+    def _promote(self, why: Exception, fence_epoch: int = 0) -> None:
         state = self._make_state()
         with state.cv:
             state.epoch = self._epoch
@@ -190,7 +308,12 @@ class StandbyCoordinator:
             state.jseq = self._jseq
         advertise = _advertise_host()
         bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
-        server = CoordinatorServer(state, self._secret, host=bind)
+        server = CoordinatorServer(state, self._secret, host=bind,
+                                   local_rank=self._rank)
+        # stamp every frame the promoted coordinator sends with the epoch
+        # it acquired the lease under — workers that saw it reject the old
+        # primary's traffic from that instant on
+        server.fence_epoch = fence_epoch
         # declare rank 0 lost BEFORE publishing the address: the first
         # worker to find us must already see the post-failover epoch, never
         # a window where the old membership looks intact
@@ -199,6 +322,11 @@ class StandbyCoordinator:
         with self._lock:
             self.server = server
             self.promoted = True
+        if self._lease is not None:
+            # the promoted coordinator is now the lease holder: renew it,
+            # and fence OURSELVES if it is ever lost (symmetry — a
+            # re-partitioned promotee obeys the same rule as the primary)
+            self._lease.start_renewing(state.fence)
         _publish_key(f"addr.{self._gen}.f1",
                      f"{advertise}:{server.port}", self._secret)
         instruments.coord_failovers().inc()

@@ -6,8 +6,16 @@ Used to decode tick payloads from the C++ controller and to exchange
 request/response lists over the cross-process control plane.
 
 This module also owns the control-plane TCP framing (send_frame/recv_frame):
-  frame = u32 payload_len | u8 msg_type | u32 seq | i32 rank | u32 crc32 |
+  frame = u32 payload_len | u8 msg_type | u32 seq | i32 rank |
+          [u32 fence_epoch when msg_type has the 0x80 bit set] | u32 crc32 |
           [32-byte HMAC-SHA256 when a job secret is set] | payload
+The fencing epoch is an *optional* field flagged by the high bit of
+msg_type: frames sent with ``fence=0`` (every job without lease-based
+leadership, see docs/fault-tolerance.md) never set the bit and are
+byte-identical to the pre-fencing format — golden-hex tests pin this.
+Receivers that pass a :class:`FenceGuard` reject frames stamped with a
+*lower* epoch than the highest they have seen (a deposed coordinator's
+traffic), and learn higher epochs by observation.
 The CRC32 covers head+payload and rejects corrupted frames cheaply and
 unconditionally (the HMAC authenticates, but only when a secret is set);
 payload_len is bounded by ``HOROVOD_FRAME_LIMIT_MB`` so a corrupted length
@@ -43,6 +51,10 @@ class FrameError(ConnectionError):
 
 _HEAD = struct.Struct("<BIi")
 
+# High bit of the u8 msg_type flags a trailing u32 fencing epoch after the
+# fixed head. The remaining 7 bits bound msg_type values at 127.
+FENCE_BIT = 0x80
+
 # Frame-type names for blackbox events (numbers match coordinator.MSG_*).
 # The bulk data plane (DATA/DATA_RESP) is excluded: it can run at tensor
 # rate and would wash everything else out of the ring.
@@ -52,7 +64,56 @@ _FRAME_NAMES = {1: "HELLO", 2: "LIST", 3: "RESP", 4: "BYE", 7: "METRICS",
                 15: "BATCH_RESP", 16: "BATCH_HB", 17: "REPL_HELLO",
                 18: "SNAPSHOT", 19: "JOURNAL", 20: "SERVE_HELLO",
                 21: "SERVE_SUBMIT", 22: "SERVE_RESULT", 26: "CKPT_MARK",
-                27: "CKPT_DONE"}
+                27: "CKPT_DONE", 28: "FENCED"}
+
+
+class FenceError(FrameError):
+    """A control-plane frame carried a fencing epoch lower than the highest
+    this process has observed: the sender is a deposed coordinator whose
+    traffic must be ignored. Connection-fatal like every FrameError."""
+
+
+class FenceGuard:
+    """Tracks the highest fencing epoch observed by this process and rejects
+    frames stamped with a lower one. Epoch 0 means "no lease-based
+    leadership seen yet" and is never rejected — pre-fencing peers stay
+    interoperable by construction."""
+
+    __slots__ = ("_epoch", "_lock", "_rank")
+
+    def __init__(self, epoch: int = 0, rank: int = -1):
+        self._epoch = epoch
+        self._lock = threading.Lock()
+        self._rank = rank
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def observe(self, epoch: int) -> None:
+        """Learn a (possibly) newer epoch — from the lease key, a failover
+        probe, or a frame stamped higher than anything seen so far."""
+        with self._lock:
+            if epoch > self._epoch:
+                self._epoch = epoch
+                instruments.fencing_epoch().set(float(epoch))
+
+    def admit(self, fence: int, msg_type: int, rank: int) -> None:
+        if fence == 0:
+            return
+        if fence < self._epoch:
+            instruments.frames_fenced().inc()
+            _blackbox.record(
+                _blackbox.K_FENCE, "rank_%d" % self._rank,
+                "fenced_frame type=%s from_epoch=%d local_epoch=%d "
+                "sender_rank=%d" % (_FRAME_NAMES.get(msg_type, msg_type),
+                                    fence, self._epoch, rank),
+                rank=self._rank)
+            raise FenceError(
+                "control-plane frame from fencing epoch %d rejected (this "
+                "process has observed epoch %d; the sender is a deposed "
+                "coordinator)" % (fence, self._epoch))
+        self.observe(fence)
 
 
 def _frame_limit() -> int:
@@ -61,8 +122,13 @@ def _frame_limit() -> int:
 
 
 def send_frame(sock: socket.socket, secret: str, msg_type: int, seq: int,
-               rank: int, payload: bytes = b"") -> None:
-    head = _HEAD.pack(msg_type, seq, rank)
+               rank: int, payload: bytes = b"", fence: int = 0) -> None:
+    if fence:
+        head = _HEAD.pack(msg_type | FENCE_BIT, seq, rank) + struct.pack(
+            "<I", fence)
+    else:
+        # no fencing epoch: byte-identical to the pre-fencing frame format
+        head = _HEAD.pack(msg_type, seq, rank)
     crc = struct.pack("<I", zlib.crc32(head + payload) & 0xFFFFFFFF)
     mac = (hmac.new(secret.encode(), head + payload, hashlib.sha256).digest()
            if secret else b"")
@@ -93,8 +159,8 @@ def recv_exact(sock: socket.socket, n: int, stop: threading.Event) -> bytes:
     return buf
 
 
-def recv_frame(sock: socket.socket, secret: str,
-               stop: threading.Event) -> Frame:
+def recv_frame(sock: socket.socket, secret: str, stop: threading.Event,
+               guard: Optional[FenceGuard] = None) -> Frame:
     n = struct.unpack("<I", recv_exact(sock, 4, stop))[0]
     limit = _frame_limit()
     if n > limit:
@@ -105,6 +171,12 @@ def recv_frame(sock: socket.socket, secret: str,
             "HOROVOD_FRAME_LIMIT_MB only if frames this large are expected)")
     head = recv_exact(sock, _HEAD.size, stop)
     msg_type, seq, rank = _HEAD.unpack(head)
+    fence = 0
+    if msg_type & FENCE_BIT:
+        fence_bytes = recv_exact(sock, 4, stop)
+        head += fence_bytes  # CRC/HMAC cover the fencing epoch too
+        fence = struct.unpack("<I", fence_bytes)[0]
+        msg_type &= ~FENCE_BIT
     crc = struct.unpack("<I", recv_exact(sock, 4, stop))[0]
     mac = recv_exact(sock, 32, stop) if secret else b""
     payload = recv_exact(sock, n, stop) if n else b""
@@ -124,6 +196,8 @@ def recv_frame(sock: socket.socket, secret: str,
     if bb is not None and msg_type in _FRAME_NAMES:
         bb.record(_blackbox.K_FRAME_RX, _FRAME_NAMES[msg_type],
                   "seq=%d len=%d" % (seq, len(payload)), rank)
+    if guard is not None:
+        guard.admit(fence, msg_type, rank)
     return Frame(msg_type, seq, rank, payload)
 
 

@@ -1418,8 +1418,12 @@ class TestFlatWireByteIdentity:
         sent_types = []
         real = wire.send_frame
 
-        def spy(sock, secret, msg_type, seq, rank, payload=b""):
+        def spy(sock, secret, msg_type, seq, rank, payload=b"", fence=0):
             sent_types.append(msg_type)
+            # knobs unset: the lease plane is off, so no frame may carry a
+            # fencing epoch — epoch 0 keeps the wire byte-identical
+            assert fence == 0, (
+                f"flat path stamped fence={fence} on frame type {msg_type}")
             return real(sock, secret, msg_type, seq, rank, payload)
 
         monkeypatch.setattr(wire, "send_frame", spy)
