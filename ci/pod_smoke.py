@@ -1308,6 +1308,53 @@ def check_serving_kill() -> None:
           "still serving the hvd_serving_* catalog")
 
 
+def check_serving_frontend_kill() -> None:
+    """Survivable-serving smoke (docs/inference.md failure matrix): run
+    the kill-frontend chaos drill — SIGKILL the active frontend under
+    Poisson load with a warm standby attached — and then point
+    ``bin/hvddoctor`` at the blackbox bundle: the doctor must NAME the
+    failover via the ``serving_failover`` signature (promotion recorded,
+    not misdiagnosed as a coordinator event), and must not raise
+    ``split_brain`` on the fenced handover."""
+    import shutil
+    import tempfile
+
+    bbdir = tempfile.mkdtemp(prefix="hvd_serving_fkill_smoke_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               HOROVOD_BLACKBOX_DIR=bbdir)
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "serving_bench.py"),
+             "--chaos", "kill-frontend", "--requests", "24",
+             "--qps", "12", "--max-new", "4"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        assert r.returncode == 0, (
+            f"kill-frontend drill failed (rc={r.returncode}):\n"
+            f"{r.stderr[-3000:]}")
+        assert "exactly_once\": true" in r.stderr.replace("'", '"'), (
+            f"drill output missing a clean jepsen verdict:\n"
+            f"{r.stderr[-2000:]}")
+
+        hvddoctor = os.path.join(REPO, "bin", "hvddoctor")
+        d = subprocess.run([sys.executable, hvddoctor, bbdir],
+                           capture_output=True, text=True, timeout=60)
+        assert d.returncode == 0, (
+            f"hvddoctor rejected the bundle:\n{d.stderr[-2000:]}")
+        assert "serving frontend failover" in d.stdout, (
+            "hvddoctor did not name the frontend failover "
+            f"(serving_failover signature):\n{d.stdout[:3000]}")
+        assert "split_brain" not in d.stdout, (
+            "hvddoctor misdiagnosed the fenced serving handover as a "
+            f"split brain:\n{d.stdout[-3000:]}")
+    finally:
+        shutil.rmtree(bbdir, ignore_errors=True)
+    print("ok: serving frontend-kill smoke — SIGKILLed the frontend "
+          "under load, standby promoted behind the lease, jepsen verdict "
+          "clean, and hvddoctor named the serving_failover")
+
+
 def _ckpt_smoke_fn():
     """2-rank elastic job with async sharded checkpointing on; the
     HVD_CKPT_VICTIM process hard-kills itself at step 5 and its same-rank
@@ -1838,6 +1885,7 @@ def main():
     check_algo_hierarchical()
     check_moe_quantized()
     check_serving_kill()
+    check_serving_frontend_kill()
     check_ckpt_kill_restore()
     check_goodput_chaos()
     print(f"pod-day smoke: {len(cmds)} command lines + /metrics endpoint "
@@ -1847,6 +1895,7 @@ def main():
           "+ tier aggregator re-home + straggler adaptive + adaptive wire "
           "+ quantized GSPMD wire + hierarchical collective "
           "+ quantized MoE dispatch + serving worker-kill "
+          "+ serving frontend-kill failover "
           "+ checkpoint kill-and-restore + goodput chaos valid")
 
 

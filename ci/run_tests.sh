@@ -154,6 +154,22 @@ python -m pytest tests/test_serving.py -q -m "not integration"
 # lost request, exit 3 on a p99 regression when a history is supplied
 python benchmarks/serving_bench.py --requests 12 --qps 32 --max-new 4
 
+stage "serving-chaos: frontend failover, deadlines, shedding, hedging, drain"
+python -m pytest tests/test_serving_failover.py -q -m "not integration"
+# the four survivability drills (docs/inference.md failure matrix); each
+# exits 4 on any lost or duplicated request delivery (jepsen-checked).
+# kill-frontend runs under pod_smoke below so hvddoctor can gate on the
+# serving_failover signature over the same blackbox bundle
+python benchmarks/serving_bench.py --chaos slow-replica \
+    --requests 16 --qps 8 --max-new 4
+python benchmarks/serving_bench.py --chaos overload --requests 48 \
+    --max-new 4 --history /tmp/hvd_ci_serve_overload.jsonl \
+    --check-regression
+python benchmarks/serving_bench.py --chaos rolling-restart \
+    --requests 24 --qps 16 --max-new 4
+# frontend SIGKILL + doctor: hvddoctor must name the serving_failover
+python ci/pod_smoke.py check_serving_frontend_kill
+
 stage "integration suite: real multi-process jobs (launcher, SPMD mesh)"
 # includes tests/test_spark_real.py (real-pyspark scenarios; they skip
 # when pyspark is absent from the image)

@@ -304,12 +304,88 @@ def detect_coordinator_failover(bundle) -> List[dict]:
         detail = ev.get("detail") or ""
         if "promoted" not in detail and "standby" not in detail:
             continue
+        if "serving" in detail:
+            continue  # the serving plane's failover has its own signature
         sigs.append(make_signature(
             "coordinator_failover", SEV_WARNING,
             "coordinator failover: %s" % (detail or "standby promoted"),
             rank=int(ev.get("rank") or 0), reported_by=src))
         break  # one promotion event is the story; redials are echoes
     return sigs
+
+
+def detect_serving_failover(bundle) -> List[dict]:
+    """The serving frontend died and its warm standby promoted itself
+    (serving/standby.py, docs/inference.md failure matrix): one
+    K_FAILOVER event with a ``serving standby promoted`` detail. The
+    request ledger survives by replication, so this is a WARNING — loss
+    or duplication would surface as jepsen violations, not here."""
+    sigs = []
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_FAILOVER:
+            continue
+        detail = ev.get("detail") or ""
+        if "serving" not in detail or "promoted" not in detail:
+            continue
+        sigs.append(make_signature(
+            "serving_failover", SEV_WARNING,
+            "serving frontend failover: %s" % detail,
+            rank=int(ev.get("rank") or 0), reported_by=src))
+        break  # one promotion is the story
+    return sigs
+
+
+_SHED_RE = re.compile(r"class=(\S+)")
+_RESOURCE_RE = re.compile(r"resource=(\S+)")
+
+
+def detect_serving_overload(bundle) -> List[dict]:
+    """The serving plane shed load or saturated (docs/inference.md):
+    the frontend records K_ANOMALY ``serving_shed`` events naming the
+    shedding class (``brownout`` = best-effort generations clamped,
+    ``best_effort`` = hard sheds) and workers record
+    ``serving_saturation`` naming the scarce resource (``queue`` vs
+    ``kv_blocks`` vs ``decode_slots``). One signature summarizing both:
+    what was shed, and which resource actually ran out."""
+    classes: List[str] = []
+    resources: List[str] = []
+    first_detail = ""
+    reported_by = None
+    for src, ev in _iter_events(bundle):
+        if ev.get("kind") != rec.K_ANOMALY:
+            continue
+        name = ev.get("name") or ""
+        if name not in ("serving_shed", "serving_saturation",
+                        "serving_shed_rate"):
+            continue
+        detail = ev.get("detail") or ""
+        if not first_detail:
+            first_detail = detail
+            reported_by = src
+        m = _SHED_RE.search(detail)
+        if m and m.group(1) not in classes:
+            classes.append(m.group(1))
+        m = _RESOURCE_RE.search(detail)
+        if m and m.group(1) not in resources:
+            resources.append(m.group(1))
+    if not first_detail:
+        return []
+    # hard sheds outrank brownout in the headline; saturation evidence
+    # from workers names the scarce resource even when the frontend only
+    # browned out
+    klass = ("best_effort" if "best_effort" in classes
+             else (classes[0] if classes else "none"))
+    # a worker naming the scarce resource (kv_blocks / decode_slots)
+    # beats the frontend's generic queue evidence
+    specific = [r for r in resources if r != "queue"]
+    resource = specific[0] if specific else (
+        resources[0] if resources else "queue")
+    return [make_signature(
+        "serving_overload", SEV_WARNING,
+        "serving overload: shedding class=%s, saturated resource=%s "
+        "(first: %s)" % (klass, resource, first_detail),
+        shed_classes=classes, resources=resources,
+        reported_by=reported_by)]
 
 
 def detect_split_brain(bundle) -> List[dict]:
@@ -462,6 +538,9 @@ def detect_latency_regression(bundle) -> List[dict]:
         name = ev.get("name") or ""
         if not name.startswith("serving_") or name in seen:
             continue
+        if name in ("serving_shed", "serving_saturation",
+                    "serving_shed_rate"):
+            continue  # overload evidence — detect_serving_overload's story
         seen.add(name)
         sigs.append(make_signature(
             "latency_regression", SEV_WARNING,
@@ -587,6 +666,8 @@ DETECTORS = (
     detect_nan_first,
     detect_dead_worker,
     detect_coordinator_failover,
+    detect_serving_failover,
+    detect_serving_overload,
     detect_split_brain,
     detect_straggler,
     detect_chronic_straggler,

@@ -41,6 +41,11 @@ SIGNALS = (
     # training-only jobs keep clean baselines.
     ("serving_p99_seconds", 1e-3),
     ("serving_queue_depth", 1.0),
+    # overload-shed rate (serving/server.py brownout/shed path): sheds per
+    # second out of hvd_serving_shed_total deltas. Maps to the doctor's
+    # serving_overload signature, not latency_regression — shedding is the
+    # mitigation working, and the response is capacity, not profiling.
+    ("serving_shed_rate", 0.5),
     # MoE capacity dispatch (parallel/expert.py gauges): sustained expert-
     # load imbalance is the router going degenerate — same live-signal
     # treatment as straggler skew. Only sampled when the MoE family
@@ -146,6 +151,11 @@ class AnomalyWatch:
         if "hvd_serving_queue_depth" in snapshot:
             out["serving_queue_depth"] = _series_total(
                 snapshot, "hvd_serving_queue_depth")
+        if "hvd_serving_shed_total" in snapshot:
+            dshed = self._delta("shed", _series_total(
+                snapshot, "hvd_serving_shed_total"))
+            if dshed is not None:
+                out["serving_shed_rate"] = dshed / max(self.interval, 1e-6)
         if "hvd_moe_load_imbalance" in snapshot:
             out["moe_load_imbalance"] = _series_total(
                 snapshot, "hvd_moe_load_imbalance")
@@ -195,10 +205,15 @@ class AnomalyWatch:
             base = baseline.baseline()
             anomalous = baseline.observe(value)
             if anomalous and not self._active[name]:
-                # serving signals map to the doctor's latency_regression
-                # vocabulary; everything else keeps the generic id
-                sig_id = ("latency_regression" if name.startswith("serving_")
-                          else "anomaly:%s" % name)
+                # serving signals map to the doctor's vocabulary: the shed
+                # rate is overload (capacity story), the rest is latency
+                # regression; everything else keeps the generic id
+                if name == "serving_shed_rate":
+                    sig_id = "serving_overload"
+                elif name.startswith("serving_"):
+                    sig_id = "latency_regression"
+                else:
+                    sig_id = "anomaly:%s" % name
                 evidence = {"signal": name, "value": value,
                             "baseline": base}
                 if name == "straggler_skew_seconds":

@@ -151,3 +151,38 @@ def check_history(bundle: Dict[int, dict],
         "intervals": intervals,
         "fenced_frames": fenced_frame_count(bundle),
     }
+
+
+def check_serving_history(bundle: Dict[int, dict],
+                          submitted: Iterable,
+                          delivered: Iterable) -> dict:
+    """Serving-plane verdict for the chaos drills: the leadership checks
+    of :func:`check_history` (the serving lease writes the same K_FENCE
+    record shapes) plus the request-delivery ledger —
+
+    * **no loss**: every submitted request id appears in ``delivered``;
+    * **no duplicates**: no id was delivered (terminally answered at a
+      client) more than once — the exactly-once promise the frontend's
+      dedupe LRU and the standby's replicated ledger exist to keep.
+
+    ``delivered`` is the concatenated, ordered list of terminal answers
+    across every client in the drill (one entry per answered future)."""
+    verdict = check_history(bundle)
+    submitted = list(submitted)
+    delivered = list(delivered)
+    counts: Dict[object, int] = {}
+    for rid in delivered:
+        counts[rid] = counts.get(rid, 0) + 1
+    lost = [rid for rid in submitted if rid not in counts]
+    dup = [rid for rid, n in counts.items() if n > 1]
+    for rid in lost:
+        verdict["violations"].append(
+            "lost request: %r submitted but never delivered" % (rid,))
+    for rid in dup:
+        verdict["violations"].append(
+            "duplicate delivery: %r answered %d times"
+            % (rid, counts[rid]))
+    verdict["exactly_once"] = verdict["exactly_once"] and not dup
+    verdict["lost"] = len(lost)
+    verdict["duplicates"] = len(dup)
+    return verdict

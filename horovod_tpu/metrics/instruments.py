@@ -441,6 +441,42 @@ def serving_kv_tokens():
         agg="max")
 
 
+def serving_shed():
+    return get_registry().counter(
+        "hvd_serving_shed_total",
+        "Requests degraded by overload admission control: class=best_effort "
+        "counts hard sheds (SERVE_SHED answered without dispatch), "
+        "class=brownout counts best-effort requests whose max_new was "
+        "clamped. High-priority traffic is never shed.",
+        labels=("class",))
+
+
+def serving_hedges():
+    return get_registry().counter(
+        "hvd_serving_hedges_total",
+        "Tail-latency hedges: outcome=launched (second replica engaged "
+        "after the p95-derived delay), outcome=won (hedge answered first; "
+        "original cancelled), outcome=lost (original answered first; hedge "
+        "cancelled).", labels=("outcome",))
+
+
+def serving_cancels():
+    return get_registry().counter(
+        "hvd_serving_cancels_total",
+        "Request cancellations by reason: client (explicit / disconnect), "
+        "deadline (wire budget expired), ttl (orphan sweep), propagated "
+        "(frontend-to-worker MSG_SERVE_CANCEL applied), hedge (losing "
+        "duplicate).", labels=("reason",))
+
+
+def serving_frontend_failovers():
+    return get_registry().counter(
+        "hvd_serving_frontend_failovers_total",
+        "Serving-frontend standby promotions (lease takeover or replication "
+        "stream loss). Paired with a K_FAILOVER blackbox event naming the "
+        "promoted address.")
+
+
 def checkpoint_stall_seconds():
     return get_registry().counter(
         "hvd_checkpoint_stall_seconds",

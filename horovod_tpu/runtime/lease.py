@@ -77,9 +77,11 @@ def _parse_value(raw: Optional[bytes]) -> Optional[Tuple[int, int, int]]:
         return None
 
 
-def read_lease_epoch(gen: int) -> int:
+def read_lease_epoch(gen: int, key: Optional[str] = None) -> int:
     """Best-effort read of the current fencing epoch — used by workers on
-    failover probes to seed their FenceGuard. 0 when no lease exists."""
+    failover probes to seed their FenceGuard. 0 when no lease exists.
+    ``key`` overrides the coordinator default ``lease.{gen}`` (the serving
+    plane holds its own lease under ``serve.lease.{gen}``)."""
     kv_addr = os.environ.get("HVD_KV_ADDR")
     if not kv_addr:
         return 0
@@ -88,7 +90,8 @@ def read_lease_epoch(gen: int) -> int:
 
         client = KVStoreClient(kv_addr, os.environ.get("HVD_SECRET", ""),
                                timeout=2.0)
-        parsed = _parse_value(client.get(LEASE_SCOPE, f"lease.{gen}"))
+        parsed = _parse_value(
+            client.get(LEASE_SCOPE, key or f"lease.{gen}"))
         return parsed[0] if parsed else 0
     except (ConnectionError, OSError):
         return 0
@@ -103,10 +106,13 @@ class LeaseManager:
     observed a full TTL of stasis.
     """
 
-    def __init__(self, gen: int, rank: int):
+    def __init__(self, gen: int, rank: int, key: Optional[str] = None):
         from ..run.rendezvous import KVStoreClient
 
-        self._key = f"lease.{gen}"
+        # The default key fences training-coordinator leadership; other
+        # planes (the serving frontend) pass their own key so the two
+        # leaderships are independent leases with independent epochs.
+        self._key = key or f"lease.{gen}"
         self._rank = rank
         self._client = KVStoreClient(
             os.environ["HVD_KV_ADDR"], os.environ.get("HVD_SECRET", ""),

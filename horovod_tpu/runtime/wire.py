@@ -63,7 +63,8 @@ _FRAME_NAMES = {1: "HELLO", 2: "LIST", 3: "RESP", 4: "BYE", 7: "METRICS",
                 12: "CLOCK_RESP", 13: "BLACKBOX", 14: "BATCH",
                 15: "BATCH_RESP", 16: "BATCH_HB", 17: "REPL_HELLO",
                 18: "SNAPSHOT", 19: "JOURNAL", 20: "SERVE_HELLO",
-                21: "SERVE_SUBMIT", 22: "SERVE_RESULT", 26: "CKPT_MARK",
+                21: "SERVE_SUBMIT", 22: "SERVE_RESULT", 23: "SERVE_CANCEL",
+                24: "SERVE_DRAIN", 26: "CKPT_MARK",
                 27: "CKPT_DONE", 28: "FENCED"}
 
 
@@ -1165,6 +1166,13 @@ def decode_tier_heartbeat(buf: bytes):
 MSG_SERVE_HELLO = 20
 MSG_SERVE_SUBMIT = 21
 MSG_SERVE_RESULT = 22
+# Cancellation/drain (docs/inference.md failure matrix). CANCEL flows both
+# directions: client -> frontend (deadline expiry, abandoned request) and
+# frontend -> worker (propagating the cancel, deadline sweep, hedging
+# loser). DRAIN flows frontend -> worker only and quiesces the replica:
+# finish in-flight, hand queued work back as SERVE_REJECTED, refuse new.
+MSG_SERVE_CANCEL = 23
+MSG_SERVE_DRAIN = 24
 
 # MSG_SERVE_HELLO roles
 SERVE_ROLE_CLIENT = 0
@@ -1174,6 +1182,14 @@ SERVE_ROLE_WORKER = 1
 SERVE_OK = 0          # tokens carry the completed generation
 SERVE_FAILED = 1      # non-retryable (bad request / engine error)
 SERVE_REJECTED = 2    # admission backpressure — retry with backoff
+SERVE_CANCELLED = 3   # terminal: cancelled (deadline / client abandon)
+SERVE_SHED = 4        # terminal: shed by overload admission control
+
+# MSG_SERVE_SUBMIT priority classes (the trailing optional block below).
+SERVE_PRIO_HIGH = 0         # interactive traffic — never shed
+SERVE_PRIO_BEST_EFFORT = 1  # browned out, then shed, under overload
+SERVE_CLASS_NAMES = {SERVE_PRIO_HIGH: "high",
+                     SERVE_PRIO_BEST_EFFORT: "best_effort"}
 
 
 def encode_serve_hello(role: int, name: str, capacity: int) -> bytes:
@@ -1193,7 +1209,14 @@ def decode_serve_hello(buf: bytes):
 
 
 def encode_serve_submit(request_id: str, prompt: List[int],
-                        max_new_tokens: int, eos_id: Optional[int]) -> bytes:
+                        max_new_tokens: int, eos_id: Optional[int],
+                        deadline: float = 0.0, priority: int = 0) -> bytes:
+    """``deadline`` is a *relative* budget in seconds (0.0 = none; each hop
+    re-anchors it on its own clock, so no cross-host clock comparison),
+    ``priority`` a SERVE_PRIO_* class. Both ride an optional trailing block
+    written only when non-default, so knobs-unset frames stay byte-identical
+    to the pre-robustness format (same discipline as the coordinator
+    journal's trailing subtree field)."""
     w = Writer()
     w.str(request_id)
     w.u32(len(prompt))
@@ -1201,17 +1224,32 @@ def encode_serve_submit(request_id: str, prompt: List[int],
         w.i32(int(t))
     w.u32(max_new_tokens)
     w.i32(-1 if eos_id is None else int(eos_id))
+    if deadline != 0.0 or priority != 0:
+        w.f64(deadline)
+        w.u8(priority)
     return w.getvalue()
 
 
 def decode_serve_submit(buf: bytes):
     """Returns (request_id, prompt, max_new_tokens, eos_id|None)."""
+    return decode_serve_submit_ex(buf)[:4]
+
+
+def decode_serve_submit_ex(buf: bytes):
+    """Returns (request_id, prompt, max_new_tokens, eos_id|None, deadline,
+    priority) — deadline 0.0 / priority SERVE_PRIO_HIGH when the sender
+    wrote the legacy 4-field frame."""
     rd = Reader(buf)
     request_id = rd.str()
     prompt = [rd.i32() for _ in range(rd.u32())]
     max_new = rd.u32()
     eos = rd.i32()
-    return request_id, prompt, max_new, (None if eos < 0 else eos)
+    deadline, priority = 0.0, SERVE_PRIO_HIGH
+    if rd.remaining():
+        deadline = rd.f64()
+        priority = rd.u8()
+    return (request_id, prompt, max_new, (None if eos < 0 else eos),
+            deadline, priority)
 
 
 def encode_serve_result(request_id: str, status: int, tokens: List[int],
@@ -1236,6 +1274,82 @@ def decode_serve_result(buf: bytes):
     error = rd.str()
     latency = rd.f64()
     return request_id, status, tokens, error, latency
+
+
+def encode_serve_cancel(request_id: str, reason: str = "") -> bytes:
+    w = Writer()
+    w.str(request_id)
+    w.str(reason)
+    return w.getvalue()
+
+
+def decode_serve_cancel(buf: bytes):
+    """Returns (request_id, reason)."""
+    rd = Reader(buf)
+    return rd.str(), rd.str()
+
+
+def encode_serve_drain(reason: str = "") -> bytes:
+    w = Writer()
+    w.str(reason)
+    return w.getvalue()
+
+
+def decode_serve_drain(buf: bytes) -> str:
+    return Reader(buf).str()
+
+
+# Frontend warm-standby replication (docs/inference.md). The standby dials
+# the active frontend with MSG_REPL_HELLO payload b"serve" and receives the
+# frontend's durable request state over the SAME MSG_SNAPSHOT/MSG_JOURNAL
+# framing the coordinator standby and the checkpoint buddy plane use: one
+# snapshot (the result dedupe LRU + every open request's submit payload),
+# then one journal record per state change. That state is exactly what
+# exactly-once delivery needs to survive a frontend SIGKILL — open requests
+# are re-dispatched by the promoted standby, completed ones answered from
+# the replicated LRU instead of re-running.
+
+SERVE_J_SUBMIT = 0   # blob = the accepted MSG_SERVE_SUBMIT payload
+SERVE_J_RESULT = 1   # blob = the terminal MSG_SERVE_RESULT payload
+SERVE_J_CANCEL = 2   # blob = the MSG_SERVE_CANCEL payload
+
+
+def encode_serve_snapshot(epoch: int, results: List[bytes],
+                          pending: List[bytes]) -> bytes:
+    """``results``: encoded MSG_SERVE_RESULT payloads (the dedupe LRU, in
+    insertion order); ``pending``: encoded MSG_SERVE_SUBMIT payloads for
+    every request not yet terminally answered."""
+    w = Writer()
+    w.u32(epoch)
+    w.u32(len(results))
+    for blob in results:
+        _put_bytes(w, blob)
+    w.u32(len(pending))
+    for blob in pending:
+        _put_bytes(w, blob)
+    return w.getvalue()
+
+
+def decode_serve_snapshot(buf: bytes):
+    """Returns (epoch, results, pending)."""
+    rd = Reader(buf)
+    epoch = rd.u32()
+    results = [_get_bytes(rd) for _ in range(rd.u32())]
+    pending = [_get_bytes(rd) for _ in range(rd.u32())]
+    return epoch, results, pending
+
+
+def encode_serve_journal(kind: int, blob: bytes) -> bytes:
+    w = Writer()
+    w.u8(kind)
+    _put_bytes(w, blob)
+    return w.getvalue()
+
+
+def decode_serve_journal(buf: bytes):
+    """Returns (kind, blob) — kind is a SERVE_J_* tag."""
+    rd = Reader(buf)
+    return rd.u8(), _get_bytes(rd)
 
 
 # --------------------------------------------------------------------------

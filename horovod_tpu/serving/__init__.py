@@ -23,16 +23,17 @@ from .client import ClientRequest, ServingClient
 from .engine import ServingConfig, ServingEngine
 from .kvcache import BlockAllocator, KVCacheFull, PagedKVCache, \
     blocks_for_tokens
-from .scheduler import (ACTIVE, DONE, FAILED, QUEUED,
+from .scheduler import (ACTIVE, CANCELLED, DONE, FAILED, QUEUED,
                         ContinuousBatchingScheduler, QueueFull, Request)
 from .server import ServingFrontend
+from .standby import ServingStandby
 from .worker import ServingWorker, build_replica_engine
 
 __all__ = [
     "ServingConfig", "ServingEngine",
     "PagedKVCache", "BlockAllocator", "KVCacheFull", "blocks_for_tokens",
     "ContinuousBatchingScheduler", "Request", "QueueFull",
-    "QUEUED", "ACTIVE", "DONE", "FAILED",
-    "ServingFrontend", "ServingWorker", "build_replica_engine",
-    "ServingClient", "ClientRequest",
+    "QUEUED", "ACTIVE", "DONE", "FAILED", "CANCELLED",
+    "ServingFrontend", "ServingStandby", "ServingWorker",
+    "build_replica_engine", "ServingClient", "ClientRequest",
 ]

@@ -6,11 +6,24 @@ connection to the frontend (``serving/server.py``) is re-established it
 blindly resubmits all of them. Correctness comes from the frontend, not
 the client — request ids are client-chosen and the frontend dedupes on
 them (in-flight resubmits re-own the request, finished ones answer from
-the result cache), so the naive replay is exactly-once end to end.
+the result cache), so the naive replay is exactly-once end to end. The
+same replay carries requests across a frontend *failover*: when redials
+keep failing the client probes the rendezvous KV for
+``serve.addr.{gen}.f{n}`` (a promoted standby) and replays there —
+the standby's replicated result LRU dedupes requests the old frontend
+already answered.
 
 Admission backpressure (``SERVE_REJECTED``) is retried here with capped
 exponential backoff per request, invisible to the caller unless
-``max_retries`` runs out.
+``max_retries`` runs out. ``SERVE_SHED`` (overload, best-effort class) and
+``SERVE_CANCELLED`` are terminal by design — retrying into an overload
+makes it worse, and a cancel is an answer.
+
+Cancellation propagates from here too: ``result(timeout)`` expiry sends a
+``MSG_SERVE_CANCEL`` upstream before raising (the frontend tombstones the
+request and the worker frees its KV blocks), and :meth:`close` cancels
+everything still unresolved — an abandoned client never strands resources
+on the serving pod.
 """
 
 from __future__ import annotations
@@ -21,20 +34,28 @@ import os
 import socket
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional
 
 from ..runtime import wire
+from ..runtime.coordinator import _backoff_schedule, _resolve_key
 
 logger = logging.getLogger("horovod_tpu")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else default
 
 
 class ClientRequest:
     """Future for one submitted request."""
 
     __slots__ = ("id", "tokens", "error", "latency", "rejections",
-                 "submitted_t", "done_t", "_event", "_failed")
+                 "submitted_t", "done_t", "status", "_event", "_failed",
+                 "_cancel")
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str, cancel=None):
         self.id = request_id
         self.tokens: List[int] = []
         self.error = ""
@@ -42,8 +63,10 @@ class ClientRequest:
         self.rejections = 0       # backpressure retries absorbed
         self.submitted_t = time.monotonic()
         self.done_t: Optional[float] = None
+        self.status = -1          # wire.SERVE_* once done
         self._event = threading.Event()
         self._failed = False
+        self._cancel = cancel     # owning client's cancel hook
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -53,6 +76,11 @@ class ClientRequest:
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self._event.wait(timeout):
+            # the caller stopped waiting: propagate the cancel upstream so
+            # the pod stops spending decode slots and KV blocks on an
+            # answer nobody will read
+            if self._cancel is not None:
+                self._cancel(self.id, "client timeout")
             raise TimeoutError(f"request {self.id} not done")
         if self._failed:
             raise RuntimeError(f"request {self.id} failed: {self.error}")
@@ -73,10 +101,11 @@ class ServingClient:
 
     def __init__(self, host: str, port: int, name: str = "client",
                  secret: Optional[str] = None, max_retries: int = 64,
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0, gen: int = 0):
         self.host = host
         self.port = int(port)
         self.name = name
+        self.gen = int(gen)
         self.secret = (secret if secret is not None
                        else os.environ.get("HVD_SECRET", ""))
         self.max_retries = int(max_retries)
@@ -84,6 +113,11 @@ class ServingClient:
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._seq = 0
+        # deterministic jitter identity: clients have no rank, so hash the
+        # name — distinct clients spread, the same client reproduces
+        self._jitter_id = zlib.crc32(name.encode()) & 0x7FFFFFFF
+        self._guard = wire.FenceGuard(rank=-1)
+        self._fo = 0
         # rid -> (future, encoded SUBMIT payload) for every unresolved
         # request — the replay set for reconnects
         self._pending: Dict[str, tuple] = {}
@@ -94,9 +128,32 @@ class ServingClient:
         self._reader.start()
 
     # ------------------------------------------------------------- wire
+    def _probe_failover(self) -> None:
+        """Look for a promoted standby frontend under the serving failover
+        key; re-aim and learn the new fencing epoch when found."""
+        try:
+            addr, secret = _resolve_key(
+                f"serve.addr.{self.gen}.f{self._fo + 1}", timeout=0.3)
+        except Exception:
+            return
+        self._fo += 1
+        from ..runtime import lease as _lease
+
+        if _lease.lease_enabled():
+            self._guard.observe(_lease.read_lease_epoch(
+                self.gen, key=f"serve.lease.{self.gen}"))
+        host, port = addr.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        if secret:
+            self.secret = secret
+        logger.warning("client %s: following serving frontend failover "
+                       "#%d to %s", self.name, self._fo, addr)
+
     def _connect(self, deadline: Optional[float] = None) -> None:
-        delay = 0.1
+        attempt = 0
+        jitter = _env_float("HOROVOD_RECONNECT_JITTER", 0.0)
         while not self._stop.is_set():
+            attempt += 1
             try:
                 sock = socket.create_connection((self.host, self.port),
                                                 timeout=5.0)
@@ -104,7 +161,8 @@ class ServingClient:
                 wire.send_frame(sock, self.secret, wire.MSG_SERVE_HELLO,
                                 0, -1,
                                 wire.encode_serve_hello(
-                                    wire.SERVE_ROLE_CLIENT, self.name, 0))
+                                    wire.SERVE_ROLE_CLIENT, self.name, 0),
+                                fence=self._guard.epoch)
                 with self._lock:
                     self._sock = sock
                     replay = [p for _, p in self._pending.values()]
@@ -112,13 +170,16 @@ class ServingClient:
                     self._send(wire.MSG_SERVE_SUBMIT, payload)
                 return
             except OSError as exc:
+                if attempt >= 2:
+                    self._probe_failover()
                 if deadline is not None and time.monotonic() > deadline:
                     raise ConnectionError(
                         f"serving frontend {self.host}:{self.port} "
                         f"unreachable: {exc}")
+                delay = _backoff_schedule(self._jitter_id, attempt, 0.1,
+                                          2.0, jitter)
                 if self._stop.wait(delay):
                     raise ConnectionError("client closed while connecting")
-                delay = min(delay * 2, 2.0)
         raise ConnectionError("client closed while connecting")
 
     def _send(self, msg_type: int, payload: bytes) -> bool:
@@ -129,7 +190,7 @@ class ServingClient:
             try:
                 self._seq += 1
                 wire.send_frame(sock, self.secret, msg_type, self._seq, -1,
-                                payload)
+                                payload, fence=self._guard.epoch)
                 return True
             except OSError:
                 return False
@@ -144,10 +205,13 @@ class ServingClient:
                     return
                 continue
             try:
-                frame = wire.recv_frame(sock, self.secret, self._stop)
+                frame = wire.recv_frame(sock, self.secret, self._stop,
+                                        guard=self._guard)
             except wire.ShutdownError:
                 return
             except (ConnectionError, OSError):
+                # FenceError lands here too: a deposed frontend's frames
+                # cut the connection, and the reconnect finds the new one
                 if self._stop.is_set():
                     return
                 logger.info("client %s: frontend connection lost; "
@@ -180,11 +244,15 @@ class ServingClient:
                 return
             error = error or "rejected; retry budget exhausted"
             status = wire.SERVE_FAILED
+        # SERVE_SHED and SERVE_CANCELLED fall through as terminal: a shed
+        # retried into the same overload only deepens it (the caller owns
+        # any re-try policy), and a cancel IS the answer
         with self._lock:
             self._pending.pop(rid, None)
         fut.tokens = tokens
         fut.error = error
         fut.latency = latency
+        fut.status = status
         fut._failed = status != wire.SERVE_OK
         fut.done_t = time.monotonic()
         fut._event.set()
@@ -192,17 +260,43 @@ class ServingClient:
     # ------------------------------------------------------------ public
     def submit(self, prompt: List[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
-               request_id: Optional[str] = None) -> ClientRequest:
+               request_id: Optional[str] = None,
+               deadline: Optional[float] = None,
+               priority: int = wire.SERVE_PRIO_HIGH) -> ClientRequest:
+        """Submit one generation request. ``deadline`` is an end-to-end
+        budget in seconds carried on the wire — each hop re-anchors it on
+        its own clock and evicts the request once it expires; ``priority``
+        selects the overload class (``wire.SERVE_PRIO_BEST_EFFORT`` is
+        shed/browned-out first)."""
         rid = (request_id if request_id is not None
                else f"{self.name}-{next(ServingClient._ids)}")
         payload = wire.encode_serve_submit(rid, prompt, max_new_tokens,
-                                           eos_id)
-        fut = ClientRequest(rid)
+                                           eos_id, deadline or 0.0,
+                                           priority)
+        fut = ClientRequest(rid, cancel=self.cancel)
         with self._lock:
             self._pending[rid] = (fut, payload)
         # a failed send is fine: the reconnect replay will carry it
         self._send(wire.MSG_SERVE_SUBMIT, payload)
         return fut
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Cancel one unresolved request: drop it locally (the future
+        fails with the reason) and tell the frontend so the pod reclaims
+        its resources. False when the id is unknown/already done."""
+        with self._lock:
+            entry = self._pending.pop(request_id, None)
+        if entry is None:
+            return False
+        fut, _ = entry
+        self._send(wire.MSG_SERVE_CANCEL,
+                   wire.encode_serve_cancel(request_id, reason))
+        fut.error = reason
+        fut.status = wire.SERVE_CANCELLED
+        fut._failed = True
+        fut.done_t = time.monotonic()
+        fut._event.set()
+        return True
 
     def generate(self, prompt: List[int], max_new_tokens: int,
                  eos_id: Optional[int] = None,
@@ -215,6 +309,12 @@ class ServingClient:
             return len(self._pending)
 
     def close(self) -> None:
+        # walking away with requests still open would strand decode work
+        # and KV blocks on the pod until the TTL sweep: cancel them first
+        with self._lock:
+            unresolved = list(self._pending)
+        for rid in unresolved:
+            self.cancel(rid, "client closed")
         self._stop.set()
         with self._lock:
             sock, self._sock = self._sock, None

@@ -34,8 +34,8 @@ import numpy as np
 
 from ..metrics import instruments
 from .kvcache import PagedKVCache
-from .scheduler import (ACTIVE, DONE, FAILED, ContinuousBatchingScheduler,
-                        QueueFull, Request)
+from .scheduler import (ACTIVE, CANCELLED, DONE, FAILED,
+                        ContinuousBatchingScheduler, QueueFull, Request)
 
 __all__ = ["ServingConfig", "ServingEngine", "QueueFull", "Request"]
 
@@ -125,6 +125,16 @@ class ServingEngine:
         self._thread = None
         self._tokens_out = 0
         self._started_t = time.monotonic()
+        # cancels from connection threads land here and are applied at the
+        # top of step() on the engine thread — never mid-forward, so a
+        # cancelled request can't be freed between the KV gather and the
+        # KV append of the same decode step
+        self._cancel_lock = threading.Lock()
+        self._cancels: List[tuple] = []
+        # fault-injection knob for the slow-replica chaos drill: a fixed
+        # stall before every step, making this replica the hedging target
+        self.step_delay = float(
+            os.environ.get("HOROVOD_SERVING_STEP_DELAY") or 0.0)
 
     # ---------------------------------------------------- compiled kernels
     def _empty_past(self, batch: int):
@@ -160,7 +170,7 @@ class ServingEngine:
     def submit(self, prompt: List[int], max_new_tokens: int,
                request_id: Optional[str] = None,
                eos_id: Optional[int] = None,
-               callback=None) -> Request:
+               callback=None, deadline: Optional[float] = None) -> Request:
         """Queue one generation request; raises :class:`QueueFull` when the
         admission queue is at capacity and ``ValueError`` when the request
         cannot fit ``max_context``. The returned :class:`Request` is a
@@ -172,12 +182,33 @@ class ServingEngine:
         req = Request(prompt, max_new_tokens,
                       eos_id=eos_id if eos_id is not None
                       else self.config.eos_id,
-                      request_id=request_id, callback=callback)
+                      request_id=request_id, callback=callback,
+                      deadline=deadline)
         self.scheduler.submit(req)
         instruments.serving_requests().labels(status="submitted").inc()
         self._observe_gauges()
         self._wake.set()
         return req
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> None:
+        """Request cancellation of ``request_id`` (thread-safe). Applied
+        between engine steps; a no-op when the id already finished."""
+        with self._cancel_lock:
+            self._cancels.append((request_id, reason))
+        self._wake.set()
+
+    def saturated_resource(self) -> str:
+        """Which resource is the admission bottleneck right now — the
+        evidence string the doctor's serving_overload signature names.
+        ``decode_slots``: the batch is full; ``kv_blocks``: the paged pool
+        cannot fit even one more block; ``queue``: admission is keeping up
+        but the bounded submit queue overflowed (burst arrival rate)."""
+        sched = self.scheduler
+        if sched.active_count() >= sched.max_batch:
+            return "decode_slots"
+        if not self.cache.allocator.can_allocate(1):
+            return "kv_blocks"
+        return "queue"
 
     # ---------------------------------------------------------- main loop
     def step(self) -> bool:
@@ -185,6 +216,9 @@ class ServingEngine:
         token for every in-flight request. Returns True if any work ran."""
         import jax.numpy as jnp
 
+        if self.step_delay > 0:
+            time.sleep(self.step_delay)
+        self._apply_cancels()
         prefills, decodes = self.scheduler.schedule()
         did = False
         for req in prefills:
@@ -205,6 +239,29 @@ class ServingEngine:
         if did:
             self._observe_gauges()
         return did
+
+    def _apply_cancels(self) -> None:
+        """Between-step cancellation point: apply queued cancels, then one
+        deadline/TTL sweep. Runs on the engine thread, so every KV free
+        here is ordered against the forward passes."""
+        with self._cancel_lock:
+            cancels, self._cancels = self._cancels, []
+        touched = bool(cancels)
+        for rid, reason in cancels:
+            if self.scheduler.cancel(rid, reason) is not None:
+                instruments.serving_requests().labels(
+                    status="cancelled").inc()
+                instruments.serving_cancels().labels(
+                    reason="propagated").inc()
+        expired, missed = self.scheduler.sweep()
+        for req in expired:
+            instruments.serving_requests().labels(status="expired").inc()
+            instruments.serving_cancels().labels(reason="ttl").inc()
+        for req in missed:
+            instruments.serving_requests().labels(status="cancelled").inc()
+            instruments.serving_cancels().labels(reason="deadline").inc()
+        if touched or expired or missed:
+            self._observe_gauges()
 
     def _prefill(self, req: Request) -> None:
         import jax.numpy as jnp
@@ -330,6 +387,8 @@ class ServingEngine:
             "completed": s.completed,
             "failed": s.failed,
             "rejected": s.rejected,
+            "cancelled": s.cancelled,
+            "expired": s.expired,
             "kv_blocks_used": self.cache.used_blocks,
             "kv_blocks_total": self.cache.num_blocks,
             "kv_occupancy": round(self.cache.occupancy(), 4),

@@ -25,9 +25,10 @@ fits, trading utilization for no-starvation).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from .kvcache import PagedKVCache, blocks_for_tokens
 
@@ -36,6 +37,7 @@ QUEUED = "queued"
 ACTIVE = "active"      # prefilled; in the decode batch
 DONE = "done"
 FAILED = "failed"
+CANCELLED = "cancelled"  # terminal: deadline / client abandon / TTL sweep
 
 
 class QueueFull(RuntimeError):
@@ -50,11 +52,13 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "state",
                  "output", "error", "submitted_t", "admitted_t",
-                 "first_token_t", "done_t", "callback", "_done_event")
+                 "first_token_t", "done_t", "callback", "deadline_t",
+                 "_done_event")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  eos_id: Optional[int] = None, request_id: Optional[str]
-                 = None, callback: Optional[Callable] = None):
+                 = None, callback: Optional[Callable] = None,
+                 deadline: Optional[float] = None):
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -72,6 +76,10 @@ class Request:
         self.first_token_t = None
         self.done_t = None
         self.callback = callback
+        # absolute monotonic cutoff; the deadline arrives as a RELATIVE
+        # budget on the wire and is re-anchored here on this host's clock
+        self.deadline_t = (self.submitted_t + float(deadline)
+                           if deadline else None)
         self._done_event = threading.Event()
 
     # ------------------------------------------------------------- result
@@ -114,7 +122,8 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, cache: PagedKVCache, max_batch: int = 8,
                  max_queue: int = 128, max_context: Optional[int] = None,
-                 prefill_per_step: int = 1, strict_fifo: bool = True):
+                 prefill_per_step: int = 1, strict_fifo: bool = True,
+                 request_ttl: Optional[float] = None):
         self.cache = cache
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
@@ -122,12 +131,21 @@ class ContinuousBatchingScheduler:
                             else cache.num_blocks * cache.block_size)
         self.prefill_per_step = max(1, int(prefill_per_step))
         self.strict_fifo = bool(strict_fifo)
+        # max lifetime for ANY request (HOROVOD_SERVING_REQUEST_TTL): the
+        # backstop against orphans whose client vanished without a cancel —
+        # without it an abandoned request holds its KV reservation forever
+        if request_ttl is None:
+            request_ttl = float(
+                os.environ.get("HOROVOD_SERVING_REQUEST_TTL") or 0.0)
+        self.request_ttl = request_ttl if request_ttl > 0 else None
         self.lock = threading.RLock()
         self.waiting: List[Request] = []
         self.active: List[Request] = []
         self.completed = 0
         self.rejected = 0
         self.failed = 0
+        self.cancelled = 0
+        self.expired = 0
 
     # ---------------------------------------------------------- admission
     def submit(self, request: Request) -> Request:
@@ -171,6 +189,15 @@ class ContinuousBatchingScheduler:
             while (len(prefills) < self.prefill_per_step
                    and i < len(self.waiting)):
                 req = self.waiting[i]
+                if (req.deadline_t is not None
+                        and time.monotonic() >= req.deadline_t):
+                    # past-deadline while still queued: evict instead of
+                    # admitting — prefilling it would burn a decode slot
+                    # and KV blocks on an answer nobody is waiting for
+                    self.waiting.pop(i)
+                    self.cancelled += 1
+                    req.finish(CANCELLED, "deadline exceeded in queue")
+                    continue
                 if self._admissible(req):
                     self.waiting.pop(i)
                     self.cache.allocate(req.id, req.total_tokens())
@@ -196,9 +223,69 @@ class ContinuousBatchingScheduler:
                 self.cache.free(request.id)
             if state == DONE:
                 self.completed += 1
+            elif state == CANCELLED:
+                self.cancelled += 1
             else:
                 self.failed += 1
         request.finish(state, error)
+
+    # ------------------------------------------------- cancellation / TTL
+    def cancel(self, request_id: str, reason: str = "cancelled"
+               ) -> Optional[Request]:
+        """Cancel one request by id wherever it sits (queued or active),
+        freeing its KV reservation. Returns the request, or None when the
+        id is unknown (already finished — cancels race results by design).
+
+        Callers on the engine thread may invoke this directly; other
+        threads should route through ``ServingEngine.cancel`` so the
+        eviction lands between engine steps, never mid-forward."""
+        with self.lock:
+            for req in self.waiting:
+                if req.id == request_id:
+                    self.waiting.remove(req)
+                    self.cancelled += 1
+                    req.finish(CANCELLED, reason)
+                    return req
+            for req in self.active:
+                if req.id == request_id:
+                    self.active.remove(req)
+                    if req.id in self.cache.requests():
+                        self.cache.free(req.id)
+                    self.cancelled += 1
+                    req.finish(CANCELLED, reason)
+                    return req
+        return None
+
+    def sweep(self) -> Tuple[List[Request], List[Request]]:
+        """One pass of the lifetime/deadline sweep: evict every request
+        past its wire deadline and every request older than
+        ``request_ttl``. Returns ``(expired, deadline_missed)`` — both
+        already finished CANCELLED with their KV blocks back in the pool."""
+        now = time.monotonic()
+        expired: List[Request] = []
+        missed: List[Request] = []
+        with self.lock:
+            for req in list(self.waiting) + list(self.active):
+                if (self.request_ttl is not None
+                        and now - req.submitted_t >= self.request_ttl):
+                    expired.append(req)
+                elif (req.deadline_t is not None and now >= req.deadline_t):
+                    missed.append(req)
+            for req in expired + missed:
+                if req in self.waiting:
+                    self.waiting.remove(req)
+                if req in self.active:
+                    self.active.remove(req)
+                if req.id in self.cache.requests():
+                    self.cache.free(req.id)
+        for req in expired:
+            self.expired += 1
+            req.finish(CANCELLED, "request ttl %.1fs exceeded"
+                       % self.request_ttl)
+        for req in missed:
+            self.cancelled += 1
+            req.finish(CANCELLED, "deadline exceeded")
+        return expired, missed
 
     # ------------------------------------------------------------- status
     def queue_depth(self) -> int:
@@ -212,6 +299,18 @@ class ContinuousBatchingScheduler:
     def has_work(self) -> bool:
         with self.lock:
             return bool(self.waiting or self.active)
+
+    def evict_queued(self) -> List[Request]:
+        """Remove every still-queued (not yet admitted) request WITHOUT
+        finishing it. The draining serving worker hands these back to the
+        frontend as retryable ``SERVE_REJECTED`` so they re-dispatch to
+        another replica — from the client's point of view they were never
+        here. Active requests are untouched: a drain finishes in-flight
+        work."""
+        with self.lock:
+            evicted = list(self.waiting)
+            self.waiting = []
+        return evicted
 
     def drain(self, error: str) -> List[Request]:
         """Fail everything queued or active (engine shutdown); returns the

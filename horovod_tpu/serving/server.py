@@ -27,10 +27,41 @@ gradients:
   frontend's ``/metrics`` endpoint via the PR-3 dead-rank ledger
   (``store_report``/``drop_report``), so pod-level serving dashboards
   survive replica churn.
+
+The frontend itself stopped being the single point of failure with the
+survivable-serving work (docs/inference.md failure matrix):
+
+* **Warm-standby failover** — a :class:`~.standby.ServingStandby` dials in
+  with ``MSG_REPL_HELLO`` payload ``b"serve"`` and mirrors the durable
+  request state (the result LRU + every open submit) over the same
+  MSG_SNAPSHOT/MSG_JOURNAL framing the coordinator standby uses. With
+  ``HOROVOD_SERVING_STANDBY`` + the rendezvous lease, the active frontend
+  holds ``serve.lease.{gen}`` and stamps its fencing epoch on every
+  outgoing ``MSG_SERVE_*`` frame; a deposed frontend's traffic is
+  fence-rejected by workers, clients and the promoted standby alike.
+* **Deadlines + cancellation** — submits may carry a deadline budget; the
+  liveness loop cancels expired requests end to end (client tombstone,
+  ``MSG_SERVE_CANCEL`` to the worker, KV blocks freed there). Clients
+  propagate their own timeouts/disconnects the same way.
+* **Overload brownout/shedding** (``HOROVOD_SERVING_SHED``) — best-effort
+  traffic gets its ``max_new`` clamped once the backlog crosses half the
+  shed threshold and is answered ``SERVE_SHED`` beyond it; high-priority
+  traffic only ever sees the hard ``max_backlog`` backpressure.
+* **Hedged decode** (``HOROVOD_SERVING_HEDGE``) — a request idle past a
+  p95-derived delay is resubmitted to a second replica; first terminal
+  result wins, the loser is cancelled (the pending-pop is the dedupe).
+* **Per-replica circuit breaker** — heartbeat gaps or an error-rate burst
+  open a breaker that keeps dispatch away from a sick replica until it
+  cools down (unless every replica is sick — degraded beats down).
+* **Graceful drain** — :meth:`ServingFrontend.drain_worker` sends
+  ``MSG_SERVE_DRAIN``: the replica finishes in-flight work, hands queued
+  work back (readmitted elsewhere) and refuses new, so rolling restarts
+  are zero-loss by construction.
 """
 
 from __future__ import annotations
 
+import argparse
 import collections
 import logging
 import os
@@ -39,20 +70,34 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .. import blackbox as _blackbox
 from ..metrics import (drop_report, instruments, maybe_start_server,
                        readmit_report, store_report)
 from ..runtime import wire
-from ..runtime.coordinator import MSG_HEARTBEAT, MSG_METRICS
+from ..runtime.coordinator import (MSG_BYE, MSG_HEARTBEAT, MSG_JOURNAL,
+                                   MSG_METRICS, MSG_REPL_HELLO, MSG_SNAPSHOT,
+                                   _publish_key)
 
 logger = logging.getLogger("horovod_tpu")
 
 #: completed results kept for duplicate-submit answers
 RESULT_CACHE = 4096
 
+#: brownout begins at this fraction of the shed threshold
+BROWNOUT_FRACTION = 0.5
+
+#: latency samples the hedge delay derives its p95 from
+HEDGE_RING = 128
+
 
 def _env_float(name: str, default: float) -> float:
     raw = os.environ.get(name, "").strip()
     return float(raw) if raw else default
+
+
+def standby_enabled() -> bool:
+    raw = os.environ.get("HOROVOD_SERVING_STANDBY", "").strip()
+    return raw not in ("", "0", "false", "False", "off")
 
 
 class _Peer:
@@ -67,11 +112,11 @@ class _Peer:
         self.last_seen = time.monotonic()
 
     def send(self, secret: str, msg_type: int, seq: int,
-             payload: bytes) -> bool:
+             payload: bytes, fence: int = 0) -> bool:
         try:
             with self.send_lock:
                 wire.send_frame(self.sock, secret, msg_type, seq, -1,
-                                payload)
+                                payload, fence=fence)
             return True
         except OSError:
             self.alive = False
@@ -91,20 +136,53 @@ class _Worker(_Peer):
         self.capacity = max(1, capacity)
         self.inflight = 0  # guarded by the frontend lock
         self.metrics_rank: Optional[int] = None
+        self.draining = False
+        # circuit breaker: error-rate over a rolling outcome window plus
+        # heartbeat-gap trips from the liveness loop. Open = excluded from
+        # dispatch until ``breaker_until`` (half-open by expiry).
+        self.fails = 0
+        self.oks = 0
+        self.breaker_until = 0.0
+
+    def breaker_open(self, now: float) -> bool:
+        return now < self.breaker_until
+
+    def record_outcome(self, ok: bool, now: float, hold: float) -> bool:
+        """Feed one terminal result into the breaker; True if it tripped."""
+        tripped = False
+        if ok:
+            self.oks += 1
+        else:
+            self.fails += 1
+            if self.fails >= 3 and self.fails > self.oks:
+                self.breaker_until = now + hold
+                tripped = True
+        if self.fails + self.oks >= 64:  # rolling window reset
+            self.fails = self.oks = 0
+        return tripped
 
 
 class _Pending:
     """One request the frontend has accepted but not answered."""
 
-    __slots__ = ("request_id", "payload", "client", "worker", "submitted_t")
+    __slots__ = ("request_id", "payload", "client", "worker", "submitted_t",
+                 "deadline_t", "priority", "dispatched_t", "hedge_worker")
 
     def __init__(self, request_id: str, payload: bytes,
-                 client: Optional[_Peer]):
+                 client: Optional[_Peer], deadline: float = 0.0,
+                 priority: int = wire.SERVE_PRIO_HIGH):
         self.request_id = request_id
         self.payload = payload           # the SUBMIT payload, relay-ready
         self.client = client
         self.worker: Optional[str] = None
         self.submitted_t = time.monotonic()
+        # the wire deadline is a relative budget re-anchored on THIS
+        # host's monotonic clock (no cross-host clock comparison)
+        self.deadline_t = (self.submitted_t + deadline if deadline > 0
+                           else None)
+        self.priority = priority
+        self.dispatched_t: Optional[float] = None
+        self.hedge_worker: Optional[str] = None
 
 
 class ServingFrontend:
@@ -112,17 +190,41 @@ class ServingFrontend:
 
     ``max_backlog`` bounds requests waiting for worker capacity — beyond
     it, submits answer ``SERVE_REJECTED`` (clients back off and retry).
+
+    ``rank``/``gen`` identify this frontend in the blackbox/lease planes
+    (the primary is conventionally rank 0, a standby rank 1);
+    ``fence_epoch`` is stamped on every outgoing ``MSG_SERVE_*`` frame
+    when non-zero — a promoted standby seeds it from the lease it won.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  secret: Optional[str] = None, max_backlog: int = 1024,
-                 heartbeat_grace: Optional[float] = None):
+                 heartbeat_grace: Optional[float] = None, rank: int = 0,
+                 gen: int = 0, fence_epoch: int = 0):
         self.secret = (secret if secret is not None
                        else os.environ.get("HVD_SECRET", ""))
         hb = _env_float("HOROVOD_HEARTBEAT_INTERVAL", 5.0)
         self.heartbeat_grace = (heartbeat_grace if heartbeat_grace
                                 is not None else 3.0 * hb)
+        self.breaker_hold = 2.0 * min(hb, self.heartbeat_grace / 3.0)
         self.max_backlog = int(max_backlog)
+        self.rank = int(rank)
+        self.gen = int(gen)
+        self.fence_epoch = int(fence_epoch)
+        self.fenced = False
+        self.guard = wire.FenceGuard(rank=self.rank)
+        if self.fence_epoch:
+            self.guard.observe(self.fence_epoch)
+        # overload shedding: fraction of max_backlog past which best-effort
+        # submits are answered SERVE_SHED (0 = disabled); brownout (max_new
+        # clamp) starts at BROWNOUT_FRACTION of that point
+        self.shed_frac = _env_float("HOROVOD_SERVING_SHED", 0.0)
+        # hedging: multiplier on the live p95 (0 = disabled)
+        self.hedge_mult = _env_float("HOROVOD_SERVING_HEDGE", 0.0)
+        self.hedge_floor = 0.05
+        self.hedge_delay_override: Optional[float] = None
+        self._lat_ring: collections.deque = collections.deque(
+            maxlen=HEDGE_RING)
         self._stop = threading.Event()
         self.lock = threading.RLock()
         self.workers: Dict[str, _Worker] = {}
@@ -132,6 +234,12 @@ class ServingFrontend:
             collections.OrderedDict()
         self.readmitted = 0
         self.completed = 0
+        self.cancelled = 0
+        self.shed = 0
+        self.hedged = 0
+        self._repl_sinks: List[_Peer] = []
+        self._lease = None
+        self._last_shed_event = 0.0
         self._seq = 0
         self._threads: List[threading.Thread] = []
         self.listener = socket.create_server((host, port))
@@ -139,9 +247,36 @@ class ServingFrontend:
         self.addr = self.listener.getsockname()
 
     # ----------------------------------------------------------- lifecycle
+    def attach_lease(self, lease) -> None:
+        """Adopt an already-acquired :class:`~..runtime.lease.LeaseManager`
+        (the promoted standby passes the one it won) and start renewing.
+        Losing it later self-fences this frontend."""
+        self._lease = lease
+        self.fence_epoch = lease.epoch
+        self.guard.observe(lease.epoch)
+        lease.start_renewing(self._on_lease_fence)
+
+    def _maybe_acquire_lease(self) -> None:
+        from ..runtime import lease as _lease_mod
+
+        if (self._lease is not None or not standby_enabled()
+                or not _lease_mod.lease_enabled()):
+            return
+        mgr = _lease_mod.LeaseManager(self.gen, self.rank,
+                                      key=f"serve.lease.{self.gen}")
+        mgr.acquire_initial()
+        self.attach_lease(mgr)
+        logger.info("serving frontend holds lease serve.lease.%d "
+                    "epoch=%d", self.gen, mgr.epoch)
+
     def start(self) -> "ServingFrontend":
-        for fn, name in ((self._accept_loop, "hvd-serve-accept"),
-                         (self._liveness_loop, "hvd-serve-liveness")):
+        _blackbox.maybe_activate()
+        self._maybe_acquire_lease()
+        loops = [(self._accept_loop, "hvd-serve-accept"),
+                 (self._liveness_loop, "hvd-serve-liveness")]
+        if self.hedge_mult > 0:
+            loops.append((self._hedge_loop, "hvd-serve-hedge"))
+        for fn, name in loops:
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -151,8 +286,16 @@ class ServingFrontend:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._lease is not None:
+            self._lease.stop()
         with self.lock:
             peers = list(self.workers.values())
+            sinks, self._repl_sinks = list(self._repl_sinks), []
+        for s in sinks:
+            # a clean BYE tells the standby to stand down, not promote
+            s.send(self.secret, MSG_BYE, self._next_seq(), b"",
+                   fence=self.fence_epoch)
+            s.close()
         for p in peers:
             p.close()
         try:
@@ -161,6 +304,21 @@ class ServingFrontend:
             pass
         for t in self._threads:
             t.join(timeout=5)
+
+    def _on_lease_fence(self, reason: str) -> None:
+        """The lease moved under us: stop serving NOW. Peers are cut so
+        workers/clients reconnect, probe the failover key and land on the
+        promoted standby; any frame this deposed frontend still emits is
+        stamped with the stale epoch and fence-rejected remotely."""
+        self.fenced = True
+        logger.error("serving frontend self-fenced: %s", reason)
+        with self.lock:
+            peers = (list(self.workers.values())
+                     + [p.client for p in self.pending.values()
+                        if p.client is not None])
+            sinks, self._repl_sinks = list(self._repl_sinks), []
+        for p in peers + sinks:
+            p.close()
 
     def _next_seq(self) -> int:
         with self.lock:
@@ -182,12 +340,17 @@ class ServingFrontend:
 
     def _handshake(self, sock: socket.socket) -> None:
         try:
-            frame = wire.recv_frame(sock, self.secret, self._stop)
+            frame = wire.recv_frame(sock, self.secret, self._stop,
+                                    guard=self.guard)
+            if (frame.msg_type == MSG_REPL_HELLO
+                    and frame.payload.startswith(b"serve")):
+                self._run_repl_sink(_Peer(sock, "serve-standby"))
+                return
             if frame.msg_type != wire.MSG_SERVE_HELLO:
                 raise wire.FrameError(
                     f"expected SERVE_HELLO, got type {frame.msg_type}")
             role, name, capacity = wire.decode_serve_hello(frame.payload)
-        except (ConnectionError, OSError) as exc:
+        except (ConnectionError, OSError, wire.ShutdownError) as exc:
             logger.info("serving handshake failed: %s", exc)
             try:
                 sock.close()
@@ -199,19 +362,90 @@ class ServingFrontend:
         else:
             self._run_client(_Peer(sock, name))
 
+    # -------------------------------------------------------- replication
+    def _snapshot_payload(self) -> bytes:
+        with self.lock:
+            results = [
+                wire.encode_serve_result(rid, st, toks, err, lat)
+                for rid, (st, toks, err, lat) in self.results.items()]
+            pending = [p.payload for p in self.pending.values()]
+            return wire.encode_serve_snapshot(self.fence_epoch, results,
+                                              pending)
+
+    def _run_repl_sink(self, s: _Peer) -> None:
+        """One standby's replication stream: snapshot, then journal pushes
+        from the request paths. The reader side only watches for EOF."""
+        if not s.send(self.secret, MSG_SNAPSHOT, self._next_seq(),
+                      self._snapshot_payload(), fence=self.fence_epoch):
+            s.close()
+            return
+        with self.lock:
+            self._repl_sinks.append(s)
+        logger.info("serving standby attached for replication")
+        try:
+            while not self._stop.is_set() and s.alive:
+                wire.recv_frame(s.sock, self.secret, self._stop,
+                                guard=self.guard)
+        except (ConnectionError, OSError, wire.ShutdownError):
+            pass
+        finally:
+            s.close()
+            with self.lock:
+                if s in self._repl_sinks:
+                    self._repl_sinks.remove(s)
+
+    def _journal(self, kind: int, blob: bytes) -> None:
+        with self.lock:
+            sinks = list(self._repl_sinks)
+        if not sinks:
+            return
+        payload = wire.encode_serve_journal(kind, blob)
+        for s in sinks:
+            if not s.send(self.secret, MSG_JOURNAL, self._next_seq(),
+                          payload, fence=self.fence_epoch):
+                with self.lock:
+                    if s in self._repl_sinks:
+                        self._repl_sinks.remove(s)
+
+    def seed_state(self, results: List[bytes],
+                   pending: List[bytes]) -> None:
+        """Adopt replicated state (a promoted standby calls this before
+        :meth:`start`): finished results answer duplicates from the LRU,
+        open submits re-enter the dispatch queue. Deadline budgets restart
+        at promotion — strictly later than the original cutoff, never
+        earlier."""
+        with self.lock:
+            for blob in results:
+                rid, st, toks, err, lat = wire.decode_serve_result(blob)
+                self.results[rid] = (st, toks, err, lat)
+            for blob in pending:
+                (rid, _, _, _, deadline,
+                 priority) = wire.decode_serve_submit_ex(blob)
+                if rid in self.results or rid in self.pending:
+                    continue
+                self.pending[rid] = _Pending(rid, blob, None,
+                                             deadline=deadline,
+                                             priority=priority)
+                self.backlog.append(rid)
+
     # ------------------------------------------------------------ workers
     def _run_worker(self, w: _Worker) -> None:
         with self.lock:
             old = self.workers.get(w.name)
             if old is not None:
+                # a replacement claimed the name: settle the old socket's
+                # estate NOW so its reader thread (which may fire later)
+                # cannot mistake the newcomer's dispatches for orphans
                 old.close()
+                self._orphan_locked(old)
             self.workers[w.name] = w
         logger.info("serving worker %r joined (capacity %d)", w.name,
                     w.capacity)
         self._drain_backlog()
         try:
             while not self._stop.is_set() and w.alive:
-                frame = wire.recv_frame(w.sock, self.secret, self._stop)
+                frame = wire.recv_frame(w.sock, self.secret, self._stop,
+                                        guard=self.guard)
                 w.last_seen = time.monotonic()
                 if frame.msg_type == wire.MSG_SERVE_RESULT:
                     self._on_result(w, frame.payload)
@@ -225,7 +459,7 @@ class ServingFrontend:
                     store_report(rank, snap, ts)
                 elif frame.msg_type == MSG_HEARTBEAT:
                     pass  # last_seen bump above is the whole point
-        except (ConnectionError, OSError) as exc:
+        except (ConnectionError, OSError, wire.ShutdownError) as exc:
             if not self._stop.is_set():
                 logger.warning("serving worker %r lost: %s", w.name, exc)
         finally:
@@ -236,21 +470,44 @@ class ServingFrontend:
         if w.metrics_rank is not None:
             drop_report(w.metrics_rank)
         with self.lock:
-            if self.workers.get(w.name) is w:
-                del self.workers[w.name]
-            orphans = [p for p in self.pending.values()
-                       if p.worker == w.name]
-            for p in orphans:
-                p.worker = None
-                self.backlog.appendleft(p.request_id)
-            self.readmitted += len(orphans)
-        for _ in orphans:
+            if self.workers.get(w.name) is not w:
+                # a replacement already took the name and _run_worker
+                # settled this socket's estate at takeover; every pending
+                # bound to the name now belongs to the newcomer
+                return
+            del self.workers[w.name]
+            n = self._orphan_locked(w)
+        for _ in range(n):
             instruments.serving_requests().labels(status="readmitted").inc()
-        if orphans:
+        if n:
             logger.warning(
                 "re-admitting %d in-flight request(s) from dead worker %r",
-                len(orphans), w.name)
+                n, w.name)
         self._drain_backlog()
+
+    def _orphan_locked(self, w: _Worker) -> int:
+        """Re-own every pending bound to ``w`` (caller holds the lock):
+        hedged dispatches collapse onto their surviving leg, the rest go
+        back to the head of the line. Returns the readmitted count."""
+        orphans = []
+        for p in self.pending.values():
+            if p.hedge_worker == w.name:
+                # the surviving primary dispatch still owns it
+                p.hedge_worker = None
+                continue
+            if p.worker != w.name:
+                continue
+            if p.hedge_worker is not None:
+                # promote the hedge to primary instead of readmitting
+                p.worker, p.hedge_worker = p.hedge_worker, None
+                continue
+            orphans.append(p)
+        for p in orphans:
+            p.worker = None
+            p.dispatched_t = None
+            self.backlog.appendleft(p.request_id)
+        self.readmitted += len(orphans)
+        return len(orphans)
 
     def _liveness_loop(self) -> None:
         while not self._stop.wait(min(1.0, self.heartbeat_grace / 3)):
@@ -258,81 +515,253 @@ class ServingFrontend:
             with self.lock:
                 stale = [w for w in self.workers.values()
                          if now - w.last_seen > self.heartbeat_grace]
+                # heartbeat-latency feed of the circuit breaker: a replica
+                # late past half the grace window stops taking new load
+                # before the hard liveness verdict lands
+                for w in self.workers.values():
+                    if (now - w.last_seen > self.heartbeat_grace / 2
+                            and not w.breaker_open(now) and w not in stale):
+                        w.breaker_until = now + self.heartbeat_grace / 2
+                        logger.warning(
+                            "serving worker %r heartbeat late (%.1fs) — "
+                            "circuit breaker open", w.name,
+                            now - w.last_seen)
+                expired = [p.request_id for p in self.pending.values()
+                           if p.deadline_t is not None
+                           and now >= p.deadline_t]
             for w in stale:
                 logger.warning(
                     "serving worker %r silent for %.1fs — declaring dead",
                     w.name, now - w.last_seen)
                 w.close()  # the reader thread unblocks and drops it
+            for rid in expired:
+                self._cancel_request(rid, "deadline exceeded", "deadline")
 
     # ------------------------------------------------------------ clients
     def _run_client(self, c: _Peer) -> None:
         logger.info("serving client %r connected", c.name)
         try:
             while not self._stop.is_set() and c.alive:
-                frame = wire.recv_frame(c.sock, self.secret, self._stop)
+                frame = wire.recv_frame(c.sock, self.secret, self._stop,
+                                        guard=self.guard)
                 if frame.msg_type == wire.MSG_SERVE_SUBMIT:
                     self._on_submit(c, frame.payload)
-        except (ConnectionError, OSError):
+                elif frame.msg_type == wire.MSG_SERVE_CANCEL:
+                    rid, reason = wire.decode_serve_cancel(frame.payload)
+                    self._cancel_request(rid, reason or "client cancel",
+                                         "client")
+        except (ConnectionError, OSError, wire.ShutdownError):
             pass
         finally:
             c.close()
             with self.lock:
                 # keep pending requests running; results for a vanished
-                # client stay in the dedupe cache for its reconnect
+                # client stay in the dedupe cache for its reconnect. The
+                # worker-side TTL sweep reaps them if nobody ever returns.
                 for p in self.pending.values():
                     if p.client is c:
                         p.client = None
 
+    def _shed_point(self) -> float:
+        return self.shed_frac * self.max_backlog
+
+    def _record_shed(self, klass: str, occupancy: int) -> None:
+        now = time.monotonic()
+        if now - self._last_shed_event < 1.0:
+            return  # one blackbox event per burst-second is plenty
+        self._last_shed_event = now
+        _blackbox.record(
+            _blackbox.K_ANOMALY, "serving_shed",
+            "shedding class=%s resource=queue backlog=%d/%d"
+            % (klass, occupancy, self.max_backlog), rank=self.rank)
+
     def _on_submit(self, c: _Peer, payload: bytes) -> None:
-        request_id, _, _, _ = wire.decode_serve_submit(payload)
+        (request_id, prompt, max_new, eos, deadline,
+         priority) = wire.decode_serve_submit_ex(payload)
+        if self.fenced:
+            return  # deposed; the connection is being torn down anyway
         with self.lock:
             done = self.results.get(request_id)
             if done is not None:  # duplicate of a finished request
                 status, tokens, error, latency = done
-                c.send(self.secret, wire.MSG_SERVE_RESULT, self._seq,
+                c.send(self.secret, wire.MSG_SERVE_RESULT, self._next_seq(),
                        wire.encode_serve_result(request_id, status, tokens,
-                                                error, latency))
+                                                error, latency),
+                       fence=self.fence_epoch)
                 return
             p = self.pending.get(request_id)
             if p is not None:     # duplicate of an in-flight request —
                 p.client = c      # re-own it (client reconnected)
                 return
-            if len(self.pending) >= self.max_backlog:
+            occupancy = len(self.pending)
+            if occupancy >= self.max_backlog:
                 instruments.serving_requests().labels(
                     status="rejected").inc()
-                c.send(self.secret, wire.MSG_SERVE_RESULT, self._seq,
+                c.send(self.secret, wire.MSG_SERVE_RESULT, self._next_seq(),
                        wire.encode_serve_result(
                            request_id, wire.SERVE_REJECTED, [],
-                           "frontend backlog full; retry with backoff"))
+                           "frontend backlog full; retry with backoff"),
+                       fence=self.fence_epoch)
                 return
-            p = _Pending(request_id, payload, c)
+            if (self.shed_frac > 0
+                    and priority >= wire.SERVE_PRIO_BEST_EFFORT):
+                shed_point = self._shed_point()
+                if occupancy >= shed_point:
+                    # hard shed: terminal, never dispatched — the client
+                    # must NOT retry into the same overload
+                    self.shed += 1
+                    instruments.serving_shed().labels(
+                        **{"class": "best_effort"}).inc()
+                    self._record_shed("best_effort", occupancy)
+                    c.send(self.secret, wire.MSG_SERVE_RESULT, self._next_seq(),
+                           wire.encode_serve_result(
+                               request_id, wire.SERVE_SHED, [],
+                               "shed: best-effort load over %.0f%% of "
+                               "backlog" % (self.shed_frac * 100)),
+                           fence=self.fence_epoch)
+                    return
+                if (occupancy >= BROWNOUT_FRACTION * shed_point
+                        and max_new > 1):
+                    # brownout: serve a shorter generation instead of
+                    # nothing — degraded beats shed beats saturated
+                    max_new = max(1, max_new // 2)
+                    payload = wire.encode_serve_submit(
+                        request_id, prompt, max_new, eos, deadline,
+                        priority)
+                    instruments.serving_shed().labels(
+                        **{"class": "brownout"}).inc()
+                    self._record_shed("brownout", occupancy)
+            p = _Pending(request_id, payload, c, deadline=deadline,
+                         priority=priority)
             self.pending[request_id] = p
             self.backlog.append(request_id)
             instruments.serving_requests().labels(status="submitted").inc()
+        self._journal(wire.SERVE_J_SUBMIT, payload)
         self._drain_backlog()
+
+    # ------------------------------------------------------- cancellation
+    def _cancel_request(self, rid: str, reason: str, label: str) -> bool:
+        """Terminally cancel one open request: tombstone the result LRU
+        (so replays dedupe), answer the owning client, propagate
+        ``MSG_SERVE_CANCEL`` to every replica working on it."""
+        with self.lock:
+            p = self.pending.pop(rid, None)
+            if p is None:
+                return False  # already terminal — cancels race results
+            self.results[rid] = (wire.SERVE_CANCELLED, [], reason, 0.0)
+            while len(self.results) > RESULT_CACHE:
+                self.results.popitem(last=False)
+            self.cancelled += 1
+            workers = [self.workers.get(n)
+                       for n in (p.worker, p.hedge_worker) if n]
+            for w in workers:
+                if w is not None and w.inflight > 0:
+                    w.inflight -= 1
+            client = p.client
+        instruments.serving_cancels().labels(reason=label).inc()
+        instruments.serving_requests().labels(status="cancelled").inc()
+        cancel_payload = wire.encode_serve_cancel(rid, reason)
+        for w in workers:
+            if w is not None:
+                w.send(self.secret, wire.MSG_SERVE_CANCEL,
+                       self._next_seq(), cancel_payload,
+                       fence=self.fence_epoch)
+        if client is not None:
+            client.send(self.secret, wire.MSG_SERVE_RESULT,
+                        self._next_seq(),
+                        wire.encode_serve_result(rid, wire.SERVE_CANCELLED,
+                                                 [], reason),
+                        fence=self.fence_epoch)
+        self._journal(wire.SERVE_J_CANCEL, cancel_payload)
+        self._drain_backlog()
+        return True
+
+    # ------------------------------------------------------------ hedging
+    def _hedge_delay(self) -> float:
+        if self.hedge_delay_override is not None:
+            return self.hedge_delay_override
+        ring = sorted(self._lat_ring)
+        if len(ring) < 8:
+            return max(self.hedge_floor, self.hedge_mult * 0.25)
+        p95 = ring[min(len(ring) - 1, int(0.95 * len(ring)))]
+        return max(self.hedge_floor, self.hedge_mult * p95)
+
+    def _hedge_loop(self) -> None:
+        while not self._stop.wait(0.05):
+            delay = self._hedge_delay()
+            now = time.monotonic()
+            with self.lock:
+                laggards = [
+                    p.request_id for p in self.pending.values()
+                    if p.worker is not None and p.hedge_worker is None
+                    and p.dispatched_t is not None
+                    and now - p.dispatched_t >= delay]
+            for rid in laggards:
+                self._launch_hedge(rid)
+
+    def _launch_hedge(self, rid: str) -> None:
+        now = time.monotonic()
+        with self.lock:
+            p = self.pending.get(rid)
+            if p is None or p.worker is None or p.hedge_worker is not None:
+                return
+            cands = [w for w in self.workers.values()
+                     if w.alive and not w.draining and w.name != p.worker
+                     and w.inflight < w.capacity
+                     and not w.breaker_open(now)]
+            if not cands:
+                return
+            w = min(cands, key=lambda x: x.inflight / x.capacity)
+            p.hedge_worker = w.name
+            w.inflight += 1
+            self.hedged += 1
+        instruments.serving_hedges().labels(outcome="launched").inc()
+        logger.info("hedging request %s to %r (delay %.3fs past p95)",
+                    rid, w.name, self._hedge_delay())
+        w.send(self.secret, wire.MSG_SERVE_SUBMIT, self._next_seq(),
+               p.payload, fence=self.fence_epoch)
 
     # ---------------------------------------------------------- dispatch
     def _drain_backlog(self) -> None:
         """Assign queued requests to the least-loaded live workers."""
         while True:
+            now = time.monotonic()
             with self.lock:
-                if not self.backlog:
+                if not self.backlog or self.fenced:
                     return
-                candidates = [w for w in self.workers.values()
-                              if w.alive and w.inflight < w.capacity]
+                live = [w for w in self.workers.values()
+                        if w.alive and not w.draining
+                        and w.inflight < w.capacity]
+                # breaker-open replicas are skipped — unless EVERY live
+                # replica is open, where degraded dispatch beats none
+                candidates = ([w for w in live if not w.breaker_open(now)]
+                              or live)
                 if not candidates:
                     instruments.serving_queue_depth().set(len(self.backlog))
                     return
                 w = min(candidates, key=lambda x: x.inflight / x.capacity)
-                rid = self.backlog.popleft()
+                # high-priority requests overtake queued best-effort ones
+                # (FIFO within a class): the overload guarantee is that
+                # the high class only ever waits on its own kind
+                rid = None
+                for i, cand in enumerate(self.backlog):
+                    q = self.pending.get(cand)
+                    if q is not None and q.priority == wire.SERVE_PRIO_HIGH:
+                        rid = cand
+                        del self.backlog[i]
+                        break
+                if rid is None:
+                    rid = self.backlog.popleft()
                 p = self.pending.get(rid)
                 if p is None:
                     continue
                 p.worker = w.name
+                p.dispatched_t = now
                 w.inflight += 1
                 instruments.serving_queue_depth().set(len(self.backlog))
             if not w.send(self.secret, wire.MSG_SERVE_SUBMIT,
-                          self._next_seq(), p.payload):
+                          self._next_seq(), p.payload,
+                          fence=self.fence_epoch):
                 # send failed: the reader thread will reap the worker and
                 # re-admit; nothing to do here
                 logger.warning("dispatch to worker %r failed", w.name)
@@ -340,41 +769,106 @@ class ServingFrontend:
     def _on_result(self, w: _Worker, payload: bytes) -> None:
         request_id, status, tokens, error, latency = \
             wire.decode_serve_result(payload)
+        now = time.monotonic()
+        hedge_outcome = None
+        loser: Optional[_Worker] = None
         with self.lock:
-            p = self.pending.pop(request_id, None)
+            p = self.pending.get(request_id)
             if p is None:
-                return  # duplicate result (worker resend) — already done
+                # duplicate (worker resend), post-cancel echo, or the
+                # hedging loser landing after the winner — already done,
+                # and its inflight slot was already released
+                return
             if w.inflight > 0:
                 w.inflight -= 1
             if status == wire.SERVE_REJECTED:
+                if p.worker == w.name and p.hedge_worker is not None:
+                    # primary bounced but the hedge still runs it
+                    p.worker, p.hedge_worker = p.hedge_worker, None
+                    return
+                if p.hedge_worker == w.name:
+                    p.hedge_worker = None  # hedge bounced; primary runs it
+                    return
                 # worker-side backpressure: the request goes back in line
                 # rather than bouncing to the client
                 p.worker = None
-                self.pending[request_id] = p
+                p.dispatched_t = None
                 self.backlog.append(request_id)
                 self.readmitted += 1
             else:
+                self.pending.pop(request_id)
+                if p.hedge_worker is not None and p.worker is not None:
+                    won = w.name == p.hedge_worker
+                    hedge_outcome = "won" if won else "lost"
+                    loser = self.workers.get(
+                        p.worker if won else p.hedge_worker)
+                    if loser is not None and loser.inflight > 0:
+                        loser.inflight -= 1
                 self.results[request_id] = (status, tokens, error, latency)
                 while len(self.results) > RESULT_CACHE:
                     self.results.popitem(last=False)
                 self.completed += 1
                 client = p.client
+                w.record_outcome(status != wire.SERVE_FAILED, now,
+                                 self.breaker_hold)
         if status == wire.SERVE_REJECTED:
             instruments.serving_requests().labels(status="readmitted").inc()
             self._drain_backlog()
             return
-        total = time.monotonic() - p.submitted_t
+        if hedge_outcome is not None:
+            instruments.serving_hedges().labels(
+                outcome=hedge_outcome).inc()
+            if loser is not None:
+                loser.send(self.secret, wire.MSG_SERVE_CANCEL,
+                           self._next_seq(),
+                           wire.encode_serve_cancel(
+                               request_id, "hedge: first winner answered"),
+                           fence=self.fence_epoch)
+        total = now - p.submitted_t
+        if status == wire.SERVE_OK:
+            self._lat_ring.append(total)
         instruments.serving_request_latency().labels(stage="frontend") \
             .observe(total)
+        result_payload = wire.encode_serve_result(request_id, status,
+                                                  tokens, error, total)
         if client is not None:
             client.send(self.secret, wire.MSG_SERVE_RESULT,
-                        self._next_seq(),
-                        wire.encode_serve_result(request_id, status, tokens,
-                                                 error, total))
+                        self._next_seq(), result_payload,
+                        fence=self.fence_epoch)
+        self._journal(wire.SERVE_J_RESULT, result_payload)
         self._drain_backlog()
+
+    # -------------------------------------------------------------- drain
+    def drain_worker(self, name: str,
+                     reason: str = "rolling restart") -> bool:
+        """Quiesce one replica: no new dispatch from here, a
+        ``MSG_SERVE_DRAIN`` there (it finishes in-flight work and hands
+        queued work back as ``SERVE_REJECTED`` for re-dispatch)."""
+        with self.lock:
+            w = self.workers.get(name)
+            if w is None:
+                return False
+            w.draining = True
+        logger.info("draining serving worker %r (%s)", name, reason)
+        w.send(self.secret, wire.MSG_SERVE_DRAIN, self._next_seq(),
+               wire.encode_serve_drain(reason), fence=self.fence_epoch)
+        return True
+
+    def wait_worker_drained(self, name: str, timeout: float = 60.0) -> bool:
+        """True once the draining replica has zero in-flight requests —
+        the rolling-restart signal that it is safe to kill."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                w = self.workers.get(name)
+                if w is None or w.inflight == 0:
+                    return True
+            time.sleep(0.02)
+        return False
 
     # ------------------------------------------------------------- status
     def stats(self) -> dict:
+        now = time.monotonic()
         with self.lock:
             return {
                 "workers": sorted(self.workers),
@@ -382,6 +876,16 @@ class ServingFrontend:
                 "backlog": len(self.backlog),
                 "completed": self.completed,
                 "readmitted": self.readmitted,
+                "cancelled": self.cancelled,
+                "shed": self.shed,
+                "hedged": self.hedged,
+                "fence_epoch": self.fence_epoch,
+                "fenced": self.fenced,
+                "draining": sorted(w.name for w in self.workers.values()
+                                   if w.draining),
+                "breaker_open": sorted(w.name
+                                       for w in self.workers.values()
+                                       if w.breaker_open(now)),
             }
 
     def wait_for_workers(self, n: int, timeout: float = 60.0) -> None:
@@ -392,3 +896,46 @@ class ServingFrontend:
                     return
             time.sleep(0.05)
         raise TimeoutError(f"fewer than {n} serving workers joined")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m horovod_tpu.serving.server`` — the frontend process the
+    chaos drills SIGKILL. Publishes its address to the rendezvous KV
+    (``serve.addr.{gen}``) when one is configured, and flushes the
+    blackbox periodically so a SIGKILL still leaves a ledger behind."""
+    ap = argparse.ArgumentParser(description="horovod_tpu serving frontend")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--gen", type=int, default=0)
+    ap.add_argument("--max-backlog", type=int, default=1024)
+    ap.add_argument("--heartbeat-grace", type=float, default=None)
+    ap.add_argument("--flush-every", type=float, default=0.5,
+                    help="blackbox flush interval (seconds)")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s frontend %(message)s")
+    _blackbox.maybe_activate()
+    _blackbox.set_identity(args.rank, 2)
+    fe = ServingFrontend(host=args.host, port=args.port, rank=args.rank,
+                         gen=args.gen, max_backlog=args.max_backlog,
+                         heartbeat_grace=args.heartbeat_grace)
+    fe.start()
+    if os.environ.get("HVD_KV_ADDR"):
+        _publish_key(f"serve.addr.{args.gen}",
+                     "%s:%d" % fe.addr[:2], fe.secret)
+    print("SERVING_FRONTEND %s:%d" % fe.addr[:2], flush=True)
+    try:
+        while True:
+            time.sleep(args.flush_every)
+            # periodic flight-recorder flush: a SIGKILLed frontend loses
+            # at most one interval of lease/frame events
+            _blackbox.dump("serving frontend periodic flush", force=True)
+    except KeyboardInterrupt:
+        fe.stop()
+        _blackbox.dump("serving frontend exit", force=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

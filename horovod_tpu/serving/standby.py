@@ -1,0 +1,342 @@
+"""Warm-standby serving frontend: replication client + promotion logic.
+
+The serving plane's analog of :class:`~..runtime.standby.StandbyCoordinator`
+(docs/inference.md failure matrix, "frontend dies" row). A second frontend
+process runs a :class:`ServingStandby`: it dials the active frontend's
+listener with ``MSG_REPL_HELLO`` payload ``b"serve"``, receives one
+``MSG_SNAPSHOT`` of the durable request state — the finished-result LRU
+(dedupe answers) plus every open submit payload — and then applies a
+``MSG_JOURNAL`` record per accepted submit, terminal result, and cancel.
+
+The replicated state is exactly what exactly-once needs and nothing more:
+
+* **results** — so duplicate submits replayed by reconnecting clients are
+  answered from cache, never re-generated, across the failover boundary.
+* **pending submits** — so requests the old frontend accepted but had not
+  answered re-enter the dispatch queue on the promotee; the blind replay
+  clients do on reconnect makes delivery certain even for requests that
+  raced the crash (they dedupe against the seeded pending map).
+
+Dispatch assignments and worker inflight counts are NOT replicated: the
+promoted frontend starts with an empty worker table and simply re-dispatches
+everything pending as workers re-HELLO — worker-side ``_seen`` dedupe and
+the result LRU make the re-send idempotent.
+
+Promotion mirrors the coordinator rules exactly:
+
+* **Lease mode** (``HOROVOD_LEASE_TTL`` + ``HVD_KV_ADDR``): stream loss
+  alone never promotes. The standby watches ``serve.lease.{gen}`` and
+  takes over only after a full TTL of observed stasis on its own clock,
+  by winning the CAS (epoch+1). The new epoch fences the old frontend's
+  frames everywhere.
+* **Crash-only mode** (no lease): a few quick re-dials, then promote.
+  Fencing is toothless (epoch stays 0) — same documented trade-off as the
+  coordinator plane.
+
+The promoted frontend publishes ``serve.addr.{gen}.f1``; workers and
+clients probe that key after their reconnect backoff fails against the
+dead address. One failover deep by design.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import blackbox as _blackbox
+from ..exceptions import ShutdownError
+from ..metrics import instruments
+from ..runtime import lease as _lease_mod
+from ..runtime import wire
+from ..runtime.coordinator import (MSG_BYE, MSG_JOURNAL, MSG_SNAPSHOT,
+                                   _advertise_host, _publish_key)
+from ..runtime.standby import dial_repl
+from .server import ServingFrontend
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class ServingStandby:
+    """A warm frontend replica: mirrors the primary's request ledger and
+    promotes itself into a live :class:`ServingFrontend` when the primary
+    dies (lease-gated when fencing is configured)."""
+
+    def __init__(self, primary_addr: Tuple[str, int], secret: str,
+                 rank: int = 1, gen: int = 0):
+        self._addr = primary_addr
+        self._secret = secret
+        self._rank = rank
+        self._gen = gen
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._have_snapshot = False
+        # replica of the primary's durable request state
+        self._results: "Dict[str, bytes]" = {}   # rid -> RESULT payload
+        self._pending: "Dict[str, bytes]" = {}   # rid -> SUBMIT payload
+        self._epoch = 0
+        self.promoted = False
+        self.frontend: Optional[ServingFrontend] = None
+        self._guard = wire.FenceGuard(rank=rank)
+        self._lease = (_lease_mod.LeaseManager(
+            gen, rank, key=f"serve.lease.{gen}")
+            if _lease_mod.lease_enabled() else None)
+        self._lease_watching = False
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-serve-standby", daemon=True)
+
+    def start(self) -> "ServingStandby":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._lease is not None:
+            self._lease.stop()
+        with self._lock:
+            fe = self.frontend
+        if fe is not None:
+            fe.stop()
+
+    def wait_promoted(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.promoted:
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return False
+
+    # ------------------------------------------------------- replication
+    def _dial(self) -> socket.socket:
+        return dial_repl(self._addr, self._secret, self._rank,
+                         hello_payload=b"serve", fence=self._guard.epoch)
+
+    def _run(self) -> None:
+        sock: Optional[socket.socket] = None
+        for _ in range(5):
+            try:
+                sock = self._dial()
+                break
+            except (ConnectionError, OSError):
+                if self._stop.wait(0.2):
+                    return
+        if sock is None:
+            logger.warning("serving standby: never reached the primary's "
+                           "replication endpoint; standby inactive")
+            return
+        try:
+            while not self._stop.is_set():
+                try:
+                    mt, _, _, payload = wire.recv_frame(
+                        sock, self._secret, self._stop, guard=self._guard)
+                except ShutdownError:
+                    return
+                except wire.FenceError as exc:
+                    # the deposed primary confirming it fenced — done
+                    logger.info("serving standby: deposed primary's frame "
+                                "rejected (%s)", exc)
+                    return
+                except (ConnectionError, OSError) as exc:
+                    if self._stop.is_set():
+                        return
+                    if self._lease is not None:
+                        # lease mode: the watcher alone promotes; keep a
+                        # path open for a healed primary's BYE/frames
+                        redialed = self._redial(120, 0.5)
+                        if redialed is None:
+                            return
+                        sock = redialed
+                        continue
+                    redialed = self._redial(3, 0.3)
+                    if redialed is not None:
+                        sock = redialed
+                        continue
+                    if self._have_snapshot:
+                        self._promote(exc)
+                    return
+                if mt == MSG_SNAPSHOT:
+                    epoch, results, pending = wire.decode_serve_snapshot(
+                        payload)
+                    with self._lock:
+                        self._epoch = epoch
+                        self._results = {
+                            wire.decode_serve_result(b)[0]: b
+                            for b in results}
+                        self._pending = {
+                            wire.decode_serve_submit_ex(b)[0]: b
+                            for b in pending}
+                    self._have_snapshot = True
+                    logger.info(
+                        "serving standby: snapshot applied (%d results, "
+                        "%d pending, epoch %d)", len(results),
+                        len(pending), epoch)
+                    if self._lease is not None and not self._lease_watching:
+                        self._lease_watching = True
+                        threading.Thread(target=self._lease_watch,
+                                         name="hvd-serve-lease-watch",
+                                         daemon=True).start()
+                elif mt == MSG_JOURNAL:
+                    self._apply_journal(payload)
+                elif mt == MSG_BYE:
+                    logger.info("serving standby: primary said BYE; "
+                                "standing down")
+                    return
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _apply_journal(self, payload: bytes) -> None:
+        kind, blob = wire.decode_serve_journal(payload)
+        with self._lock:
+            if kind == wire.SERVE_J_SUBMIT:
+                rid = wire.decode_serve_submit_ex(blob)[0]
+                if rid not in self._results:
+                    self._pending[rid] = blob
+            elif kind == wire.SERVE_J_RESULT:
+                rid = wire.decode_serve_result(blob)[0]
+                self._results[rid] = blob
+                self._pending.pop(rid, None)
+            elif kind == wire.SERVE_J_CANCEL:
+                rid, reason = wire.decode_serve_cancel(blob)
+                self._pending.pop(rid, None)
+                # tombstone: a replayed duplicate must see CANCELLED, not
+                # trigger a fresh generation on the promotee
+                self._results[rid] = wire.encode_serve_result(
+                    rid, wire.SERVE_CANCELLED, [], reason)
+
+    def _redial(self, attempts: int, pause: float
+                ) -> Optional[socket.socket]:
+        for _ in range(attempts):
+            if self._stop.wait(pause):
+                return None
+            try:
+                return self._dial()
+            except (ConnectionError, OSError):
+                continue
+        return None
+
+    # ------------------------------------------------------ lease watcher
+    def _lease_watch(self) -> None:
+        """Observed-stasis takeover on ``serve.lease.{gen}`` — identical
+        protocol to the coordinator standby's watcher: a full TTL of
+        stasis on our own clock, then the CAS decides."""
+        assert self._lease is not None
+        poll = min(self._lease.renew_interval, 0.25)
+        ttl = self._lease.ttl
+        last_val: Optional[bytes] = None
+        last_change = time.monotonic()
+        while not self._stop.wait(poll):
+            if self.promoted:
+                return
+            try:
+                val = self._lease.read()
+            except (ConnectionError, OSError):
+                last_change = time.monotonic()  # blind ≠ stasis
+                continue
+            if val != last_val:
+                last_val = val
+                last_change = time.monotonic()
+                continue
+            if time.monotonic() - last_change < ttl:
+                continue
+            if not self._have_snapshot:
+                continue
+            try:
+                epoch = self._lease.acquire_over(val)
+            except (ConnectionError, OSError):
+                last_change = time.monotonic()
+                continue
+            if epoch is None:
+                last_val = None  # lost the race; observe afresh
+                last_change = time.monotonic()
+                continue
+            self._guard.observe(epoch)
+            self._promote(
+                RuntimeError("serving lease expired: full TTL of observed "
+                             "stasis"), fence_epoch=epoch)
+            return
+
+    # --------------------------------------------------------- promotion
+    def _promote(self, why: Exception, fence_epoch: int = 0) -> None:
+        with self._lock:
+            if self.promoted:
+                return
+            results = list(self._results.values())
+            pending = list(self._pending.values())
+        advertise = _advertise_host()
+        bind = "127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0"
+        fe = ServingFrontend(host=bind, port=0, secret=self._secret,
+                             rank=self._rank, gen=self._gen,
+                             fence_epoch=fence_epoch)
+        # seed the ledger BEFORE opening for traffic: the first replayed
+        # submit must already hit the dedupe cache / pending map
+        fe.seed_state(results, pending)
+        if self._lease is not None and fence_epoch:
+            # the promotee now holds the lease; losing it later fences it
+            # by the same rule the old primary obeyed
+            fe.attach_lease(self._lease)
+        fe.start()
+        with self._lock:
+            self.frontend = fe
+            self.promoted = True
+        try:
+            _publish_key(f"serve.addr.{self._gen}.f1",
+                         f"{advertise}:{fe.addr[1]}", self._secret)
+        except (ConnectionError, OSError, KeyError, RuntimeError) as exc:
+            # no rendezvous KV (e.g. a direct-addressed pod): peers find
+            # the promotee by probing their configured address list
+            logger.warning("serving standby: failover address publish "
+                           "failed: %s", exc)
+        instruments.serving_frontend_failovers().inc()
+        _blackbox.record(
+            _blackbox.K_FAILOVER, "rank_%d" % self._rank,
+            "serving standby promoted to frontend at %s:%d "
+            "(epoch %d, %d results, %d pending re-queued) after %s"
+            % (advertise, fe.addr[1], fence_epoch, len(results),
+               len(pending), why),
+            rank=self._rank)
+        logger.warning(
+            "serving standby: PROMOTED to frontend at %s:%d (epoch %d, "
+            "%d pending re-queued): %s", advertise, fe.addr[1],
+            fence_epoch, len(pending), why)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m horovod_tpu.serving.standby`` — the warm-standby
+    process the chaos drills pair with a SIGKILLed primary."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="horovod_tpu serving frontend warm standby")
+    ap.add_argument("--primary", required=True, metavar="HOST:PORT")
+    ap.add_argument("--rank", type=int, default=1)
+    ap.add_argument("--gen", type=int, default=0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s standby %(message)s")
+    import os
+
+    _blackbox.maybe_activate()
+    _blackbox.set_identity(args.rank, 2)
+    host, port = args.primary.rsplit(":", 1)
+    sb = ServingStandby((host, int(port)),
+                        os.environ.get("HVD_SECRET", ""),
+                        rank=args.rank, gen=args.gen)
+    sb.start()
+    try:
+        while True:
+            time.sleep(0.5)
+            _blackbox.dump("serving standby periodic flush", force=True)
+    except KeyboardInterrupt:
+        sb.stop()
+        _blackbox.dump("serving standby exit", force=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
