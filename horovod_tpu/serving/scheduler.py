@@ -182,6 +182,7 @@ class ContinuousBatchingScheduler:
         BEFORE this call — they get one decode token this step. Prefilled
         requests join the decode batch at the NEXT step (their first token
         comes out of the prefill forward itself)."""
+        evicted: List[Request] = []
         with self.lock:
             decodes = list(self.active)
             prefills: List[Request] = []
@@ -196,7 +197,7 @@ class ContinuousBatchingScheduler:
                     # and KV blocks on an answer nobody is waiting for
                     self.waiting.pop(i)
                     self.cancelled += 1
-                    req.finish(CANCELLED, "deadline exceeded in queue")
+                    evicted.append(req)
                     continue
                 if self._admissible(req):
                     self.waiting.pop(i)
@@ -209,7 +210,11 @@ class ContinuousBatchingScheduler:
                     break  # the queue head waits; nobody overtakes it
                 else:
                     i += 1
-            return prefills, decodes
+        # finish() fires completion callbacks (result delivery — possibly
+        # a blocking socket send): outside the lock, like complete()/sweep()
+        for req in evicted:
+            req.finish(CANCELLED, "deadline exceeded in queue")
+        return prefills, decodes
 
     # --------------------------------------------------------- completion
     def complete(self, request: Request, state: str = DONE,
@@ -239,22 +244,27 @@ class ContinuousBatchingScheduler:
         Callers on the engine thread may invoke this directly; other
         threads should route through ``ServingEngine.cancel`` so the
         eviction lands between engine steps, never mid-forward."""
+        found: Optional[Request] = None
         with self.lock:
             for req in self.waiting:
                 if req.id == request_id:
                     self.waiting.remove(req)
                     self.cancelled += 1
-                    req.finish(CANCELLED, reason)
-                    return req
-            for req in self.active:
-                if req.id == request_id:
-                    self.active.remove(req)
-                    if req.id in self.cache.requests():
-                        self.cache.free(req.id)
-                    self.cancelled += 1
-                    req.finish(CANCELLED, reason)
-                    return req
-        return None
+                    found = req
+                    break
+            if found is None:
+                for req in self.active:
+                    if req.id == request_id:
+                        self.active.remove(req)
+                        if req.id in self.cache.requests():
+                            self.cache.free(req.id)
+                        self.cancelled += 1
+                        found = req
+                        break
+        if found is not None:
+            # callback runs outside the lock (see schedule()/complete())
+            found.finish(CANCELLED, reason)
+        return found
 
     def sweep(self) -> Tuple[List[Request], List[Request]]:
         """One pass of the lifetime/deadline sweep: evict every request

@@ -593,7 +593,10 @@ class ServingFrontend:
             if p is not None:     # duplicate of an in-flight request —
                 p.client = c      # re-own it (client reconnected)
                 return
-            occupancy = len(self.pending)
+            # true queue depth: requests waiting for worker capacity —
+            # dispatched in-flight work is already bounded by replica
+            # capacity and must not eat into the admission budget
+            occupancy = len(self.backlog)
             if occupancy >= self.max_backlog:
                 instruments.serving_requests().labels(
                     status="rejected").inc()

@@ -147,6 +147,11 @@ class ServingWorker:
                                             self.name,
                                             self.engine.config.max_batch),
                     fence=self._guard.epoch)
+                # a drain is scoped to the frontend session that issued
+                # it; this HELLO opened a new session (possibly with a
+                # promoted standby that knows nothing of the drain), so
+                # the replica serves again
+                self.draining = False
                 return sock
             except OSError as exc:
                 if attempt >= 2:
@@ -183,6 +188,11 @@ class ServingWorker:
                                deadline=deadline or None)
         except QueueFull:
             self._record_saturation()
+            with self._unsent_lock:
+                # handing the request back: forget the id, or the
+                # frontend's re-dispatch of this retryable rejection
+                # would be swallowed as a duplicate (mirrors _on_drain)
+                self._seen.pop(rid, None)
             self._queue_result(rid, wire.encode_serve_result(
                 rid, wire.SERVE_REJECTED, [],
                 "replica queue full"))

@@ -174,6 +174,42 @@ class TestSchedulerCancel:
         assert a.state == ACTIVE and b.state == QUEUED  # b left intact
         assert s.queue_depth() == 0
 
+    @staticmethod
+    def _lock_free_probe(sched, results):
+        """Callback asserting the scheduler lock is NOT held: a foreign
+        thread must be able to take it while the callback runs (finish()
+        can block on a slow result send — holding the lock there stalls
+        every submit/cancel/schedule caller)."""
+        def cb(req):
+            got = []
+
+            def probe():
+                if sched.lock.acquire(timeout=2.0):
+                    sched.lock.release()
+                    got.append(True)
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+            results.append(bool(got))
+        return cb
+
+    def test_deadline_eviction_finishes_outside_lock(self):
+        s = _sched()
+        free = []
+        s.submit(Request([1], 1, deadline=0.01,
+                         callback=self._lock_free_probe(s, free)))
+        time.sleep(0.03)
+        s.schedule()
+        assert free == [True]
+
+    def test_cancel_finishes_outside_lock(self):
+        s = _sched()
+        free = []
+        r = s.submit(Request([1], 1,
+                             callback=self._lock_free_probe(s, free)))
+        assert s.cancel(r.id, "client gone")
+        assert free == [True]
+
     def test_ttl_knob_read_from_env(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_SERVING_REQUEST_TTL", "12.5")
         assert _sched().request_ttl == 12.5
@@ -236,6 +272,51 @@ class TestEngineCancel:
         eng.submit([1, 2], 2)
         eng.step()
         assert eng.saturated_resource() == "decode_slots"
+
+
+# ----------------------------------------------------- worker handback
+class TestWorkerHandback:
+    def _worker(self, lm, host="127.0.0.1", port=1, **kw):
+        from horovod_tpu.serving.worker import ServingWorker
+        return ServingWorker(host, port, _engine(lm, **kw))
+
+    def test_queuefull_handback_forgets_request_id(self, lm):
+        """The readmit-loop regression: a QueueFull rejection hands the
+        request back to the frontend, which may re-dispatch it to this
+        same replica (guaranteed with one replica under load) — the retry
+        must not be swallowed by the dedupe set, or the request hangs
+        forever and the frontend's inflight slot leaks."""
+        w = self._worker(lm, max_queue=1, max_batch=1)
+        filler = w.engine.submit([9, 9], 2)
+        payload = wire.encode_serve_submit("r1", [1, 2], 2, None)
+        w._on_submit(payload)  # replica queue full: handed back
+        assert "r1" not in w._seen
+        assert wire.decode_serve_result(w._unsent["r1"])[1] == \
+            wire.SERVE_REJECTED
+        # capacity frees up; the frontend re-dispatches the same id —
+        # it must be accepted, not dropped as a duplicate
+        w.engine.cancel(filler.id, "test")
+        w.engine.step()
+        w._unsent.clear()
+        w._on_submit(payload)
+        assert "r1" in w._seen
+        assert w.engine.scheduler.queue_depth() == 1
+
+    def test_draining_cleared_on_new_session(self, lm):
+        """A drain is scoped to the frontend session that issued it: after
+        reconnecting (e.g. to a promoted standby that knows nothing of the
+        drain) the replica must serve again, not reject forever."""
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            w = self._worker(lm, port=srv.getsockname()[1])
+            w.draining = True
+            sock = w._connect()
+            assert w.draining is False
+            sock.close()
+        finally:
+            srv.close()
 
 
 # ---------------------------------------------------------- reconnect jitter
@@ -487,6 +568,27 @@ class TestFrontendOverload:
                 wire.SERVE_REJECTED
         finally:
             cs.close()
+
+    def test_inflight_dispatch_not_counted_against_admission(self, fe):
+        """max_backlog bounds requests WAITING for worker capacity (the
+        class docstring's contract): work already dispatched to a replica
+        is bounded by that replica's capacity and must not eat into the
+        admission budget, or a pod with plenty of free decode slots
+        rejects traffic it could absorb."""
+        fe.max_backlog = 2
+        cs = _dial(fe.addr, wire.SERVE_ROLE_CLIENT, "c")
+        ws = _dial(fe.addr, wire.SERVE_ROLE_WORKER, "w", capacity=4)
+        try:
+            _submit(cs, "a")
+            _submit(cs, "b")
+            # both dispatched to the worker: queue empty, 2 in flight
+            assert _wait(lambda: len(fe.pending) == 2
+                         and not fe.backlog)
+            _submit(cs, "c")  # would be rejected under an open-request cap
+            assert _wait(lambda: "c" in fe.pending)
+        finally:
+            cs.close()
+            ws.close()
 
 
 class TestCircuitBreaker:
